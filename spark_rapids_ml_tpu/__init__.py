@@ -1,0 +1,55 @@
+#
+# spark_rapids_ml_tpu: a TPU-native distributed classical-ML framework with
+# the capabilities of NVIDIA's spark-rapids-ml (reference mounted at
+# /root/reference), rebuilt on jax/XLA/pjit: estimators dispatch to jax.jit'd
+# solvers sharded over a device Mesh instead of cuML MG kernels over NCCL.
+#
+from .version import __version__
+
+__all__ = [
+    "__version__",
+    "KMeans",
+    "KMeansModel",
+    "PCA",
+    "PCAModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
+    "NearestNeighbors",
+    "NearestNeighborsModel",
+    "UMAP",
+    "UMAPModel",
+    "CrossValidator",
+]
+
+
+def __getattr__(name):  # lazy re-exports keep `import spark_rapids_ml_tpu` light
+    from importlib import import_module
+
+    _locations = {
+        "KMeans": ".models.kmeans",
+        "KMeansModel": ".models.kmeans",
+        "PCA": ".models.pca",
+        "PCAModel": ".models.pca",
+        "LinearRegression": ".models.linear_regression",
+        "LinearRegressionModel": ".models.linear_regression",
+        "LogisticRegression": ".models.logistic_regression",
+        "LogisticRegressionModel": ".models.logistic_regression",
+        "RandomForestClassifier": ".models.random_forest",
+        "RandomForestClassificationModel": ".models.random_forest",
+        "RandomForestRegressor": ".models.random_forest",
+        "RandomForestRegressionModel": ".models.random_forest",
+        "NearestNeighbors": ".models.knn",
+        "NearestNeighborsModel": ".models.knn",
+        "UMAP": ".models.umap",
+        "UMAPModel": ".models.umap",
+        "CrossValidator": ".tuning",
+    }
+    if name in _locations:
+        return getattr(import_module(_locations[name], __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

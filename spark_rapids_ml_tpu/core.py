@@ -1,0 +1,561 @@
+#
+# Core estimator/model machinery: ingest -> mesh-sharded jax arrays, fit
+# dispatch, transform dispatch, persistence.
+#
+# Structural counterpart of the reference's core
+# (/root/reference/python/src/spark_rapids_ml/core.py): _CumlCaller
+# _pre_process_data/_call_cuml_fit_func (:344-640), _CumlEstimator._fit_internal
+# (:856), _FitMultipleIterator (:649), _CumlModel transform/evaluate plumbing
+# (:1126-1377), and the writer/reader pairs (:139-226).  The execution model is
+# redesigned TPU-first rather than translated:
+#
+#   reference: driver builds a closure -> mapInPandas -> barrier task per GPU
+#              -> NCCL rank per task -> cuML MG kernels all-reduce per iter
+#   here:      ingest concatenates Arrow/pandas partitions into host numpy,
+#              zero-pads rows, device_puts with NamedSharding(P("data")) over a
+#              jax Mesh, and calls a pure jax fit function; XLA/GSPMD inserts
+#              psum/all_gather collectives (ICI intra-host, DCN inter-host).
+#              One *process* spans many chips (single-controller); multi-host
+#              runs extend the same mesh via parallel/context.TpuContext.
+#
+# Padded rows are masked through the `weight` vector so every solver is
+# weighted by construction (weightCol support falls out for free).
+#
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from abc import abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+import jax
+
+from .dataframe import DataFrame, as_dataframe
+from .params import Param, Params, _TpuParams
+from .parallel.mesh import get_mesh, shard_rows, data_sharding
+from .parallel.partition import PartitionDescriptor
+from .utils import get_logger, stack_feature_cells
+
+_SinglePdDataFrameBatchType = Tuple[pd.DataFrame, Optional[pd.DataFrame]]
+
+
+@dataclass
+class FitInputs:
+    """Device-resident, row-sharded training inputs handed to fit functions."""
+
+    X: jax.Array                      # (N_pad, D) row-sharded over mesh "data" axis
+    weight: jax.Array                 # (N_pad,) user weight * valid-row mask
+    y: Optional[jax.Array]            # (N_pad,) labels (supervised only)
+    n_rows: int                       # valid rows (N_pad >= n_rows)
+    n_cols: int
+    mesh: Any
+    pdesc: PartitionDescriptor
+    dtype: np.dtype
+    row_id: Optional[np.ndarray] = None   # original row numbers (host, unpadded)
+    extra_cols: Dict[str, np.ndarray] = field(default_factory=dict)
+
+
+# fit function: (inputs, params-dict) -> model attribute dict (or list of
+# dicts when fitting multiple param maps in a single pass)
+FitFunc = Callable[[FitInputs, Dict[str, Any]], Union[Dict[str, Any], List[Dict[str, Any]]]]
+# transform function: feature batch -> {output column name: column values}
+TransformFunc = Callable[[np.ndarray], Dict[str, Any]]
+
+
+class _TpuCaller(_TpuParams):
+    """Shared ingest + fit-dispatch (reference _CumlCaller core.py:327-647)."""
+
+    def _use_dtype(self, df: DataFrame, col: Optional[str]) -> np.dtype:
+        if self._float32_inputs:
+            return np.dtype(np.float32)
+        # preserve f64 when float32_inputs disabled (reference core.py:363-401)
+        return np.dtype(np.float64)
+
+    def _extract_partition_features(
+        self, part: pd.DataFrame, input_col: Optional[str], input_cols: Optional[List[str]], dtype: np.dtype
+    ) -> np.ndarray:
+        if input_col is not None:
+            cells = part[input_col].tolist()
+            if len(cells) == 0:
+                return np.zeros((0, 0), dtype=dtype)
+            return stack_feature_cells(cells, dtype)
+        assert input_cols is not None
+        return np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+
+    def _pre_process_data(
+        self, df: DataFrame
+    ) -> Tuple[List[np.ndarray], Optional[List[np.ndarray]], Optional[List[np.ndarray]], np.dtype]:
+        """Per-partition (features, label, weight) numpy extraction with dtype
+        casting (reference core.py:344-422 + supervised label cast :918-952)."""
+        input_col, input_cols = self._get_input_columns()
+        dtype = self._use_dtype(df, input_col)
+        feats, labels, weights = [], None, None
+        label_col = (
+            self.getOrDefault("labelCol")
+            if isinstance(self, _TpuEstimatorSupervised) and self.hasParam("labelCol")
+            else None
+        )
+        weight_col = (
+            self.getOrDefault("weightCol")
+            if self.hasParam("weightCol") and self.isSet("weightCol")
+            else None
+        )
+        if label_col is not None:
+            labels = []
+        if weight_col is not None:
+            weights = []
+        for part in df.partitions:
+            feats.append(self._extract_partition_features(part, input_col, input_cols, dtype))
+            if labels is not None:
+                labels.append(np.asarray(part[label_col].to_numpy(), dtype=dtype))
+            if weights is not None:
+                weights.append(np.asarray(part[weight_col].to_numpy(), dtype=dtype))
+        return feats, labels, weights, dtype
+
+    def _build_fit_inputs(
+        self, df: DataFrame, keep_row_id: bool = False
+    ) -> FitInputs:
+        feats, labels, weights, dtype = self._pre_process_data(df)
+        partition_rows = [f.shape[0] for f in feats]
+        nonempty = [f for f in feats if f.shape[0] > 0]
+        if not nonempty:
+            raise RuntimeError("Dataset is empty; cannot fit")
+        from .utils import _concat_and_free
+
+        X = _concat_and_free(nonempty, order="C")
+        n_rows, n_cols = X.shape
+        mesh = get_mesh(self.num_workers)
+        y_np = np.concatenate(labels) if labels is not None else None
+        w_np = np.concatenate(weights) if weights is not None else np.ones(n_rows, dtype=dtype)
+        Xs, _ = shard_rows(X, mesh)
+        n_pad = Xs.shape[0]
+        mask = np.zeros(n_pad, dtype=dtype)
+        mask[:n_rows] = w_np
+        ws = jax.device_put(mask, data_sharding(mesh))
+        ys = None
+        if y_np is not None:
+            y_pad = np.zeros(n_pad, dtype=dtype)
+            y_pad[:n_rows] = y_np
+            ys = jax.device_put(y_pad, data_sharding(mesh))
+        pdesc = PartitionDescriptor.build(partition_rows, n_cols)
+        return FitInputs(
+            X=Xs,
+            weight=ws,
+            y=ys,
+            n_rows=n_rows,
+            n_cols=n_cols,
+            mesh=mesh,
+            pdesc=pdesc,
+            dtype=dtype,
+            row_id=np.arange(n_rows) if keep_row_id else None,
+        )
+
+    def _call_tpu_fit_func(
+        self,
+        dataset: Any,
+        paramMaps: Optional[List[Dict[Param, Any]]] = None,
+    ) -> Union[Dict[str, Any], List[Dict[str, Any]]]:
+        """Dispatch one (or a batch of) fits on the device mesh (reference
+        _call_cuml_fit_func core.py:488-640, single data load for all param
+        maps as in _fit_internal core.py:723-752)."""
+        df = as_dataframe(dataset)
+        self._validate_parameters(df)
+        inputs = self._build_fit_inputs(df)
+        extra_params = None
+        if paramMaps is not None:
+            extra_params = [self._paramMap_to_tpu_overrides(pm) for pm in paramMaps]
+        fit_func = self._get_tpu_fit_func(df, extra_params)
+        logger = get_logger(type(self))
+        logger.info(
+            "Invoking TPU fit: %d rows x %d cols on %d-device mesh",
+            inputs.n_rows, inputs.n_cols, inputs.mesh.devices.size,
+        )
+        return fit_func(inputs, dict(self._tpu_params))
+
+    def _paramMap_to_tpu_overrides(self, paramMap: Dict[Param, Any]) -> Dict[str, Any]:
+        mapping = self._param_mapping()
+        overrides: Dict[str, Any] = {}
+        for param, value in paramMap.items():
+            solver = mapping.get(param.name)
+            if solver:
+                value_mapping = self._param_value_mapping()
+                if solver in value_mapping:
+                    mapped = value_mapping[solver](value)
+                    if mapped is None:
+                        raise ValueError(
+                            f"Value '{value}' for param '{param.name}' is not supported on TPU"
+                        )
+                    value = mapped
+                overrides[solver] = value
+            elif solver is None and param.name in mapping:
+                raise ValueError(f"Param '{param.name}' unsupported on TPU")
+        return overrides
+
+    def _validate_parameters(self, df: DataFrame) -> None:
+        input_col, input_cols = self._get_input_columns()
+        cols = df.columns
+        missing = [
+            c for c in ([input_col] if input_col else input_cols or []) if c not in cols
+        ]
+        if missing:
+            raise ValueError(f"Input column(s) {missing} not found in dataset {cols}")
+
+    # -- abstract ----------------------------------------------------------
+    @abstractmethod
+    def _get_tpu_fit_func(
+        self, dataset: DataFrame, extra_params: Optional[List[Dict[str, Any]]] = None
+    ) -> FitFunc:
+        raise NotImplementedError
+
+
+class _FitMultipleIterator:
+    """Thread-safe (index, model) iterator over single-pass multi-model fits
+    (reference core.py:649-721)."""
+
+    def __init__(self, fit_multiple_models: Callable[[], List["_TpuModel"]], num_models: int):
+        self.fit_multiple_models = fit_multiple_models
+        self.num_models = num_models
+        self.counter = 0
+        self.lock = threading.Lock()
+        self.models: Optional[List[_TpuModel]] = None
+
+    def __iter__(self) -> "_FitMultipleIterator":
+        return self
+
+    def __next__(self) -> Tuple[int, "_TpuModel"]:
+        with self.lock:
+            index = self.counter
+            if index >= self.num_models:
+                raise StopIteration()
+            self.counter += 1
+            if self.models is None:
+                self.models = self.fit_multiple_models()
+        return index, self.models[index]
+
+
+class _TpuEstimator(_TpuCaller):
+    """Base estimator (reference _CumlEstimator core.py:717-916)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.logger = get_logger(type(self))
+
+    # -- public API --------------------------------------------------------
+    def fit(
+        self, dataset: Any, params: Optional[Union[Dict[Param, Any], List[Dict[Param, Any]]]] = None
+    ) -> Any:
+        if isinstance(params, (list, tuple)):
+            return [m for _, m in sorted(self.fitMultiple(dataset, list(params)))]
+        if isinstance(params, dict) and params:
+            return self.copy(params)._fit(dataset)
+        return self._fit(dataset)
+
+    def _fit(self, dataset: Any) -> "_TpuModel":
+        return self._fit_internal(dataset, None)[0]
+
+    def fitMultiple(
+        self, dataset: Any, paramMaps: List[Dict[Param, Any]]
+    ) -> Iterator[Tuple[int, "_TpuModel"]]:
+        if self._enable_fit_multiple_in_single_pass():
+            return _FitMultipleIterator(
+                lambda: self._fit_internal(dataset, paramMaps), len(paramMaps)
+            )
+        return iter(
+            [(i, self.copy(pm)._fit(dataset)) for i, pm in enumerate(paramMaps)]
+        )
+
+    def _fit_internal(
+        self, dataset: Any, paramMaps: Optional[List[Dict[Param, Any]]]
+    ) -> List["_TpuModel"]:
+        results = self._call_tpu_fit_func(dataset, paramMaps)
+        if paramMaps is None:
+            results = [results] if isinstance(results, dict) else list(results)
+            assert len(results) == 1
+        models = []
+        for i, attrs in enumerate(results if isinstance(results, list) else [results]):
+            model = self._create_model(attrs)
+            self._copyValues(model)
+            model._tpu_params.update(self._tpu_params)
+            model._num_workers = self._num_workers
+            model._float32_inputs = self._float32_inputs
+            if paramMaps is not None and i < len(paramMaps):
+                for p, v in paramMaps[i].items():
+                    if model.hasParam(p.name):
+                        # _set_params keeps the Spark param and the solver
+                        # param dict in sync (raw set() would desync them)
+                        model._set_params(**{p.name: v})
+            models.append(model)
+        return models
+
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        return False
+
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        return False
+
+    # -- abstract ----------------------------------------------------------
+    @abstractmethod
+    def _create_model(self, result: Dict[str, Any]) -> "_TpuModel":
+        raise NotImplementedError
+
+    # -- persistence -------------------------------------------------------
+    def write(self) -> "_TpuEstimatorWriter":
+        return _TpuEstimatorWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def read(cls) -> "_TpuEstimatorReader":
+        return _TpuEstimatorReader(cls)
+
+    @classmethod
+    def load(cls, path: str) -> "_TpuEstimator":
+        return cls.read().load(path)
+
+
+class _TpuEstimatorSupervised(_TpuEstimator):
+    """Estimator consuming (features, label[, weight]) (reference
+    _CumlEstimatorSupervised core.py:918-952)."""
+
+
+class _TpuModel(_TpuParams):
+    """Base model/transformer (reference _CumlModel core.py:954-1374)."""
+
+    def __init__(self, **model_attributes: Any) -> None:
+        super().__init__()
+        self._model_attributes = model_attributes
+        self._initialize_tpu_params()
+        self.logger = get_logger(type(self))
+
+    def _get_model_attributes(self) -> Dict[str, Any]:
+        return self._model_attributes
+
+    @property
+    def hasSummary(self) -> bool:
+        return False
+
+    # -- transform ---------------------------------------------------------
+    def transform(self, dataset: Any) -> DataFrame:
+        """Column-appending inference (reference _CumlModelWithColumns._transform
+        core.py:1277-1361): original columns are preserved, output columns
+        named by the *Col params are appended."""
+        df = as_dataframe(dataset)
+        input_col, input_cols = self._get_input_columns()
+        dtype = np.dtype(np.float32) if self._float32_inputs else np.dtype(np.float64)
+        transform_fn = self._get_tpu_transform_func(df)
+        out_parts: List[Optional[pd.DataFrame]] = []
+        out_col_names: Optional[List[str]] = None
+        for part in df.partitions:
+            if len(part) == 0:
+                out_parts.append(None)  # filled once output columns are known
+                continue
+            if input_col is not None:
+                feats = stack_feature_cells(part[input_col].tolist(), dtype)
+            else:
+                feats = np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+            new_part = part.copy()
+            outputs = transform_fn(feats)
+            for name, values in outputs.items():
+                if isinstance(values, np.ndarray) and values.ndim == 2:
+                    new_part[name] = list(values)
+                else:
+                    new_part[name] = values
+            if out_col_names is None:
+                out_col_names = list(outputs.keys())
+            out_parts.append(new_part)
+        # empty partitions get the same output columns (from the first
+        # non-empty partition, falling back to the *Col params) so all
+        # partitions share one schema
+        if out_col_names is None:
+            out_col_names = self._out_columns()
+        filled = []
+        for part, orig in zip(out_parts, df.partitions):
+            if part is None:
+                part = orig.copy()
+                for name in out_col_names:
+                    part[name] = []
+            filled.append(part)
+        return DataFrame(filled)
+
+    def _out_columns(self) -> List[str]:
+        cols = []
+        for p in ("predictionCol", "probabilityCol", "rawPredictionCol", "outputCol"):
+            if self.hasParam(p) and self.isDefined(p):
+                cols.append(self.getOrDefault(p))
+        return cols
+
+    # -- abstract ----------------------------------------------------------
+    @abstractmethod
+    def _get_tpu_transform_func(self, dataset: DataFrame) -> TransformFunc:
+        raise NotImplementedError
+
+    # -- multi-model -------------------------------------------------------
+    @classmethod
+    def _combine(cls, models: List["_TpuModel"]) -> "_TpuModel":
+        raise NotImplementedError
+
+    def _transformEvaluate(self, dataset: Any, evaluator: Any) -> List[float]:
+        raise NotImplementedError
+
+    # -- persistence -------------------------------------------------------
+    def write(self) -> "_TpuModelWriter":
+        return _TpuModelWriter(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def read(cls) -> "_TpuModelReader":
+        return _TpuModelReader(cls)
+
+    @classmethod
+    def load(cls, path: str) -> "_TpuModel":
+        return cls.read().load(path)
+
+
+class _TpuModelWithPredictionCol(_TpuModel):
+    """Model appending a predictionCol (reference core.py:1377-1387)."""
+
+    def setPredictionCol(self, value: str) -> "_TpuModelWithPredictionCol":
+        self._set_params(predictionCol=value)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Persistence (reference core.py:139-226; model attrs as npz instead of the
+# reference's JSON-in-text-file to keep large arrays binary and chunk-free)
+# ---------------------------------------------------------------------------
+
+_METADATA_FILE = "metadata.json"
+_ARRAYS_FILE = "model_arrays.npz"
+_ATTRS_FILE = "model_attrs.json"
+
+
+def _params_metadata(instance: _TpuParams) -> Dict[str, Any]:
+    return {
+        "class": f"{type(instance).__module__}.{type(instance).__name__}",
+        "uid": instance.uid,
+        "paramMap": {p.name: _jsonable(v) for p, v in instance._paramMap.items()},
+        "defaultParamMap": {p.name: _jsonable(v) for p, v in instance._defaultParamMap.items()},
+        "tpu_params": {k: _jsonable(v) for k, v in instance._tpu_params.items()},
+        "num_workers": instance._num_workers,
+        "float32_inputs": instance._float32_inputs,
+        "sparkRapidsMlTpuVersion": _version(),
+    }
+
+
+def _version() -> str:
+    from .version import __version__
+
+    return __version__
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+def _apply_params_metadata(meta: Dict[str, Any], instance: _TpuParams) -> None:
+    for name, value in meta.get("defaultParamMap", {}).items():
+        if instance.hasParam(name):
+            instance._defaultParamMap[instance.getParam(name)] = value
+    for name, value in meta.get("paramMap", {}).items():
+        if instance.hasParam(name):
+            instance.set(instance.getParam(name), value)
+    instance._tpu_params = dict(meta.get("tpu_params", {}))
+    instance._num_workers = meta.get("num_workers")
+    instance._float32_inputs = meta.get("float32_inputs", True)
+    instance.uid = meta.get("uid", instance.uid)
+
+
+def _resolve_class(qualname: str) -> type:
+    import importlib
+
+    module, _, name = qualname.rpartition(".")
+    return getattr(importlib.import_module(module), name)
+
+
+class _TpuEstimatorWriter:
+    def __init__(self, instance: _TpuEstimator):
+        self.instance = instance
+
+    def overwrite(self) -> "_TpuEstimatorWriter":
+        return self
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, _METADATA_FILE), "w") as f:
+            json.dump(_params_metadata(self.instance), f, indent=2)
+
+
+class _TpuEstimatorReader:
+    def __init__(self, cls: type):
+        self.cls = cls
+
+    def load(self, path: str) -> _TpuEstimator:
+        with open(os.path.join(path, _METADATA_FILE)) as f:
+            meta = json.load(f)
+        cls = _resolve_class(meta["class"])
+        est = cls()
+        _apply_params_metadata(meta, est)
+        return est
+
+
+class _TpuModelWriter:
+    def __init__(self, instance: _TpuModel):
+        self.instance = instance
+
+    def overwrite(self) -> "_TpuModelWriter":
+        return self
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, _METADATA_FILE), "w") as f:
+            json.dump(_params_metadata(self.instance), f, indent=2)
+        arrays, attrs = {}, {}
+        for k, v in self.instance._get_model_attributes().items():
+            if isinstance(v, np.ndarray):
+                arrays[k] = v
+            elif isinstance(v, jax.Array):
+                arrays[k] = np.asarray(v)
+            else:
+                attrs[k] = _jsonable(v)
+        np.savez(os.path.join(path, _ARRAYS_FILE), **arrays)
+        with open(os.path.join(path, _ATTRS_FILE), "w") as f:
+            json.dump(attrs, f)
+
+
+class _TpuModelReader:
+    def __init__(self, cls: type):
+        self.cls = cls
+
+    def load(self, path: str) -> _TpuModel:
+        with open(os.path.join(path, _METADATA_FILE)) as f:
+            meta = json.load(f)
+        cls = _resolve_class(meta["class"])
+        with open(os.path.join(path, _ATTRS_FILE)) as f:
+            attrs = json.load(f)
+        npz = np.load(os.path.join(path, _ARRAYS_FILE), allow_pickle=False)
+        for k in npz.files:
+            attrs[k] = npz[k]
+        model = cls(**attrs)
+        _apply_params_metadata(meta, model)
+        return model
+
+
+def load(path: str) -> Union[_TpuEstimator, _TpuModel]:
+    """Load any saved estimator/model, resolving the class from metadata."""
+    with open(os.path.join(path, _METADATA_FILE)) as f:
+        meta = json.load(f)
+    cls = _resolve_class(meta["class"])
+    return cls.load(path)

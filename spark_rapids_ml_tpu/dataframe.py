@@ -1,0 +1,265 @@
+#
+# Partitioned columnar DataFrame facade.
+#
+# The reference rides pyspark DataFrames end to end; its executors see Arrow
+# batches via mapInPandas (/root/reference/python/src/spark_rapids_ml/core.py:558-632).
+# This framework keeps that data model — a DataFrame is an ordered list of
+# column-named row partitions — but owns it natively so the TPU runtime works
+# with or without a Spark cluster: partitions are pandas DataFrames (Arrow
+# interchangeable), and the Spark adapter (spark/ package) converts a real
+# pyspark DataFrame into this facade at the executor boundary.
+#
+# Feature layouts supported everywhere (mirroring the reference tests'
+# vector/array/multi_cols parametrization, python/tests/utils.py:77-117):
+#   - "array":      one column whose cells are fixed-length numpy arrays/lists
+#   - "vector":     alias of "array" (Spark VectorUDT becomes arrays here)
+#   - "multi_cols": D scalar columns
+#
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+
+class Row:
+    """Lightweight attribute/row access wrapper (pyspark.sql.Row stand-in)."""
+
+    __slots__ = ("_data",)
+
+    def __init__(self, data: Dict[str, Any]):
+        object.__setattr__(self, "_data", data)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._data[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def __getitem__(self, key: Union[str, int]) -> Any:
+        if isinstance(key, int):
+            return list(self._data.values())[key]
+        return self._data[key]
+
+    def asDict(self) -> Dict[str, Any]:
+        return dict(self._data)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}={v!r}" for k, v in self._data.items())
+        return f"Row({inner})"
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Row) and self._data == other._data
+
+
+class DataFrame:
+    """An ordered collection of pandas partitions with Spark-flavored methods."""
+
+    def __init__(self, partitions: Sequence[pd.DataFrame]):
+        parts = [p for p in partitions]
+        if not parts:
+            parts = [pd.DataFrame()]
+        cols = list(parts[0].columns)
+        for p in parts[1:]:
+            if list(p.columns) != cols:
+                raise ValueError("All partitions must share the same columns")
+        self._partitions: List[pd.DataFrame] = parts
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_pandas(cls, pdf: pd.DataFrame, num_partitions: int = 1) -> "DataFrame":
+        return cls(_split_pandas(pdf, num_partitions))
+
+    @classmethod
+    def from_arrow(cls, table: Any, num_partitions: int = 1) -> "DataFrame":
+        return cls.from_pandas(table.to_pandas(), num_partitions)
+
+    @classmethod
+    def from_numpy(
+        cls,
+        X: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        feature_layout: str = "array",
+        featuresCol: Union[str, List[str]] = "features",
+        labelCol: str = "label",
+        num_partitions: int = 1,
+        weight: Optional[np.ndarray] = None,
+        weightCol: str = "weight",
+    ) -> "DataFrame":
+        X = np.asarray(X)
+        data: Dict[str, Any] = {}
+        if feature_layout in ("array", "vector"):
+            col = featuresCol if isinstance(featuresCol, str) else featuresCol[0]
+            data[col] = list(X)
+        elif feature_layout == "multi_cols":
+            names = (
+                featuresCol
+                if isinstance(featuresCol, list)
+                else [f"{featuresCol}_{i}" for i in range(X.shape[1])]
+            )
+            for i, name in enumerate(names):
+                data[name] = X[:, i]
+        else:
+            raise ValueError(f"Unknown feature_layout: {feature_layout}")
+        if y is not None:
+            data[labelCol] = np.asarray(y)
+        if weight is not None:
+            data[weightCol] = np.asarray(weight)
+        return cls.from_pandas(pd.DataFrame(data), num_partitions)
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._partitions[0].columns)
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._partitions)
+
+    @property
+    def partitions(self) -> List[pd.DataFrame]:
+        return self._partitions
+
+    @property
+    def dtypes(self) -> List[tuple]:
+        p = self._partitions[0]
+        return [(c, str(p[c].dtype)) for c in p.columns]
+
+    def schema_of(self, col: str) -> str:
+        return str(self._partitions[0][col].dtype)
+
+    def count(self) -> int:
+        return sum(len(p) for p in self._partitions)
+
+    def isEmpty(self) -> bool:
+        return self.count() == 0
+
+    # -- layout ------------------------------------------------------------
+    def repartition(self, n: int) -> "DataFrame":
+        return DataFrame.from_pandas(self.toPandas(), n)
+
+    def coalesce(self, n: int) -> "DataFrame":
+        if n >= len(self._partitions):
+            return self
+        return self.repartition(n)
+
+    # -- relational ops ----------------------------------------------------
+    def select(self, *cols: str) -> "DataFrame":
+        names = list(cols[0]) if len(cols) == 1 and isinstance(cols[0], (list, tuple)) else list(cols)
+        return DataFrame([p[names] for p in self._partitions])
+
+    def drop(self, *cols: str) -> "DataFrame":
+        return DataFrame(
+            [p.drop(columns=[c for c in cols if c in p.columns]) for p in self._partitions]
+        )
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        return DataFrame([p.rename(columns={old: new}) for p in self._partitions])
+
+    def filter(self, predicate: Callable[[pd.DataFrame], pd.Series]) -> "DataFrame":
+        return DataFrame([p[predicate(p)].reset_index(drop=True) for p in self._partitions])
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self._partitions + other._partitions)
+
+    def with_row_id(self, col: str = "unique_id") -> "DataFrame":
+        """Monotonically-increasing globally unique row id (analog of the
+        reference's _ensureIdCol, knn.py:231-258)."""
+        out, offset = [], 0
+        for p in self._partitions:
+            q = p.copy()
+            q[col] = np.arange(offset, offset + len(p), dtype=np.int64)
+            offset += len(p)
+            out.append(q)
+        return DataFrame(out)
+
+    def randomSplit(self, weights: List[float], seed: int = 0) -> List["DataFrame"]:
+        pdf = self.toPandas()
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(len(pdf))
+        total = float(sum(weights))
+        bounds = np.cumsum([w / total for w in weights])[:-1]
+        cut = (bounds * len(pdf)).astype(int)
+        idx_groups = np.split(perm, cut)
+        nparts = max(1, len(self._partitions))
+        return [
+            DataFrame.from_pandas(pdf.iloc[np.sort(g)].reset_index(drop=True), nparts)
+            for g in idx_groups
+        ]
+
+    # -- execution ---------------------------------------------------------
+    def mapInPandas(
+        self, fn: Callable[[Iterable[pd.DataFrame]], Iterable[pd.DataFrame]], schema: Any = None
+    ) -> "DataFrame":
+        """Per-partition transform, same contract as pyspark mapInPandas: fn
+        takes an iterator of batches and yields output batches."""
+        out = []
+        for p in self._partitions:
+            frames = list(fn(iter([p])))
+            out.append(
+                pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
+            )
+        return DataFrame(out)
+
+    def toPandas(self) -> pd.DataFrame:
+        return pd.concat(self._partitions, ignore_index=True)
+
+    def to_arrow(self) -> Any:
+        import pyarrow as pa
+
+        return pa.Table.from_pandas(self.toPandas(), preserve_index=False)
+
+    def collect(self) -> List[Row]:
+        pdf = self.toPandas()
+        return [Row({c: row[c] for c in pdf.columns}) for _, row in pdf.iterrows()]
+
+    def first(self) -> Optional[Row]:
+        for p in self._partitions:
+            if len(p):
+                return Row({c: p.iloc[0][c] for c in p.columns})
+        return None
+
+    def cache(self) -> "DataFrame":
+        return self
+
+    def unpersist(self) -> "DataFrame":
+        return self
+
+    def __repr__(self) -> str:
+        return f"DataFrame[{', '.join(self.columns)}] ({self.num_partitions} partitions)"
+
+
+def _split_pandas(pdf: pd.DataFrame, n: int) -> List[pd.DataFrame]:
+    n = max(1, n)
+    if len(pdf) == 0:
+        return [pdf]
+    idx = np.array_split(np.arange(len(pdf)), n)
+    return [pdf.iloc[ix].reset_index(drop=True) for ix in idx]
+
+
+def as_dataframe(dataset: Any, num_partitions: Optional[int] = None) -> DataFrame:
+    """Coerce any supported input (our DataFrame, pandas, arrow Table, numpy
+    (X,)| (X, y) tuple, or a live pyspark DataFrame) into the facade."""
+    if isinstance(dataset, DataFrame):
+        return dataset
+    if isinstance(dataset, pd.DataFrame):
+        return DataFrame.from_pandas(dataset, num_partitions or 1)
+    try:
+        import pyarrow as pa
+
+        if isinstance(dataset, pa.Table):
+            return DataFrame.from_arrow(dataset, num_partitions or 1)
+    except ImportError:
+        pass
+    try:
+        import pyspark
+
+        if isinstance(dataset, pyspark.sql.DataFrame):
+            from .spark.adapter import spark_to_facade
+
+            return spark_to_facade(dataset)
+    except ImportError:
+        pass
+    raise TypeError(f"Unsupported dataset type: {type(dataset)}")

@@ -1,0 +1,11 @@
+from .mesh import default_num_workers, get_mesh, shard_rows
+from .partition import PartitionDescriptor
+from .context import TpuContext
+
+__all__ = [
+    "default_num_workers",
+    "get_mesh",
+    "shard_rows",
+    "PartitionDescriptor",
+    "TpuContext",
+]

@@ -1,0 +1,141 @@
+#
+# Distributed-runtime lifecycle management.
+#
+# TPU-native replacement for the reference's CumlContext
+# (/root/reference/python/src/spark_rapids_ml/common/cuml_context.py:35-192),
+# which creates a raft Handle, has rank 0 mint an NCCL uid, spreads it via
+# BarrierTaskContext.allGather, and injects NCCL/UCX comms.  Here the same
+# three-phase shape holds, but the data plane is jax.distributed + XLA
+# collectives over ICI/DCN:
+#
+#   1. rank 0 picks a coordinator address (host:port) — analog of the NCCL uid
+#   2. the address is allGathered over the *control plane* (Spark barrier RPC
+#      in the Spark adapter; trivial in single-controller local mode)
+#   3. every rank calls jax.distributed.initialize(coordinator, nranks, rank);
+#      afterwards jax.devices() spans the pod and a global Mesh is built, so
+#      psum/all_gather/ppermute ride ICI within a host and DCN across hosts.
+#
+# __exit__ tears down jax.distributed the way CumlContext.__exit__ destroys or
+# aborts the NCCL comm (cuml_context.py:149-166).
+#
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, List, Optional, Protocol
+
+import jax
+
+from ..utils import get_logger
+
+
+class ControlPlane(Protocol):
+    """Minimal control-plane contract: Spark's BarrierTaskContext satisfies it
+    (allGather of strings + barrier), as does the local trivial impl."""
+
+    def allGather(self, message: str) -> List[str]: ...
+
+    def barrier(self) -> None: ...
+
+
+class LocalControlPlane:
+    """Single-controller control plane: one process drives the whole mesh, so
+    gather/barrier are identities."""
+
+    def allGather(self, message: str) -> List[str]:
+        return [message]
+
+    def barrier(self) -> None:
+        return None
+
+
+def _local_ip() -> str:
+    """Routable local IP: a UDP connect() selects the egress interface without
+    sending packets, avoiding /etc/hosts entries that pin the hostname to
+    127.0.x.1 (common on Debian TPU-VMs)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def _free_port() -> int:
+    # NOTE: inherently racy (jax.distributed.initialize rebinds the port after
+    # we release it) — the coordinator retries are jax's own; picking from the
+    # kernel ephemeral range keeps collisions rare.
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class TpuContext:
+    """Context manager bootstrapping the distributed jax runtime for one fit.
+
+    In single-controller mode (nranks == 1 processes) this is a cheap no-op
+    that exposes the local device mesh.  In multi-controller mode (one process
+    per Spark barrier task / TPU-VM worker) it initializes jax.distributed
+    with a coordinator address exchanged over the control plane, mirroring the
+    NCCL-uid handshake of the reference (cuml_context.py:75-103).
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        nranks: int,
+        control_plane: Optional[ControlPlane] = None,
+        require_dcn: bool = False,
+    ):
+        self._rank = rank
+        self._nranks = nranks
+        self._cp = control_plane or LocalControlPlane()
+        self._require_dcn = require_dcn
+        self._initialized_distributed = False
+        self._logger = get_logger(type(self))
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def nranks(self) -> int:
+        return self._nranks
+
+    def __enter__(self) -> "TpuContext":
+        if self._nranks > 1:
+            # rank 0 advertises coordinator host:port; everyone gathers it.
+            if self._rank == 0:
+                addr = f"{_local_ip()}:{_free_port()}"
+            else:
+                addr = ""
+            gathered = self._cp.allGather(json.dumps({"rank": self._rank, "addr": addr}))
+            coordinator = ""
+            for msg in gathered:
+                info = json.loads(msg)
+                if info["rank"] == 0:
+                    coordinator = info["addr"]
+            assert coordinator, "rank 0 coordinator address missing from allGather"
+            self._logger.info(
+                "rank %d/%d connecting to coordinator %s",
+                self._rank, self._nranks, coordinator,
+            )
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=self._nranks,
+                process_id=self._rank,
+            )
+            self._initialized_distributed = True
+        return self
+
+    def __exit__(self, exc_type: Any, exc_val: Any, exc_tb: Any) -> None:
+        if self._initialized_distributed:
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 - mirror nccl abort-on-error path
+                if exc_type is None:
+                    raise
+        return None

@@ -1,0 +1,74 @@
+#
+# Device-mesh construction and row-sharded ingest.
+#
+# TPU-native replacement for the reference's GPU binding + cuDF ingest
+# (/root/reference/python/src/spark_rapids_ml/core.py:233-259 device binding,
+# :558-632 Arrow->cupy ingest).  Instead of "1 Spark task = 1 GPU = 1 NCCL
+# rank", the unit of parallelism is a jax.sharding.Mesh over all addressable
+# devices: within one host the mesh rides ICI; across hosts jax.distributed +
+# DCN extends the same mesh (see parallel/context.py).  Data parallelism is
+# expressed by sharding the row axis with NamedSharding(P("data")) and letting
+# GSPMD insert psum/all_gather collectives during compilation.
+#
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def default_num_workers() -> int:
+    """One logical worker per addressable device (chips on this host, or the
+    whole pod under jax.distributed)."""
+    return jax.device_count()
+
+
+def get_mesh(num_workers: Optional[int] = None) -> Mesh:
+    """1-D data-parallel mesh over the first `num_workers` devices."""
+    devices = jax.devices()
+    n = num_workers or len(devices)
+    n = min(n, len(devices))
+    return Mesh(np.array(devices[:n]), (DATA_AXIS,))
+
+
+def get_2d_mesh(num_data: int, num_model: int) -> Mesh:
+    """(data, model) mesh for feature-axis sharding of very wide problems
+    (e.g. X^T X when n_cols is huge) — the GSPMD generalization noted in
+    SURVEY.md §2.4."""
+    devices = np.array(jax.devices()[: num_data * num_model]).reshape(
+        num_data, num_model
+    )
+    return Mesh(devices, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_rows(
+    arr: np.ndarray, mesh: Mesh, dtype: Optional[np.dtype] = None
+) -> Tuple[jax.Array, int]:
+    """Zero-pad rows to a multiple of the data-axis size and device_put with a
+    row sharding.  Returns (sharded_array, n_valid_rows).  Padded rows must be
+    masked by callers via the weight vector produced in core ingest."""
+    from ..utils import pad_rows
+
+    if dtype is not None:
+        arr = np.asarray(arr, dtype=dtype)
+    n_valid = arr.shape[0]
+    n_shards = mesh.shape[DATA_AXIS]
+    padded = pad_rows(arr, n_shards)
+    sharded = jax.device_put(padded, data_sharding(mesh))
+    return sharded, n_valid

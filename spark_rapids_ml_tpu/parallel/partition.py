@@ -1,0 +1,33 @@
+#
+# Partition metadata shared by all ranks before a distributed fit.
+#
+# Behavioral analog of the reference's PartitionDescriptor
+# (/root/reference/python/src/spark_rapids_ml/utils.py:133-196), which
+# allGathers per-rank partition sizes over the Spark barrier control plane.
+# In the TPU build the "ranks" are mesh shards; sizes are known locally in
+# single-controller mode and allGathered over the runner's control plane in
+# multi-controller mode (see runtime/spark adapter).
+#
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class PartitionDescriptor:
+    """m: total rows, n: cols, rank: this worker, parts_rank_size: flat list of
+    (rank, size) for every partition in rank order."""
+
+    m: int
+    n: int
+    rank: int
+    parts_rank_size: List[tuple] = field(default_factory=list)
+
+    @classmethod
+    def build(cls, partition_rows: List[int], total_cols: int, rank: int = 0) -> "PartitionDescriptor":
+        parts = [(r, size) for r, size in enumerate(partition_rows)]
+        return cls(
+            m=sum(partition_rows), n=total_cols, rank=rank, parts_rank_size=parts
+        )

@@ -1,0 +1,95 @@
+#
+# Shared utilities: logging, dtype mapping, array layout helpers.
+#
+# Functional counterpart of the reference's utils
+# (/root/reference/python/src/spark_rapids_ml/utils.py): get_logger (:250),
+# dtype mapping (:233), memory-careful concat (:199).  GPU-id discovery
+# (:98-130) has no TPU analog — device binding is the jax mesh's job
+# (see parallel/mesh.py).
+#
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Any, Iterator, List, Optional, Union
+
+import numpy as np
+
+
+def get_logger(cls: Union[type, str], level: int = logging.INFO) -> logging.Logger:
+    """Per-class stderr logger with a standard format (reference utils.py:250-267)."""
+    name = cls if isinstance(cls, str) else f"spark_rapids_ml_tpu.{cls.__name__}"
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger
+
+
+def dtype_to_pyspark_type(dtype: Union[np.dtype, str]) -> str:
+    """numpy dtype -> Spark SQL type name (reference utils.py:233-247)."""
+    dtype = np.dtype(dtype)
+    if dtype == np.float32:
+        return "float"
+    if dtype == np.float64:
+        return "double"
+    if dtype == np.int32:
+        return "int"
+    if dtype == np.int64:
+        return "long"
+    if dtype == np.int16:
+        return "short"
+    raise RuntimeError(f"Unsupported dtype: {dtype}")
+
+
+def _concat_and_free(array_list: List[np.ndarray], order: str = "F") -> np.ndarray:
+    """Concatenate row chunks while freeing inputs incrementally to bound peak
+    host memory (behavioral analog of reference utils.py:199-221)."""
+    if len(array_list) == 1:
+        arr = array_list.pop()
+        return np.asarray(arr, order=order)  # type: ignore[call-overload]
+    rows = sum(a.shape[0] for a in array_list)
+    if array_list[0].ndim == 1:
+        out = np.empty((rows,), dtype=array_list[0].dtype)
+    else:
+        out = np.empty((rows, array_list[0].shape[1]), dtype=array_list[0].dtype, order=order)  # type: ignore[call-overload]
+    offset = 0
+    while array_list:
+        a = array_list.pop(0)
+        out[offset : offset + a.shape[0]] = a
+        offset += a.shape[0]
+        del a
+    return out
+
+
+def stack_feature_cells(cells: Any, dtype: np.dtype) -> np.ndarray:
+    """Column of array-like cells (Spark Vector / array<float> layout) -> 2-D array."""
+    try:
+        out = np.stack(cells)
+    except ValueError as e:
+        raise ValueError(
+            "feature column cells must all be arrays of the same length"
+        ) from e
+    return np.asarray(out, dtype=dtype)
+
+
+def pad_rows(arr: np.ndarray, multiple: int) -> np.ndarray:
+    """Zero-pad rows so arr.shape[0] is a multiple of `multiple` (static shapes
+    for XLA; padded rows are masked by zero weights downstream)."""
+    n = arr.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr
+    pad_shape = (rem,) + arr.shape[1:]
+    return np.concatenate([arr, np.zeros(pad_shape, dtype=arr.dtype)], axis=0)
+
+
+def chunk_iter(n: int, chunk: int) -> Iterator[slice]:
+    for start in range(0, n, chunk):
+        yield slice(start, min(start + chunk, n))

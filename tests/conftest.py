@@ -1,0 +1,51 @@
+# Tests run on a virtual 8-device CPU mesh so multi-chip sharding is exercised
+# without TPU hardware (the role Spark local[N] + N GPUs plays in the
+# reference, /root/reference/python/tests/conftest.py:44-70).
+#
+# Some TPU PJRT plugin environments (e.g. axon) import jax and register their
+# backend from sitecustomize before any user code runs, so env vars alone are
+# too late: we must flip the already-imported jax config to cpu and inject the
+# host-device-count flag before the first backend initialization.  Set
+# SRML_TPU_TESTS=1 to run the suite on real TPU devices instead.
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("SRML_TPU_TESTS") != "1":
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False, help="run slow tests"
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: mark test as slow to run")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip_slow = pytest.mark.skip(reason="need --runslow option to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)
+
+
+@pytest.fixture
+def n_devices():
+    import jax
+
+    return jax.device_count()
