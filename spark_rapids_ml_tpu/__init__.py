@@ -51,5 +51,10 @@ def __getattr__(name):  # lazy re-exports keep `import spark_rapids_ml_tpu` ligh
         "CrossValidator": ".tuning",
     }
     if name in _locations:
-        return getattr(import_module(_locations[name], __name__), name)
+        try:
+            return getattr(import_module(_locations[name], __name__), name)
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r} ({e})"
+            ) from e
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

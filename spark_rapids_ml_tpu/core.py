@@ -71,10 +71,25 @@ TransformFunc = Callable[[np.ndarray], Dict[str, Any]]
 class _TpuCaller(_TpuParams):
     """Shared ingest + fit-dispatch (reference _CumlCaller core.py:327-647)."""
 
-    def _use_dtype(self, df: DataFrame, col: Optional[str]) -> np.dtype:
+    def _use_dtype(
+        self, df: DataFrame, input_col: Optional[str], input_cols: Optional[List[str]]
+    ) -> np.dtype:
         if self._float32_inputs:
             return np.dtype(np.float32)
-        # preserve f64 when float32_inputs disabled (reference core.py:363-401)
+        # float32_inputs=False preserves the input dtype (reference
+        # core.py:363-401 keeps f64 data in f64 and f32 in f32)
+        for part in df.partitions:
+            if len(part) == 0:
+                continue
+            if input_col is not None:
+                cell = np.asarray(part[input_col].iloc[0])
+                dt = cell.dtype
+            else:
+                assert input_cols is not None
+                dt = np.result_type(*(part[c].dtype for c in input_cols))
+            if np.issubdtype(dt, np.floating):
+                return np.dtype(dt)
+            break
         return np.dtype(np.float64)
 
     def _extract_partition_features(
@@ -94,7 +109,7 @@ class _TpuCaller(_TpuParams):
         """Per-partition (features, label, weight) numpy extraction with dtype
         casting (reference core.py:344-422 + supervised label cast :918-952)."""
         input_col, input_cols = self._get_input_columns()
-        dtype = self._use_dtype(df, input_col)
+        dtype = self._use_dtype(df, input_col, input_cols)
         feats, labels, weights = [], None, None
         label_col = (
             self.getOrDefault("labelCol")
@@ -348,7 +363,7 @@ class _TpuModel(_TpuParams):
         named by the *Col params are appended."""
         df = as_dataframe(dataset)
         input_col, input_cols = self._get_input_columns()
-        dtype = np.dtype(np.float32) if self._float32_inputs else np.dtype(np.float64)
+        dtype = self._transform_dtype(self._model_attributes.get("dtype"))
         transform_fn = self._get_tpu_transform_func(df)
         out_parts: List[Optional[pd.DataFrame]] = []
         out_col_names: Optional[List[str]] = None

@@ -1,0 +1,259 @@
+#
+# KMeans estimator/model.
+#
+# Capability parity with the reference's KMeans/KMeansModel
+# (/root/reference/python/src/spark_rapids_ml/clustering.py:59-466): same
+# Spark param mapping (clustering.py:61-82), same solver defaults
+# (clustering.py:84-95), same model attributes (cluster_centers_, n_cols,
+# dtype) and int prediction output (clustering.py:430-433).  The solver is
+# the TPU-native shard_map Lloyd kernel in ops/kmeans.py instead of cuML
+# KMeansMG over NCCL.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+import jax
+
+from ..core import FitInputs, _TpuEstimator, _TpuModelWithPredictionCol
+from ..dataframe import DataFrame
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+    HasTol,
+    HasVerbose,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+    _dummy,
+    _TpuParams,
+)
+from ..ops.kmeans import (
+    kmeans_predict_kernel,
+    lloyd_iterations,
+    random_init,
+    scalable_kmeans_pp_init,
+)
+from ..utils import get_logger
+
+
+class KMeansClass(_TpuParams):
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # mirrors clustering.py:61-82: distanceMeasure/weightCol unsupported,
+        # initSteps/solver/maxBlockSizeInMB silently ignored
+        return {
+            "distanceMeasure": None,
+            "initMode": "init",
+            "k": "n_clusters",
+            "initSteps": "",
+            "maxIter": "max_iter",
+            "seed": "random_state",
+            "tol": "tol",
+            "weightCol": None,
+            "solver": "",
+            "maxBlockSizeInMB": "",
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        return {
+            "init": lambda v: {
+                "k-means||": "scalable-k-means++",
+                "random": "random",
+                "scalable-k-means++": "scalable-k-means++",
+            }.get(v)
+        }
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_clusters": 8,
+            "max_iter": 300,
+            "tol": 0.0001,
+            "verbose": False,
+            "random_state": 1,
+            "init": "scalable-k-means++",
+            "n_init": 1,
+            "oversampling_factor": 2.0,
+            "max_samples_per_batch": 32768,
+        }
+
+
+class _KMeansParams(
+    KMeansClass,
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasPredictionCol,
+    HasMaxIter,
+    HasTol,
+    HasSeed,
+    HasWeightCol,
+    HasVerbose,
+):
+    k = Param(_dummy(), "k", "The number of clusters to create. Must be > 1.", TypeConverters.toInt)
+    initMode = Param(
+        _dummy(),
+        "initMode",
+        'The initialization algorithm. Supported options: "random" and "k-means||".',
+        TypeConverters.toString,
+    )
+    initSteps = Param(
+        _dummy(), "initSteps", "The number of steps for k-means|| initialization mode. Must be > 0.", TypeConverters.toInt
+    )
+    distanceMeasure = Param(
+        _dummy(), "distanceMeasure", "the distance measure", TypeConverters.toString
+    )
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._setDefault(
+            k=2, initMode="k-means||", initSteps=2, maxIter=20, tol=0.0001
+        )
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def setK(self, value: int):
+        return self._set_params(k=value)
+
+    def setInitMode(self, value: str):
+        return self._set_params(initMode=value)
+
+    def setMaxIter(self, value: int):
+        return self._set_params(maxIter=value)
+
+    def setTol(self, value: float):
+        return self._set_params(tol=value)
+
+    def setSeed(self, value: int):
+        return self._set_params(seed=value)
+
+    def setWeightCol(self, value: str):
+        # parity with clustering.py setWeightCol: unsupported
+        raise ValueError("'weightCol' is not supported.")
+
+
+class KMeans(_KMeansParams, _TpuEstimator):
+    """Distributed KMeans on a TPU mesh (Lloyd + k-means|| init), API-parity
+    with the reference KMeans (clustering.py:146-308)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def _get_tpu_fit_func(self, dataset: DataFrame, extra_params=None):
+        logger = get_logger(type(self))
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]):
+            k = int(params["n_clusters"])
+            seed = int(params["random_state"]) & 0x7FFFFFFF
+            chunk = min(int(params["max_samples_per_batch"]), inputs.X.shape[0])
+            if params["init"] == "random":
+                centers0 = random_init(inputs.X, inputs.weight, k, seed)
+            else:
+                oversample = float(params["oversampling_factor"])
+                round_size = max(1, min(int(oversample * k), inputs.n_rows))
+                centers0 = scalable_kmeans_pp_init(
+                    inputs.X,
+                    inputs.weight,
+                    k,
+                    seed,
+                    oversample,
+                    rounds=4,
+                    round_size=round_size,
+                )
+            centers, n_iter, inertia = lloyd_iterations(
+                inputs.X,
+                inputs.weight,
+                centers0,
+                inputs.mesh,
+                int(params["max_iter"]),
+                float(params["tol"]),
+                chunk,
+            )
+            logger.info(
+                "iterations: %d, inertia: %f", int(n_iter), float(inertia)
+            )
+            return {
+                "cluster_centers_": np.asarray(centers, dtype=np.float64),
+                "n_cols": inputs.n_cols,
+                "dtype": str(inputs.dtype),
+                "n_iter_": int(n_iter),
+                "inertia_": float(inertia),
+            }
+
+        return _fit
+
+    def _create_model(self, result: Dict[str, Any]) -> "KMeansModel":
+        return KMeansModel(**result)
+
+
+class KMeansModel(_KMeansParams, _TpuModelWithPredictionCol):
+    def __init__(
+        self,
+        cluster_centers_: np.ndarray,
+        n_cols: int,
+        dtype: str,
+        n_iter_: int = 0,
+        inertia_: float = 0.0,
+    ) -> None:
+        super().__init__(
+            cluster_centers_=np.asarray(cluster_centers_),
+            n_cols=int(n_cols),
+            dtype=str(dtype),
+            n_iter_=int(n_iter_),
+            inertia_=float(inertia_),
+        )
+        self.cluster_centers_ = np.asarray(cluster_centers_)
+        self.n_cols = int(n_cols)
+        self.dtype = str(dtype)
+        self.n_iter_ = int(n_iter_)
+        self.inertia_ = float(inertia_)
+
+    def clusterCenters(self) -> List[np.ndarray]:
+        """Parity with Spark KMeansModel.clusterCenters (clustering.py:385-391)."""
+        return list(self.cluster_centers_)
+
+    @property
+    def hasSummary(self) -> bool:
+        return False
+
+    def predict(self, value: np.ndarray) -> int:
+        """Single-vector prediction (Spark API parity); same dtype policy as
+        transform() so the two paths agree on borderline points."""
+        np_dtype = self._transform_dtype(self.dtype)
+        arr = np.asarray(value, dtype=np_dtype)[None, :]
+        return int(
+            np.asarray(
+                kmeans_predict_kernel(
+                    jax.numpy.asarray(arr),
+                    jax.numpy.asarray(self.cluster_centers_.astype(np_dtype)),
+                )
+            )[0]
+        )
+
+    def cpu(self):
+        """pyspark.ml KMeansModel (parity hook for clustering.py:393-435)."""
+        from ..spark.interop import to_spark_kmeans_model
+
+        return to_spark_kmeans_model(self)
+
+    def _get_tpu_transform_func(self, dataset: DataFrame):
+        np_dtype = self._transform_dtype(self.dtype)
+        centers = jax.device_put(np.asarray(self.cluster_centers_, dtype=np_dtype))
+        pred_col = self.getOrDefault("predictionCol")
+        predict = jax.jit(kmeans_predict_kernel)
+
+        def _transform(features: np.ndarray) -> Dict[str, Any]:
+            labels = predict(jax.device_put(np.asarray(features, dtype=np_dtype)), centers)
+            return {pred_col: np.asarray(labels)}
+
+        return _transform

@@ -1,0 +1,93 @@
+#
+# Distributed linear-algebra building blocks (pure jax, mesh-aware).
+#
+# TPU-native replacement for cuML's PCAMG / tall-skinny covariance kernels
+# (used by the reference at feature.py:217-238) and for the raft eigDC +
+# sign-flip pipeline of the legacy JNI path (rapidsml_jni.cu:215-269).  All
+# functions take row-sharded global arrays; jnp matmuls over the sharded row
+# axis compile to per-shard partial products + psum over ICI/DCN (GSPMD), so
+# no explicit collectives appear here.
+#
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sign_flip(components: jax.Array) -> jax.Array:
+    """Deterministic eigenvector signs: flip each row so its largest-|.|
+    element is positive (semantics of the reference's thrust signFlip kernel,
+    rapidsml_jni.cu:35-61, and cuML MG PCA)."""
+    idx = jnp.argmax(jnp.abs(components), axis=1)
+    picked = jnp.take_along_axis(components, idx[:, None], axis=1)
+    return components * jnp.sign(picked)
+
+
+def weighted_moments(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (wsum, mean, scatter) where scatter = sum_i w_i x_i x_i^T.
+
+    X: (N, D) row-sharded, w: (N,) row-sharded (0 for padded rows).  The
+    contraction over the sharded axis becomes a psum inserted by XLA.
+    """
+    wsum = w.sum()
+    mean = (X * w[:, None]).sum(axis=0) / wsum
+    scatter = (X * w[:, None]).T @ X
+    return wsum, mean, scatter
+
+
+@partial(jax.jit, static_argnames=("k", "whiten"))
+def pca_fit_kernel(
+    X: jax.Array, w: jax.Array, k: int, whiten: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Distributed PCA via covariance + eigh.
+
+    Math (not a port): cov = (sum w x x^T - n·mean mean^T) / (n - 1) with the
+    row-sharded scatter psum'd by GSPMD; eigh runs replicated on the (D, D)
+    covariance; top-k eigenpairs in descending order; singular values follow
+    sigma_j = sqrt(lambda_j (n-1)).  Matches the observable behavior of cuML
+    PCAMG as used by the reference (feature.py:217-238) incl. deterministic
+    component signs.
+
+    Returns (mean, components[k,D], explained_variance[k], explained_variance_ratio[k],
+    singular_values[k]).
+    """
+    wsum, mean, scatter = weighted_moments(X, w)
+    cov = (scatter - wsum * jnp.outer(mean, mean)) / (wsum - 1.0)
+    cov = (cov + cov.T) * 0.5
+    evals, evecs = jnp.linalg.eigh(cov)  # ascending
+    evals = evals[::-1]
+    evecs = evecs[:, ::-1]
+    top_vals = evals[:k]
+    components = sign_flip(evecs[:, :k].T)
+    total_var = jnp.maximum(evals.sum(), jnp.finfo(evals.dtype).tiny)
+    ratio = top_vals / total_var
+    singular_values = jnp.sqrt(jnp.maximum(top_vals, 0.0) * (wsum - 1.0))
+    return mean, components, top_vals, ratio, singular_values
+
+
+@jax.jit
+def pca_transform_kernel(X: jax.Array, components: jax.Array) -> jax.Array:
+    """Spark-parity projection: X @ PC^T *without* mean removal (Spark does not
+    center at transform time; the reference adds the transformed mean back to
+    cuML's centered output to match, feature.py:419-431 — we simply never
+    subtract it)."""
+    return X @ components.T
+
+
+def gram_and_xty(
+    X: jax.Array, y: jax.Array, w: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Weighted normal-equation statistics in one fused pass:
+    returns (wsum, x_mean, y_mean, XtWX, XtWy) — inputs row-sharded, outputs
+    replicated (psum'd)."""
+    wsum = w.sum()
+    Xw = X * w[:, None]
+    x_mean = Xw.sum(axis=0) / wsum
+    y_mean = (y * w).sum() / wsum
+    XtWX = Xw.T @ X
+    XtWy = Xw.T @ y
+    return wsum, x_mean, y_mean, XtWX, XtWy

@@ -611,6 +611,15 @@ class _TpuParams(_TpuClass, Params):
         if solver:
             self._set_tpu_value(solver, self.getOrDefault(spark_name))
 
+    def _transform_dtype(self, model_dtype: Optional[str] = None):
+        """Single source of truth for the inference dtype: float32 when
+        float32_inputs (the default), else the dtype recorded at fit time."""
+        import numpy as np
+
+        if self._float32_inputs:
+            return np.dtype(np.float32)
+        return np.dtype(model_dtype or np.float64)
+
     # ------------------------------------------------------------------
     def _get_input_columns(self) -> tuple:
         """Returns (featuresCol-or-None, featuresCols-or-None); mirrors

@@ -1,0 +1,84 @@
+#
+# pyspark DataFrame -> facade conversion, and the Spark barrier-mode runner.
+#
+# This is the layer that lets the framework ride a real Spark cluster the way
+# the reference does (core.py:488-640): the driver repartitions to
+# num_workers, ships a barrier-mode mapInPandas UDF, each barrier task (= one
+# TPU-VM worker) bootstraps jax.distributed via TpuContext (coordinator
+# address allGathered exactly like the reference's NCCL uid,
+# cuml_context.py:75-103) and runs the same pure-jax fit function over the
+# pod-wide mesh.  Import-gated: everything here requires pyspark.
+#
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+import pandas as pd
+
+
+def spark_to_facade(sdf: Any) -> Any:
+    """Collect a pyspark DataFrame into the local partitioned facade.
+
+    Used for driver-local execution (e.g. notebooks on a single TPU-VM).  For
+    cluster execution use BarrierFitRunner, which never collects to the
+    driver."""
+    from ..dataframe import DataFrame
+
+    n_parts = max(1, sdf.rdd.getNumPartitions())
+    return DataFrame.from_pandas(sdf.toPandas(), num_partitions=n_parts)
+
+
+class SparkBarrierControlPlane:
+    """ControlPlane backed by pyspark BarrierTaskContext (the reference's
+    control plane for the NCCL uid handshake, cuml_context.py:75-103)."""
+
+    def __init__(self, barrier_ctx: Any):
+        self._ctx = barrier_ctx
+
+    def allGather(self, message: str) -> List[str]:
+        return self._ctx.allGather(message)
+
+    def barrier(self) -> None:
+        self._ctx.barrier()
+
+
+def run_barrier_fit(
+    sdf: Any,
+    num_workers: int,
+    fit_closure: Callable[[List[pd.DataFrame], int, int, Any], List[Dict[str, Any]]],
+) -> List[Dict[str, Any]]:
+    """Dispatch `fit_closure` over a Spark barrier stage, one task per TPU-VM
+    worker process.
+
+    fit_closure(partitions, rank, nranks, control_plane) runs on the executor;
+    rank 0 returns the model-attribute rows.  Mirrors the dispatch shape of
+    the reference's _call_cuml_fit_func (core.py:488-640) with jax.distributed
+    replacing NCCL.
+    """
+    import json
+
+    from pyspark import BarrierTaskContext
+
+    sdf = sdf.repartition(num_workers)
+    fields = sdf.schema.fieldNames()
+
+    def _train_udf(iterator):
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        cp = SparkBarrierControlPlane(ctx)
+        parts = [pdf for pdf in iterator]
+        results = fit_closure(parts, rank, num_workers, cp)
+        ctx.barrier()
+        if rank == 0:
+            for attrs in results:
+                yield pd.DataFrame({"model_attributes": [json.dumps(attrs)]})
+
+    rdd = (
+        sdf.mapInPandas(_train_udf, schema="model_attributes string")
+        .rdd.barrier()
+        .mapPartitions(lambda x: x)
+    )
+    rows = rdd.collect()
+    return [json.loads(r["model_attributes"]) for r in rows]

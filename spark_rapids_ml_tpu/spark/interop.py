@@ -1,0 +1,93 @@
+#
+# CPU-model interop: convert fitted TPU models into genuine pyspark.ml models
+# (the reference's `cpu()` methods, e.g. PCAModel.cpu feature.py:362-376,
+# KMeansModel.cpu clustering.py:393, LinearRegressionModel.cpu
+# regression.py:650).  Requires pyspark + an active SparkSession; every entry
+# point degrades with a clear error when pyspark is absent.
+#
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def _require_pyspark() -> Any:
+    try:
+        import pyspark  # noqa: F401
+
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "cpu() interop requires pyspark; install pyspark to convert TPU "
+            "models into pyspark.ml models."
+        ) from e
+
+
+def _active_session():
+    from pyspark.sql import SparkSession
+
+    spark = SparkSession.getActiveSession()
+    if spark is None:
+        raise RuntimeError("cpu() requires an active SparkSession")
+    return spark
+
+
+def _java_uid(sc: Any, prefix: str) -> Any:
+    return sc._jvm.org.apache.spark.ml.util.Identifiable.randomUID(prefix)
+
+
+def to_spark_pca_model(model: Any):
+    """TPU PCAModel -> pyspark.ml.feature.PCAModel via py4j construction."""
+    _require_pyspark()
+    from pyspark.ml.common import _py2java
+    from pyspark.ml.feature import PCAModel as SparkPCAModel
+    from pyspark.ml.linalg import DenseMatrix, DenseVector
+
+    spark = _active_session()
+    sc = spark.sparkContext
+    k = len(model.components_)
+    n = model.n_cols
+    # DenseMatrix is column-major; components rows become matrix columns
+    pc = DenseMatrix(n, k, model.components_.flatten().tolist(), False)
+    ev = DenseVector(model.explained_variance_ratio_.tolist())
+    java_model = sc._jvm.org.apache.spark.ml.feature.PCAModel(
+        _java_uid(sc, "pca"), _py2java(sc, pc), _py2java(sc, ev)
+    )
+    return SparkPCAModel(java_model)
+
+
+def to_spark_kmeans_model(model: Any):
+    """TPU KMeansModel -> pyspark.ml.clustering.KMeansModel (parity with
+    clustering.py:393-435)."""
+    _require_pyspark()
+    from pyspark.ml.clustering import KMeansModel as SparkKMeansModel
+    from pyspark.ml.common import _py2java
+    from pyspark.ml.linalg import DenseVector
+
+    spark = _active_session()
+    sc = spark.sparkContext
+    java_centers = sc._jvm.java.util.ArrayList()
+    for center in model.cluster_centers_:
+        java_centers.add(_py2java(sc, DenseVector(list(center))))
+    java_model = sc._jvm.org.apache.spark.ml.clustering.KMeansModel(
+        _java_uid(sc, "kmeans"),
+        sc._jvm.org.apache.spark.mllib.clustering.KMeansModel(java_centers),
+    )
+    return SparkKMeansModel(java_model)
+
+
+def to_spark_linear_model(model: Any):
+    """TPU LinearRegressionModel -> pyspark.ml.regression.LinearRegressionModel
+    (parity with regression.py:650-668)."""
+    _require_pyspark()
+    from pyspark.ml.common import _py2java
+    from pyspark.ml.linalg import DenseVector
+    from pyspark.ml.regression import LinearRegressionModel as SparkLRModel
+
+    spark = _active_session()
+    sc = spark.sparkContext
+    coef = _py2java(sc, DenseVector(model.coef_.tolist()))
+    java_model = sc._jvm.org.apache.spark.ml.regression.LinearRegressionModel(
+        _java_uid(sc, "linReg"), coef, float(model.intercept_), float(1.0)
+    )
+    return SparkLRModel(java_model)

@@ -1,0 +1,123 @@
+# PCA correctness vs sklearn + param/persistence parity (modeled on the
+# reference's test_pca.py strategy: default-param parity, small
+# hand-checkable correctness, layouts, persistence).
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import PCA, PCAModel
+from spark_rapids_ml_tpu.core import load
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+
+def _data(n=500, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    # low-rank + noise so components are well separated
+    basis = rng.normal(size=(3, d))
+    X = rng.normal(size=(n, 3)) @ basis + 0.01 * rng.normal(size=(n, d))
+    return X.astype(np.float64)
+
+
+def test_default_params():
+    pca = PCA()
+    assert pca.tpu_params["n_components"] is None
+    assert pca.tpu_params["whiten"] is False
+    pca = PCA(k=3)
+    assert pca.getK() == 3
+    assert pca.tpu_params["n_components"] == 3
+    pca = PCA(n_components=4)
+    assert pca.getOrDefault("k") == 4
+
+
+def test_pca_basic_vs_sklearn():
+    from sklearn.decomposition import PCA as SkPCA
+
+    X = _data()
+    df = DataFrame.from_numpy(X, num_partitions=4)
+    model = PCA(k=3).fit(df)
+    sk = SkPCA(n_components=3, svd_solver="full").fit(X)
+
+    # compare up to sign via abs (sign handled separately below)
+    np.testing.assert_allclose(model.mean_, X.mean(axis=0), atol=1e-4)
+    np.testing.assert_allclose(
+        np.abs(model.components_), np.abs(sk.components_), atol=1e-3
+    )
+    np.testing.assert_allclose(
+        model.explained_variance_ratio_, sk.explained_variance_ratio_, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        model.singular_values_, sk.singular_values_, rtol=1e-3
+    )
+    # deterministic sign: largest-|.| element of each component positive
+    for row in model.components_:
+        assert row[np.argmax(np.abs(row))] > 0
+
+
+def test_pca_transform_spark_semantics():
+    X = _data(n=100, d=6)
+    df = DataFrame.from_numpy(X, num_partitions=3)
+    model = PCA(k=2).fit(df)
+    out = model.transform(df).toPandas()
+    got = np.stack(out["pca_features"].to_numpy())
+    # Spark semantics: projection WITHOUT mean centering
+    expect = X @ model.components_.T
+    np.testing.assert_allclose(got, expect, atol=1e-3)
+
+
+@pytest.mark.parametrize("layout", ["array", "multi_cols"])
+def test_pca_layouts(layout):
+    X = _data(n=200, d=5)
+    df = DataFrame.from_numpy(X, feature_layout=layout, num_partitions=2)
+    pca = PCA(k=2)
+    if layout == "multi_cols":
+        pca.setInputCols(df.columns)
+    model = pca.fit(df)
+    assert model.components_.shape == (2, 5)
+
+
+def test_pca_float64():
+    X = _data(n=200, d=5)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+    m32 = PCA(k=2).fit(df)
+    m64 = PCA(k=2, float32_inputs=False).fit(df)
+    np.testing.assert_allclose(m32.components_, m64.components_, atol=1e-2)
+
+
+def test_pca_persistence(tmp_path):
+    X = _data(n=100, d=5)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+    est = PCA(k=2)
+    est.save(str(tmp_path / "est"))
+    est2 = load(str(tmp_path / "est"))
+    assert isinstance(est2, PCA)
+    assert est2.getK() == 2
+
+    model = est.fit(df)
+    model.save(str(tmp_path / "model"))
+    loaded = load(str(tmp_path / "model"))
+    assert isinstance(loaded, PCAModel)
+    np.testing.assert_allclose(loaded.components_, model.components_)
+    np.testing.assert_allclose(loaded.mean_, model.mean_)
+    assert loaded.n_cols == 5
+    out1 = model.transform(df).toPandas()["pca_features"]
+    out2 = loaded.transform(df).toPandas()["pca_features"]
+    np.testing.assert_allclose(np.stack(out1), np.stack(out2), atol=1e-6)
+
+
+def test_pca_model_accessors():
+    X = _data(n=100, d=5)
+    model = PCA(k=2).fit(DataFrame.from_numpy(X))
+    assert model.pc.shape == (5, 2)
+    assert len(model.mean) == 5
+    assert model.explainedVariance.shape == (2,)
+    assert model.getK() == 2
+
+
+def test_pca_mesh_invariance():
+    """Multi-device result == single-device result (distribution is exact for
+    covariance accumulation)."""
+    X = _data(n=256, d=6)
+    df = DataFrame.from_numpy(X, num_partitions=4)
+    m1 = PCA(k=3, num_workers=1).fit(df)
+    m8 = PCA(k=3, num_workers=8).fit(df)
+    np.testing.assert_allclose(m1.components_, m8.components_, atol=1e-3)
+    np.testing.assert_allclose(m1.singular_values_, m8.singular_values_, rtol=1e-3)
