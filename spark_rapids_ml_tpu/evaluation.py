@@ -1,0 +1,193 @@
+#
+# Evaluators: pyspark.ml.evaluation-compatible stand-ins that run locally on
+# the DataFrame facade (the reference consumes the genuine pyspark
+# evaluators; this framework works with or without pyspark, so these carry
+# the same param surface + an `evaluate(dataset)` that computes via the
+# metrics package).
+#
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .dataframe import DataFrame, as_dataframe
+from .metrics.multiclass import MulticlassMetrics
+from .metrics.regression import RegressionMetrics
+from .params import (
+    HasLabelCol,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasWeightCol,
+    Param,
+    Params,
+    TypeConverters,
+    _dummy,
+)
+
+
+class Evaluator(Params):
+    def evaluate(self, dataset: Any) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+
+class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol, HasWeightCol):
+    """Metric parity with pyspark RegressionEvaluator: rmse (default), mse,
+    r2, mae, var."""
+
+    metricName = Param(_dummy(), "metricName", "metric name in evaluation (mse|rmse|r2|mae|var)", TypeConverters.toString)
+    throughOrigin = Param(_dummy(), "throughOrigin", "whether the regression is through the origin", TypeConverters.toBoolean)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(metricName="rmse", throughOrigin=False)
+        for k, v in kwargs.items():
+            self.set(self.getParam(k), v)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def setMetricName(self, value: str) -> "RegressionEvaluator":
+        self.set(self.getParam("metricName"), value)
+        return self
+
+    def getThroughOrigin(self) -> bool:
+        return self.getOrDefault("throughOrigin")
+
+    def setLabelCol(self, value: str) -> "RegressionEvaluator":
+        self.set(self.getParam("labelCol"), value)
+        return self
+
+    def setPredictionCol(self, value: str) -> "RegressionEvaluator":
+        self.set(self.getParam("predictionCol"), value)
+        return self
+
+    def isLargerBetter(self) -> bool:
+        return self.getMetricName() in ("r2", "var")
+
+    def evaluate(self, dataset: Any) -> float:
+        df = as_dataframe(dataset)
+        metrics = None
+        for part in df.partitions:
+            if len(part) == 0:
+                continue
+            m = RegressionMetrics.from_arrays(
+                part[self.getOrDefault("labelCol")].to_numpy(),
+                part[self.getOrDefault("predictionCol")].to_numpy(),
+            )
+            metrics = m if metrics is None else metrics.merge(m)
+        assert metrics is not None, "empty dataset"
+        return metrics.evaluate(self)
+
+
+class MulticlassClassificationEvaluator(
+    Evaluator, HasLabelCol, HasPredictionCol, HasProbabilityCol, HasWeightCol
+):
+    """Metric parity with pyspark MulticlassClassificationEvaluator for the
+    metrics the reference supports (MulticlassMetrics.py:38-53)."""
+
+    metricName = Param(_dummy(), "metricName", "metric name in evaluation", TypeConverters.toString)
+    metricLabel = Param(_dummy(), "metricLabel", "the class whose metric will be computed in by-label metrics", TypeConverters.toFloat)
+    beta = Param(_dummy(), "beta", "beta value in weightedFMeasure|fMeasureByLabel", TypeConverters.toFloat)
+    eps = Param(_dummy(), "eps", "log-loss epsilon", TypeConverters.toFloat)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(metricName="f1", metricLabel=0.0, beta=1.0, eps=1.0e-15)
+        for k, v in kwargs.items():
+            self.set(self.getParam(k), v)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def setMetricName(self, value: str) -> "MulticlassClassificationEvaluator":
+        self.set(self.getParam("metricName"), value)
+        return self
+
+    def getMetricLabel(self) -> float:
+        return self.getOrDefault("metricLabel")
+
+    def getBeta(self) -> float:
+        return self.getOrDefault("beta")
+
+    def getEps(self) -> float:
+        return self.getOrDefault("eps")
+
+    def setLabelCol(self, value: str) -> "MulticlassClassificationEvaluator":
+        self.set(self.getParam("labelCol"), value)
+        return self
+
+    def setPredictionCol(self, value: str) -> "MulticlassClassificationEvaluator":
+        self.set(self.getParam("predictionCol"), value)
+        return self
+
+    def isLargerBetter(self) -> bool:
+        return self.getMetricName() not in (
+            "weightedFalsePositiveRate",
+            "falsePositiveRateByLabel",
+            "hammingLoss",
+            "logLoss",
+        )
+
+    def evaluate(self, dataset: Any) -> float:
+        df = as_dataframe(dataset)
+        needs_probs = self.getMetricName() == "logLoss"
+        metrics = None
+        for part in df.partitions:
+            if len(part) == 0:
+                continue
+            probs = (
+                np.stack(part[self.getOrDefault("probabilityCol")].to_numpy())
+                if needs_probs
+                else None
+            )
+            m = MulticlassMetrics.from_arrays(
+                part[self.getOrDefault("labelCol")].to_numpy(),
+                part[self.getOrDefault("predictionCol")].to_numpy(),
+                probs=probs,
+                eps=self.getEps(),
+            )
+            metrics = m if metrics is None else metrics.merge(m)
+        assert metrics is not None, "empty dataset"
+        return metrics.evaluate(self)
+
+
+class BinaryClassificationEvaluator(
+    Evaluator, HasLabelCol, HasRawPredictionCol, HasWeightCol
+):
+    """areaUnderROC / areaUnderPR over the rawPrediction column."""
+
+    metricName = Param(_dummy(), "metricName", "metric name in evaluation (areaUnderROC|areaUnderPR)", TypeConverters.toString)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(metricName="areaUnderROC")
+        for k, v in kwargs.items():
+            self.set(self.getParam(k), v)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def setLabelCol(self, value: str) -> "BinaryClassificationEvaluator":
+        self.set(self.getParam("labelCol"), value)
+        return self
+
+    def evaluate(self, dataset: Any) -> float:
+        from sklearn.metrics import average_precision_score, roc_auc_score
+
+        df = as_dataframe(dataset)
+        pdf = df.toPandas()
+        labels = pdf[self.getOrDefault("labelCol")].to_numpy()
+        raw = pdf[self.getOrDefault("rawPredictionCol")].to_numpy()
+        if raw.dtype == object:
+            raw = np.stack(raw)[:, -1]  # score of the positive class
+        if self.getMetricName() == "areaUnderROC":
+            return float(roc_auc_score(labels, raw))
+        if self.getMetricName() == "areaUnderPR":
+            return float(average_precision_score(labels, raw))
+        raise ValueError(f"Unsupported metric name, found {self.getMetricName()}")

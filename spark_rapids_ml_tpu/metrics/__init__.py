@@ -1,0 +1,42 @@
+#
+# Distributed-evaluation metric infrastructure.
+#
+# Functional counterpart of the reference's metrics package
+# (/root/reference/python/src/spark_rapids_ml/metrics/__init__.py): the
+# EvalMetricInfo carrier (eps=1e-15 logLoss parity, :36) and the
+# transform-evaluate metric kinds.  Per-partition partial statistics are
+# computed on device output and merged on the driver, mirroring Spark's
+# Scala MulticlassMetrics/RegressionMetrics aggregation design.
+#
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+class transform_evaluate_metric:
+    accuracy_like = "accuracy_like"
+    log_loss = "log_loss"
+    regression = "regression"
+
+
+@dataclass
+class EvalMetricInfo:
+    """Info about the evaluator passed into transform-evaluate local
+    computations (reference metrics/__init__.py:31-40)."""
+
+    eps: float = 1.0e-15  # logLoss epsilon
+    numBins: int = 1000  # BinaryClassificationEvaluator placeholder
+    eval_metric: Optional[str] = None
+
+
+from .regression import RegressionMetrics, _SummarizerBuffer  # noqa: E402
+from .multiclass import MulticlassMetrics, log_loss  # noqa: E402
+
+__all__ = [
+    "EvalMetricInfo",
+    "transform_evaluate_metric",
+    "RegressionMetrics",
+    "_SummarizerBuffer",
+    "MulticlassMetrics",
+    "log_loss",
+]

@@ -53,7 +53,9 @@ def to_spark_pca_model(model: Any):
     java_model = sc._jvm.org.apache.spark.ml.feature.PCAModel(
         _java_uid(sc, "pca"), _py2java(sc, pc), _py2java(sc, ev)
     )
-    return SparkPCAModel(java_model)
+    spark_model = SparkPCAModel(java_model)
+    model._copyValues(spark_model)
+    return spark_model
 
 
 def to_spark_kmeans_model(model: Any):
@@ -73,7 +75,37 @@ def to_spark_kmeans_model(model: Any):
         _java_uid(sc, "kmeans"),
         sc._jvm.org.apache.spark.mllib.clustering.KMeansModel(java_centers),
     )
-    return SparkKMeansModel(java_model)
+    spark_model = SparkKMeansModel(java_model)
+    model._copyValues(spark_model)
+    return spark_model
+
+
+def to_spark_logistic_model(model: Any):
+    """TPU LogisticRegressionModel -> pyspark.ml LogisticRegressionModel
+    (parity with classification.py:1124-1146)."""
+    _require_pyspark()
+    from pyspark.ml.classification import (
+        LogisticRegressionModel as SparkLogisticRegressionModel,
+    )
+    from pyspark.ml.common import _py2java
+    from pyspark.ml.linalg import DenseMatrix
+
+    spark = _active_session()
+    sc = spark.sparkContext
+    coef = model.coefficientMatrix
+    mat = DenseMatrix(
+        coef.shape[0], coef.shape[1], coef.flatten().tolist(), True
+    )
+    java_model = sc._jvm.org.apache.spark.ml.classification.LogisticRegressionModel(
+        _java_uid(sc, "logreg"),
+        _py2java(sc, mat),
+        _py2java(sc, model.interceptVector),  # reuses the compression rule
+        int(model.numClasses),
+        bool(model.numClasses > 2),
+    )
+    spark_model = SparkLogisticRegressionModel(java_model)
+    model._copyValues(spark_model)
+    return spark_model
 
 
 def to_spark_linear_model(model: Any):
@@ -90,4 +122,6 @@ def to_spark_linear_model(model: Any):
     java_model = sc._jvm.org.apache.spark.ml.regression.LinearRegressionModel(
         _java_uid(sc, "linReg"), coef, float(model.intercept_), float(1.0)
     )
-    return SparkLRModel(java_model)
+    spark_model = SparkLRModel(java_model)
+    model._copyValues(spark_model)
+    return spark_model
