@@ -195,13 +195,17 @@ class DataFrame:
     ) -> "DataFrame":
         """Per-partition transform, same contract as pyspark mapInPandas: fn
         takes an iterator of batches and yields output batches."""
-        out = []
+        out: List[Optional[pd.DataFrame]] = []
         for p in self._partitions:
             frames = list(fn(iter([p])))
-            out.append(
-                pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
-            )
-        return DataFrame(out)
+            out.append(pd.concat(frames, ignore_index=True) if frames else None)
+        # partitions with no output batches get the output schema of the
+        # first non-empty partition (pyspark declares the schema up front)
+        template = next((o for o in out if o is not None), pd.DataFrame())
+        filled = [
+            o if o is not None else template.iloc[0:0].copy() for o in out
+        ]
+        return DataFrame(filled)
 
     def toPandas(self) -> pd.DataFrame:
         return pd.concat(self._partitions, ignore_index=True)

@@ -76,7 +76,10 @@ class MulticlassMetrics:
         classes = np.unique(np.concatenate([labels, preds]))
         tp = {c: float(((labels == c) & (preds == c)).sum()) for c in classes}
         fp = {c: float(((labels != c) & (preds == c)).sum()) for c in classes}
-        cnt = {c: float((labels == c).sum()) for c in classes}
+        # label counts keyed by TRUE labels only (reference semantics): a
+        # predicted-but-absent class must not enter the weighted averages,
+        # where its zero count would divide by zero
+        cnt = {c: float((labels == c).sum()) for c in np.unique(labels)}
         ll = log_loss(labels, probs, eps) if probs is not None else -1.0
         return cls(tp, fp, cnt, len(labels), ll)
 

@@ -25,7 +25,6 @@ import jax.numpy as jnp
 
 from ..core import FitInputs, _TpuEstimatorSupervised, _TpuModelWithPredictionCol
 from ..dataframe import DataFrame, as_dataframe
-from ..metrics import EvalMetricInfo
 from ..metrics.multiclass import MulticlassMetrics
 from ..params import (
     HasElasticNetParam,
@@ -86,10 +85,13 @@ class _ClassificationModelEvaluationMixIn:
             else:
                 feats = np.asarray(part[input_cols].to_numpy(), dtype=dtype)
             labels = part[label_col].to_numpy()
+            preds_all, probs_all = predict_all(feats)  # (M, n), (M, n, C)
             for i in range(num_models):
-                preds, probs = predict_all(feats, i)
                 m = MulticlassMetrics.from_arrays(
-                    labels, preds, probs=probs if needs_probs else None, eps=eps
+                    labels,
+                    preds_all[i],
+                    probs=probs_all[i] if needs_probs else None,
+                    eps=eps,
                 )
                 metrics[i] = m if metrics[i] is None else metrics[i].merge(m)
         return [m.evaluate(evaluator) for m in metrics]  # type: ignore[union-attr]
@@ -446,26 +448,38 @@ class LogisticRegressionModel(
 
         return _transform
 
-    def _get_eval_predict_func(self) -> Callable[[np.ndarray, int], tuple]:
+    def _get_eval_predict_func(self) -> Callable[[np.ndarray], tuple]:
         np_dtype = self._transform_dtype(self.dtype)
-        coefs = self.coef_ if self.coef_.ndim == 3 else self.coef_[None]
-        intercepts = (
-            self.intercept_ if self.intercept_.ndim == 2 else self.intercept_[None]
-        )
+        coefs = jnp.asarray(
+            (self.coef_ if self.coef_.ndim == 3 else self.coef_[None]).astype(np_dtype)
+        )  # (M, k, D)
+        intercepts = jnp.asarray(
+            (
+                self.intercept_ if self.intercept_.ndim == 2 else self.intercept_[None]
+            ).astype(np_dtype)
+        )  # (M, k)
         classes = self.classes_
         num_classes = self._num_classes
 
-        def _predict(feats: np.ndarray, model_index: int):
-            scores = logistic_decision_kernel(
-                jax.device_put(np.asarray(feats, np_dtype)),
-                jnp.asarray(coefs[model_index].astype(np_dtype)),
-                jnp.asarray(intercepts[model_index].astype(np_dtype)),
+        def _predict_all(feats: np.ndarray):
+            # one transfer + one batched matmul for all M models
+            Xd = jax.device_put(np.asarray(feats, np_dtype))
+            scores = jnp.einsum("nd,mkd->mnk", Xd, coefs) + intercepts[:, None, :]
+            probs = np.stack(
+                [
+                    np.asarray(scores_to_probs(scores[m], num_classes), np.float64)
+                    for m in range(scores.shape[0])
+                ]
             )
-            probs = np.asarray(scores_to_probs(scores, num_classes), np.float64)
-            idx = np.asarray(scores_to_labels(scores, num_classes), np.int64)
+            idx = np.stack(
+                [
+                    np.asarray(scores_to_labels(scores[m], num_classes), np.int64)
+                    for m in range(scores.shape[0])
+                ]
+            )
             return classes[idx].astype(np.float64), probs
 
-        return _predict
+        return _predict_all
 
     def cpu(self):
         """pyspark.ml LogisticRegressionModel (parity hook for

@@ -180,7 +180,16 @@ class PCAModel(_PCAParams, _TpuModel):
 
     def _get_tpu_transform_func(self, dataset: DataFrame):
         np_dtype = self._transform_dtype(self.dtype)
-        components = jax.device_put(np.asarray(self.components_, dtype=np_dtype))
+        comps = np.asarray(self.components_, dtype=np_dtype)
+        if self._tpu_params.get("whiten"):
+            # whitened projection: unit variance per component (note: Spark
+            # semantics never center at transform time, so whitening scales
+            # the uncentered projection)
+            scale = 1.0 / np.sqrt(
+                np.maximum(self.explained_variance_, 1e-12)
+            ).astype(np_dtype)
+            comps = comps * scale[:, None]
+        components = jax.device_put(comps)
         out_col = self.getOrDefault("outputCol")
 
         def _transform(features: np.ndarray) -> Dict[str, Any]:

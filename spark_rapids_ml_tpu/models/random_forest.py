@@ -1,0 +1,618 @@
+#
+# RandomForest classifier/regressor estimators and models.
+#
+# Capability parity with the reference's shared tree machinery
+# (/root/reference/python/src/spark_rapids_ml/tree.py:66-607) and its
+# Spark-facing subclasses (classification.py:297-643, regression.py:780-1057):
+# same Spark param mapping (tree.py:68-86), same max_features value mapping
+# (tree.py:88-110), same solver defaults (tree.py:112-128), int32 label cast
+# for classification (classification.py:483-496), probability/rawPrediction
+# columns, model combine and single-pass transform-evaluate.
+#
+# The builder is redesigned TPU-first (ops/forest.py): every tree trains on
+# the FULL row-sharded dataset with Poisson bootstrap weights (a statistical
+# improvement over the reference's per-worker data shards, tree.py:256-267 —
+# there each worker only sees 1/num_workers of the rows).  The forest is
+# stored as dense arrays (feature/threshold/leaf-value per node) instead of
+# treelite bytes; `trees_to_dicts` exports the portable nested-dict format
+# that plays the role of the reference's treelite JSON (utils.py:385-447
+# translate_trees interop).
+#
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import FitInputs, _TpuEstimatorSupervised, _TpuModelWithPredictionCol
+from ..dataframe import DataFrame
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasSeed,
+    HasVerbose,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+    _dummy,
+    _TpuParams,
+)
+from ..ops.forest import (
+    TreeArrays,
+    bin_features,
+    compute_bin_edges,
+    forest_predict_kernel,
+    grow_tree,
+)
+from ..utils import get_logger
+
+_MAX_SUPPORTED_DEPTH = 16  # dense tree layout: 2^(d+1)-1 node slots
+
+
+def _str_or_numerical(value: str) -> Union[str, float, int]:
+    """'0.3' -> 0.3, '5' -> 5, else the string (reference utils helper
+    used by the max_features mapping)."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return value
+
+
+class _RandomForestClass(_TpuParams):
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {
+            "maxBins": "n_bins",
+            "maxDepth": "max_depth",
+            "numTrees": "n_estimators",
+            "impurity": "split_criterion",
+            "featureSubsetStrategy": "max_features",
+            "bootstrap": "bootstrap",
+            "seed": "random_state",
+            "minInstancesPerNode": "min_samples_leaf",
+            "minInfoGain": "",
+            "maxMemoryInMB": "",
+            "cacheNodeIds": "",
+            "checkpointInterval": "",
+            "subsamplingRate": "",
+            "minWeightFractionPerNode": "",
+            "weightCol": None,
+            "leafCol": None,
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        def _subset_mapping(v):
+            maybe = _str_or_numerical(v) if isinstance(v, str) else v
+            if isinstance(maybe, (int, float)) and not isinstance(maybe, bool):
+                return maybe
+            return {
+                "onethird": 1 / 3.0,
+                "all": 1.0,
+                "auto": "auto",
+                "sqrt": "sqrt",
+                "log2": "log2",
+            }.get(maybe)
+
+        return {"max_features": _subset_mapping}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_estimators": 100,
+            "max_depth": 16,
+            "max_features": "auto",
+            "n_bins": 128,
+            "bootstrap": True,
+            "verbose": False,
+            "min_samples_leaf": 1,
+            "min_samples_split": 2,
+            "max_samples": 1.0,
+            "max_leaves": -1,
+            "min_impurity_decrease": 0.0,
+            "random_state": None,
+            "max_batch_size": 4096,
+        }
+
+
+class _RandomForestParams(
+    _RandomForestClass,
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasPredictionCol,
+    HasSeed,
+    HasWeightCol,
+    HasVerbose,
+):
+    numTrees = Param(_dummy(), "numTrees", "number of trees to train (>= 1)", TypeConverters.toInt)
+    maxDepth = Param(_dummy(), "maxDepth", "maximum depth of the tree (>= 0, <= 16)", TypeConverters.toInt)
+    maxBins = Param(_dummy(), "maxBins", "max number of bins for discretizing continuous features", TypeConverters.toInt)
+    impurity = Param(_dummy(), "impurity", "criterion used for information gain calculation", TypeConverters.toString)
+    featureSubsetStrategy = Param(_dummy(), "featureSubsetStrategy", "number of features to consider per split (auto|all|onethird|sqrt|log2|n|fraction)", TypeConverters.toString)
+    bootstrap = Param(_dummy(), "bootstrap", "whether bootstrap samples are used", TypeConverters.toBoolean)
+    minInstancesPerNode = Param(_dummy(), "minInstancesPerNode", "minimum number of instances each child must have after split", TypeConverters.toInt)
+    minInfoGain = Param(_dummy(), "minInfoGain", "minimum information gain for a split (ignored)", TypeConverters.toFloat)
+    subsamplingRate = Param(_dummy(), "subsamplingRate", "fraction of data used per tree (ignored)", TypeConverters.toFloat)
+    maxMemoryInMB = Param(_dummy(), "maxMemoryInMB", "max memory for histogram aggregation (ignored)", TypeConverters.toInt)
+    cacheNodeIds = Param(_dummy(), "cacheNodeIds", "ignored", TypeConverters.toBoolean)
+    checkpointInterval = Param(_dummy(), "checkpointInterval", "ignored", TypeConverters.toInt)
+    minWeightFractionPerNode = Param(_dummy(), "minWeightFractionPerNode", "ignored", TypeConverters.toFloat)
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._setDefault(
+            numTrees=20,
+            maxDepth=5,
+            maxBins=32,
+            featureSubsetStrategy="auto",
+            bootstrap=True,
+            minInstancesPerNode=1,
+            minInfoGain=0.0,
+            subsamplingRate=1.0,
+            maxMemoryInMB=256,
+            cacheNodeIds=False,
+            checkpointInterval=10,
+            minWeightFractionPerNode=0.0,
+        )
+
+    def setNumTrees(self, value: int):
+        return self._set_params(numTrees=value)
+
+    def setMaxDepth(self, value: int):
+        return self._set_params(maxDepth=value)
+
+    def setMaxBins(self, value: int):
+        return self._set_params(maxBins=value)
+
+    def setImpurity(self, value: str):
+        return self._set_params(impurity=value)
+
+    def setFeatureSubsetStrategy(self, value: str):
+        return self._set_params(featureSubsetStrategy=value)
+
+    def setSeed(self, value: int):
+        return self._set_params(seed=value)
+
+    def getNumTrees(self) -> int:
+        return self.getOrDefault("numTrees")
+
+    def getMaxDepth(self) -> int:
+        return self.getOrDefault("maxDepth")
+
+    def getMaxBins(self) -> int:
+        return self.getOrDefault("maxBins")
+
+
+def _resolve_max_features(value: Any, n_cols: int, is_classification: bool, n_trees: int) -> int:
+    """Spark featureSubsetStrategy semantics: auto = all when numTrees == 1,
+    else sqrt (classification) / onethird (regression)."""
+    if value == "auto" or value is None:
+        if n_trees == 1:
+            return n_cols
+        return (
+            max(1, int(math.sqrt(n_cols)))
+            if is_classification
+            else max(1, int(n_cols / 3.0))
+        )
+    if value == "sqrt":
+        return max(1, int(math.sqrt(n_cols)))
+    if value == "log2":
+        return max(1, int(math.log2(n_cols)))
+    if isinstance(value, float):
+        return max(1, min(n_cols, int(value * n_cols)))
+    return max(1, min(n_cols, int(value)))
+
+
+class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
+    _is_classification = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        return True
+
+    def _encode_labels(self, y: np.ndarray, valid: np.ndarray):
+        raise NotImplementedError
+
+    def _get_tpu_fit_func(self, dataset: DataFrame, extra_params=None):
+        logger = get_logger(type(self))
+        is_classification = self._is_classification
+
+        def _single_fit(
+            inputs: FitInputs, params: Dict[str, Any], Xb, edges, stats, extra_attrs
+        ) -> Dict[str, Any]:
+            max_depth = int(params["max_depth"])
+            if max_depth > _MAX_SUPPORTED_DEPTH:
+                raise ValueError(
+                    f"maxDepth > {_MAX_SUPPORTED_DEPTH} is not supported by the dense "
+                    f"TPU tree layout (got {max_depth})"
+                )
+            n_trees = int(params["n_estimators"])
+            n_bins = int(params["n_bins"])
+            criterion = params.get("split_criterion")
+            kind = (
+                "regression"
+                if not is_classification
+                else ("entropy" if criterion == "entropy" else "gini")
+            )
+            max_features = _resolve_max_features(
+                params.get("max_features", "auto"),
+                inputs.n_cols,
+                is_classification,
+                n_trees,
+            )
+            seed = params.get("random_state")
+            seed = int(seed) & 0x7FFFFFFF if seed is not None else 42
+            bootstrap = bool(params.get("bootstrap", True))
+            trees: List[TreeArrays] = []
+            key = jax.random.PRNGKey(seed)
+            for t in range(n_trees):
+                key, kt = jax.random.split(key)
+                if bootstrap:
+                    bw = jax.random.poisson(kt, 1.0, (inputs.X.shape[0],)).astype(
+                        inputs.X.dtype
+                    )
+                    w_t = inputs.weight * bw
+                else:
+                    w_t = inputs.weight
+                tree_stats = stats * w_t[:, None]
+                trees.append(
+                    grow_tree(
+                        Xb,
+                        tree_stats,
+                        edges,
+                        max_depth=max_depth,
+                        n_bins=n_bins,
+                        kind=kind,
+                        max_features=max_features,
+                        min_samples_leaf=float(params.get("min_samples_leaf", 1)),
+                        min_impurity_decrease=float(
+                            params.get("min_impurity_decrease", 0.0)
+                        ),
+                        seed=(seed + 7919 * t) & 0x7FFFFFFF,
+                    )
+                )
+            logger.info("grew %d trees (depth<=%d, bins=%d)", n_trees, max_depth, n_bins)
+            attrs = {
+                "features_": np.stack([np.asarray(t.feature) for t in trees]),
+                "thresholds_": np.stack([np.asarray(t.threshold) for t in trees]),
+                "leaf_values_": np.stack([np.asarray(t.leaf_value) for t in trees]),
+                "node_counts_": np.stack([np.asarray(t.n_samples) for t in trees]),
+                "impurities_": np.stack([np.asarray(t.impurity) for t in trees]),
+                "max_depth": max_depth,
+                "n_cols": inputs.n_cols,
+                "dtype": str(inputs.dtype),
+            }
+            attrs.update(extra_attrs)
+            return attrs
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]):
+            assert inputs.y is not None
+            X_host = np.asarray(inputs.X)
+            valid = np.asarray(inputs.weight) > 0
+            n_bins = int(params["n_bins"])
+            edges = compute_bin_edges(X_host[valid], n_bins)
+            Xb = bin_features(inputs.X, jnp.asarray(edges))
+            stats, extra_attrs = self._label_stats(inputs, valid)
+            if extra_params:
+                results = []
+                for override in extra_params:
+                    p = dict(params)
+                    p.update(override)
+                    if int(p["n_bins"]) != n_bins:
+                        e2 = compute_bin_edges(X_host[valid], int(p["n_bins"]))
+                        xb2 = bin_features(inputs.X, jnp.asarray(e2))
+                        results.append(_single_fit(inputs, p, xb2, e2, stats, extra_attrs))
+                    else:
+                        results.append(_single_fit(inputs, p, Xb, edges, stats, extra_attrs))
+                return results
+            return _single_fit(inputs, params, Xb, edges, stats, extra_attrs)
+
+        return _fit
+
+    def _label_stats(self, inputs: FitInputs, valid: np.ndarray):
+        raise NotImplementedError
+
+
+class _RandomForestModelBase(_RandomForestParams, _TpuModelWithPredictionCol):
+    """Shared forest model: dense arrays + vectorized traversal predict."""
+
+    def _forest_arrays(self):
+        np_dtype = self._transform_dtype(self.dtype)
+        return (
+            jnp.asarray(self.features_),
+            jnp.asarray(self.thresholds_.astype(np_dtype)),
+            jnp.asarray(self.leaf_values_),
+        )
+
+    def _predict_values(self, features: np.ndarray) -> np.ndarray:
+        features = np.atleast_2d(np.asarray(features))
+        if features.shape[1] != self.n_cols:
+            # gathers clamp out-of-range feature ids, which would silently
+            # mispredict — reject wrong-width inputs explicitly
+            raise ValueError(
+                f"feature width {features.shape[1]} != model n_cols {self.n_cols}"
+            )
+        np_dtype = self._transform_dtype(self.dtype)
+        f, t, v = self._forest_arrays()
+        return np.asarray(
+            forest_predict_kernel(
+                jax.device_put(np.asarray(features, np_dtype)), f, t, v,
+                max_depth=int(self.max_depth),
+            )
+        )
+
+    @property
+    def getNumTrees(self) -> int:  # property for pyspark API parity
+        return self.features_.shape[0]
+
+    @property
+    def treeWeights(self) -> List[float]:
+        return [1.0] * self.features_.shape[0]
+
+    @property
+    def totalNumNodes(self) -> int:
+        return int((self.features_ >= 0).sum() * 2 + (self.features_ >= 0).shape[0])
+
+    def trees_to_dicts(self) -> List[Dict[str, Any]]:
+        """Portable nested-dict forest export — the role the reference's
+        treelite JSON plays for translate_trees (utils.py:385-447)."""
+        out = []
+        for t in range(self.features_.shape[0]):
+            def node_dict(i: int) -> Dict[str, Any]:
+                if self.features_[t, i] < 0:
+                    return {
+                        "leaf_value": self.leaf_values_[t, i].tolist(),
+                        "instance_count": float(self.node_counts_[t, i]),
+                    }
+                return {
+                    "split_feature": int(self.features_[t, i]),
+                    "threshold": float(self.thresholds_[t, i]),
+                    "gain": float(self.impurities_[t, i]),
+                    "instance_count": float(self.node_counts_[t, i]),
+                    "yes": node_dict(2 * i + 1),
+                    "no": node_dict(2 * i + 2),
+                }
+
+            out.append(node_dict(0))
+        return out
+
+
+class RandomForestClassifier(_RandomForestEstimator):
+    """Distributed random-forest classifier (API parity with
+    classification.py:307-513)."""
+
+    _is_classification = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._setDefault(impurity="gini")
+        if "impurity" not in kwargs:
+            self._set_tpu_value("split_criterion", "gini")
+
+    @classmethod
+    def _param_value_mapping(cls):
+        mapping = dict(super()._param_value_mapping())
+        mapping["split_criterion"] = lambda x: {"gini": "gini", "entropy": "entropy"}.get(x)
+        return mapping
+
+    def _label_stats(self, inputs: FitInputs, valid: np.ndarray):
+        # int32 label cast parity (classification.py:483-496)
+        y_np = np.asarray(inputs.y)
+        classes = np.unique(y_np[valid].astype(np.int32))
+        y_idx = np.searchsorted(classes, np.where(valid, y_np.astype(np.int32), classes[0]))
+        onehot = jax.nn.one_hot(
+            jnp.asarray(y_idx), len(classes), dtype=inputs.X.dtype
+        )
+        return onehot, {"classes_": classes.astype(np.float64), "num_classes": len(classes)}
+
+    def _create_model(self, result: Dict[str, Any]) -> "RandomForestClassificationModel":
+        return RandomForestClassificationModel(**result)
+
+
+class RandomForestClassificationModel(
+    HasProbabilityCol, HasRawPredictionCol, _RandomForestModelBase
+):
+    def __init__(
+        self,
+        features_: np.ndarray,
+        thresholds_: np.ndarray,
+        leaf_values_: np.ndarray,
+        node_counts_: np.ndarray,
+        impurities_: np.ndarray,
+        max_depth: int,
+        n_cols: int,
+        dtype: str,
+        classes_: np.ndarray,
+        num_classes: int,
+    ) -> None:
+        super().__init__(
+            features_=np.asarray(features_),
+            thresholds_=np.asarray(thresholds_),
+            leaf_values_=np.asarray(leaf_values_),
+            node_counts_=np.asarray(node_counts_),
+            impurities_=np.asarray(impurities_),
+            max_depth=int(max_depth),
+            n_cols=int(n_cols),
+            dtype=str(dtype),
+            classes_=np.asarray(classes_),
+            num_classes=int(num_classes),
+        )
+        self.features_ = np.asarray(features_)
+        self.thresholds_ = np.asarray(thresholds_)
+        self.leaf_values_ = np.asarray(leaf_values_)
+        self.node_counts_ = np.asarray(node_counts_)
+        self.impurities_ = np.asarray(impurities_)
+        self.max_depth = int(max_depth)
+        self.n_cols = int(n_cols)
+        self.dtype = str(dtype)
+        self.classes_ = np.asarray(classes_)
+        self.num_classes = int(num_classes)
+
+    @property
+    def numClasses(self) -> int:
+        return self.num_classes
+
+    def _out_columns(self) -> List[str]:
+        return [
+            self.getOrDefault("predictionCol"),
+            self.getOrDefault("probabilityCol"),
+            self.getOrDefault("rawPredictionCol"),
+        ]
+
+    def _get_tpu_transform_func(self, dataset: DataFrame):
+        classes = self.classes_
+        n_trees = self.features_.shape[0]
+        pred_col = self.getOrDefault("predictionCol")
+        prob_col = self.getOrDefault("probabilityCol")
+        raw_col = self.getOrDefault("rawPredictionCol")
+
+        def _transform(features: np.ndarray) -> Dict[str, Any]:
+            probs = self._predict_values(features)  # (N, C) mean leaf distributions
+            probs = probs / np.maximum(probs.sum(axis=1, keepdims=True), 1e-12)
+            idx = probs.argmax(axis=1)
+            return {
+                pred_col: classes[idx].astype(np.float64),
+                prob_col: probs.astype(np.float64),
+                raw_col: (probs * n_trees).astype(np.float64),
+            }
+
+        return _transform
+
+    def _get_eval_predict_func(self):
+        classes = self.classes_
+
+        def _predict_all(feats: np.ndarray):
+            probs = self._predict_values(feats)
+            probs = probs / np.maximum(probs.sum(axis=1, keepdims=True), 1e-12)
+            preds = classes[probs.argmax(axis=1)].astype(np.float64)
+            return preds[None, :], probs[None, :, :]
+
+        return _predict_all
+
+    def predict(self, value: np.ndarray) -> float:
+        probs = self._predict_values(np.asarray(value)[None, :])
+        return float(self.classes_[int(probs[0].argmax())])
+
+    def predictProbability(self, value: np.ndarray) -> np.ndarray:
+        probs = self._predict_values(np.asarray(value)[None, :])[0]
+        return probs / max(probs.sum(), 1e-12)
+
+    def _transformEvaluate(self, dataset: Any, evaluator: Any, params=None) -> List[float]:
+        from .logistic_regression import _ClassificationModelEvaluationMixIn
+
+        return _ClassificationModelEvaluationMixIn._transform_evaluate(
+            self, dataset, evaluator, 1
+        )
+
+    def cpu(self):
+        raise NotImplementedError(
+            "RandomForest cpu() interop requires pyspark JVM tree construction; "
+            "use trees_to_dicts() for a portable export."
+        )
+
+
+class RandomForestRegressor(_RandomForestEstimator):
+    """Distributed random-forest regressor (API parity with
+    regression.py:795-968)."""
+
+    _is_classification = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._setDefault(impurity="variance")
+        if "impurity" not in kwargs:
+            self._set_tpu_value("split_criterion", "variance")
+
+    @classmethod
+    def _param_value_mapping(cls):
+        mapping = dict(super()._param_value_mapping())
+        mapping["split_criterion"] = lambda x: {"variance": "variance", "mse": "variance"}.get(x)
+        return mapping
+
+    def _label_stats(self, inputs: FitInputs, valid: np.ndarray):
+        y = inputs.y
+        stats = jnp.stack([jnp.ones_like(y), y, y * y], axis=1)
+        return stats, {}
+
+    def _create_model(self, result: Dict[str, Any]) -> "RandomForestRegressionModel":
+        return RandomForestRegressionModel(**result)
+
+
+class RandomForestRegressionModel(_RandomForestModelBase):
+    def __init__(
+        self,
+        features_: np.ndarray,
+        thresholds_: np.ndarray,
+        leaf_values_: np.ndarray,
+        node_counts_: np.ndarray,
+        impurities_: np.ndarray,
+        max_depth: int,
+        n_cols: int,
+        dtype: str,
+    ) -> None:
+        super().__init__(
+            features_=np.asarray(features_),
+            thresholds_=np.asarray(thresholds_),
+            leaf_values_=np.asarray(leaf_values_),
+            node_counts_=np.asarray(node_counts_),
+            impurities_=np.asarray(impurities_),
+            max_depth=int(max_depth),
+            n_cols=int(n_cols),
+            dtype=str(dtype),
+        )
+        self.features_ = np.asarray(features_)
+        self.thresholds_ = np.asarray(thresholds_)
+        self.leaf_values_ = np.asarray(leaf_values_)
+        self.node_counts_ = np.asarray(node_counts_)
+        self.impurities_ = np.asarray(impurities_)
+        self.max_depth = int(max_depth)
+        self.n_cols = int(n_cols)
+        self.dtype = str(dtype)
+
+    def _get_tpu_transform_func(self, dataset: DataFrame):
+        pred_col = self.getOrDefault("predictionCol")
+
+        def _transform(features: np.ndarray) -> Dict[str, Any]:
+            preds = self._predict_values(features)[:, 0]
+            return {pred_col: preds.astype(np.float64)}
+
+        return _transform
+
+    def _get_eval_predict_func(self) -> Callable[[np.ndarray], np.ndarray]:
+        def _predict_all(feats: np.ndarray) -> np.ndarray:
+            return self._predict_values(feats)[:, 0][None, :].astype(np.float64)
+
+        return _predict_all
+
+    def predict(self, value: np.ndarray) -> float:
+        return float(self._predict_values(np.asarray(value)[None, :])[0, 0])
+
+    def _transformEvaluate(self, dataset: Any, evaluator: Any, params=None) -> List[float]:
+        from .linear_regression import _RegressionModelEvaluationMixIn
+
+        return _RegressionModelEvaluationMixIn._transform_evaluate(
+            self, dataset, evaluator, 1
+        )
+
+    def cpu(self):
+        raise NotImplementedError(
+            "RandomForest cpu() interop requires pyspark JVM tree construction; "
+            "use trees_to_dicts() for a portable export."
+        )

@@ -1,0 +1,296 @@
+#
+# Histogram-based random-forest builder (binned, level-wise), pure jax.
+#
+# TPU-native replacement for cuML's RandomForest{Classifier,Regressor}
+# (used by the reference at tree.py:292-397).  cuML's node-batched GPU tree
+# building has no XLA analog, so the builder is reformulated the way
+# XGBoost-style systems map to accelerators (SURVEY.md §7 "hard parts"):
+#
+#   - features are quantile-binned once (maxBins = n_bins, as the reference's
+#     cuml n_bins) -> all split search runs on integer bins
+#   - trees grow LEVEL-WISE with static shapes: at level L there are 2^L
+#     dense node slots; per-level histograms are segment-sums keyed by
+#     (node, bin), vmapped over features; split selection is a pure argmax
+#   - per-level kernels are jitted once per level shape and reused across
+#     every tree and every fit with the same geometry
+#   - rows carry an int32 node id; routing is a gather + compare per level
+#   - bootstrap = per-tree Poisson(1) row weights; featureSubsetStrategy =
+#     per-node Gumbel top-k feature masks
+#
+# One stat layout serves both tasks: regression rows carry [w, w*y, w*y^2]
+# (variance impurity), classification rows carry w*onehot(y) (gini/entropy).
+#
+# A dense complete binary tree of size 2^(max_depth+1)-1 holds
+# (feature, threshold, leaf flag, leaf value); prediction is max_depth
+# gather/compare steps vmapped over trees.  Node histograms at a level are
+# chunked (node_batch) so deep levels stay within HBM for wide features.
+#
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class TreeArrays(NamedTuple):
+    feature: jax.Array     # (M,) int32, -1 => leaf/unused
+    threshold: jax.Array   # (M,) float32 raw-space threshold (go left if x <= t)
+    leaf_value: jax.Array  # (M, V) float32
+    n_samples: jax.Array   # (M,) float32 weighted sample count (for export)
+    impurity: jax.Array    # (M,) float32 node impurity (for export)
+
+
+def compute_bin_edges(X: np.ndarray, n_bins: int, max_sample: int = 100_000, seed: int = 0) -> np.ndarray:
+    """Per-feature quantile bin edges, (D, n_bins-1).  Host-side, computed
+    once per fit on a row subsample (the binning role of cuml's n_bins)."""
+    n = X.shape[0]
+    if n > max_sample:
+        idx = np.random.default_rng(seed).choice(n, max_sample, replace=False)
+        sample = X[idx]
+    else:
+        sample = X
+    qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    edges = np.quantile(sample, qs, axis=0).T.astype(np.float32)  # (D, B-1)
+    # strictly increasing edges make searchsorted/thresholds deterministic
+    return edges
+
+
+@jax.jit
+def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
+    """bin = number of edges strictly below x, in [0, B-1]; x <= edges[b]
+    iff bin <= b, so thresholds in raw space are exactly edge values."""
+    def per_col(col, e):
+        return jnp.searchsorted(e, col, side="left").astype(jnp.int32)
+
+    return jax.vmap(per_col, in_axes=(1, 0), out_axes=1)(X, edges)
+
+
+def _chunk_histogram(Xb, stats, rel_node, lo, node_batch, n_bins):
+    """Per-(node, feature, bin) stat sums for nodes [lo, lo+node_batch):
+    (node_batch, D, n_bins, S).  Rows outside the chunk are masked; only one
+    chunk's histogram is ever live."""
+    S = stats.shape[1]
+    in_chunk = (rel_node >= lo) & (rel_node < lo + node_batch)
+    local = jnp.where(in_chunk, rel_node - lo, node_batch)
+    seg = local * n_bins  # (N,)
+    masked_stats = jnp.where(in_chunk[:, None], stats, 0.0)
+
+    def per_feature(bins_col):
+        ids = jnp.where(in_chunk, seg + bins_col, node_batch * n_bins)
+        return jax.ops.segment_sum(
+            masked_stats, ids, num_segments=node_batch * n_bins + 1
+        )[:-1].reshape(node_batch, n_bins, S)
+
+    return jax.vmap(per_feature, in_axes=1, out_axes=1)(Xb)  # (nb, D, B, S)
+
+
+def _impurity_from_stats(stats, kind: str):
+    """stats (..., S) -> (impurity, count, value).
+    regression: S=[w, wy, wy2] -> variance; classification: S=class counts
+    -> gini or entropy; value = mean or class distribution."""
+    if kind == "regression":
+        w = stats[..., 0]
+        mean = stats[..., 1] / jnp.maximum(w, 1e-12)
+        var = stats[..., 2] / jnp.maximum(w, 1e-12) - mean**2
+        return jnp.maximum(var, 0.0), w, mean[..., None]
+    counts = stats
+    w = counts.sum(axis=-1)
+    p = counts / jnp.maximum(w, 1e-12)[..., None]
+    if kind == "entropy":
+        imp = -(p * jnp.log2(jnp.maximum(p, 1e-12))).sum(axis=-1)
+    else:  # gini
+        imp = 1.0 - (p * p).sum(axis=-1)
+    return imp, w, p
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins", "node_batch", "kind", "max_features"),
+)
+def level_split_kernel(
+    Xb: jax.Array,
+    stats: jax.Array,
+    rel_node: jax.Array,
+    key: jax.Array,
+    n_nodes: int,
+    n_bins: int,
+    node_batch: int,
+    kind: str,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+):
+    """One level of growth: chunked histograms -> best (feature, bin) per
+    node.  Only one (node_batch, D, B, S) histogram is live at a time; per
+    node only scalars + the value vector escape the chunk loop.
+
+    Returns (best_feature (n,), best_bin (n,), split_ok (n,), node_count (n,),
+    node_impurity (n,), node_value (n, V)).
+    """
+    D = Xb.shape[1]
+    n_chunks = -(-n_nodes // node_batch)
+
+    def one_chunk(c):
+        lo = c * node_batch
+        hist = _chunk_histogram(Xb, stats, rel_node, lo, node_batch, n_bins)
+        left = jnp.cumsum(hist, axis=2)          # (nb, D, B, S)
+        total = left[:, :, -1:, :]
+        right = total - left
+        l_imp, l_w, _ = _impurity_from_stats(left, kind)
+        r_imp, r_w, _ = _impurity_from_stats(right, kind)
+        node_stats = total[:, 0, 0, :]           # identical across features
+        p_imp, p_w, p_val = _impurity_from_stats(node_stats, kind)
+        # weighted impurity decrease (Spark/cuml gain semantics)
+        gain = p_imp[:, None, None] * p_w[:, None, None] - (l_imp * l_w + r_imp * r_w)
+        ok = (l_w >= min_samples_leaf) & (r_w >= min_samples_leaf)
+        gain = jnp.where(ok, gain, -jnp.inf)
+        gain = gain.at[:, :, -1].set(-jnp.inf)   # last bin = empty right side
+        if max_features < D:
+            # per-node random feature subset (featureSubsetStrategy)
+            scores = jax.random.uniform(
+                jax.random.fold_in(key, c), (node_batch, D)
+            )
+            kth = -jnp.sort(-scores, axis=1)[:, max_features - 1]
+            fmask = scores >= kth[:, None]
+            gain = jnp.where(fmask[:, :, None], gain, -jnp.inf)
+        flat = gain.reshape(node_batch, -1)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        return (
+            (best // n_bins).astype(jnp.int32),
+            (best % n_bins).astype(jnp.int32),
+            best_gain,
+            p_w,
+            p_imp,
+            p_val,
+        )
+
+    bf, bb, bg, p_w, p_imp, p_val = jax.lax.map(one_chunk, jnp.arange(n_chunks))
+    bf = bf.reshape(-1)[:n_nodes]
+    bb = bb.reshape(-1)[:n_nodes]
+    bg = bg.reshape(-1)[:n_nodes]
+    p_w = p_w.reshape(-1)[:n_nodes]
+    p_imp = p_imp.reshape(-1)[:n_nodes]
+    p_val = p_val.reshape(n_chunks * node_batch, -1)[:n_nodes]
+    split_ok = (
+        jnp.isfinite(bg)
+        & (bg > jnp.maximum(min_impurity_decrease * p_w, 1e-7))
+        & (p_w >= 2 * min_samples_leaf)
+    )
+    return bf, bb, split_ok, p_w, p_imp, p_val
+
+
+@jax.jit
+def route_rows_kernel(Xb, rel_node, abs_node, best_feature, best_bin, split_ok):
+    """Send each active row to its child; rows on leaf nodes become inactive.
+
+    rel_node: index within level (sentinel n_nodes for inactive);
+    abs_node: dense-tree absolute index.  Returns (new_rel, new_abs)."""
+    n_nodes = best_feature.shape[0]
+    active = rel_node < n_nodes
+    safe_rel = jnp.minimum(rel_node, n_nodes - 1)
+    f = best_feature[safe_rel]
+    b = best_bin[safe_rel]
+    ok = split_ok[safe_rel] & active
+    row_bin = jnp.take_along_axis(Xb, f[:, None].astype(jnp.int32), axis=1)[:, 0]
+    go_right = (row_bin > b).astype(jnp.int32)
+    new_rel = jnp.where(ok, 2 * rel_node + go_right, 2 * n_nodes)
+    new_abs = jnp.where(ok, 2 * abs_node + 1 + go_right, abs_node)
+    return new_rel, new_abs
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_predict_kernel(
+    X: jax.Array,
+    feature: jax.Array,    # (T, M) int32
+    threshold: jax.Array,  # (T, M) float32
+    leaf_value: jax.Array, # (T, M, V)
+    max_depth: int,
+) -> jax.Array:
+    """Average of per-tree leaf values, (N, V).  max_depth gather/compare
+    steps; vmapped over trees."""
+
+    def one_tree(feat, thr, values):
+        def step(_, node):
+            f = feat[node]
+            is_leaf = f < 0
+            x = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+            child = 2 * node + 1 + (x > thr[node]).astype(jnp.int32)
+            return jnp.where(is_leaf, node, child)
+
+        node = jax.lax.fori_loop(
+            0, max_depth, step, jnp.zeros(X.shape[0], jnp.int32)
+        )
+        return values[node]
+
+    per_tree = jax.vmap(one_tree)(feature, threshold, leaf_value)  # (T, N, V)
+    return per_tree.mean(axis=0)
+
+
+def grow_tree(
+    Xb: jax.Array,
+    stats: jax.Array,
+    edges: np.ndarray,
+    max_depth: int,
+    n_bins: int,
+    kind: str,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+    seed: int,
+    node_batch: int = 256,
+) -> TreeArrays:
+    """Grow one tree level-by-level (host loop over <= max_depth jitted
+    levels; each level kernel is compiled once per shape and cached)."""
+    N, D = Xb.shape
+    V = 1 if kind == "regression" else stats.shape[1]
+    M = 2 ** (max_depth + 1) - 1
+    feature = np.full(M, -1, np.int32)
+    threshold = np.zeros(M, np.float32)
+    leaf_value = np.zeros((M, V), np.float32)
+    n_samples = np.zeros(M, np.float32)
+    impurity = np.zeros(M, np.float32)
+
+    rel = jnp.zeros(N, jnp.int32)
+    abs_node = jnp.zeros(N, jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    for level in range(max_depth + 1):
+        n_nodes = 2**level
+        key, kl = jax.random.split(key)
+        nb = min(node_batch, n_nodes)
+        bf, bb, ok, cnt, imp, val = level_split_kernel(
+            Xb, stats, rel, kl,
+            n_nodes=n_nodes, n_bins=n_bins, node_batch=nb, kind=kind,
+            max_features=max_features, min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease,
+        )
+        if level == max_depth:
+            ok = jnp.zeros_like(ok)
+        bf_h, bb_h, ok_h = np.asarray(bf), np.asarray(bb), np.asarray(ok)
+        cnt_h, imp_h, val_h = np.asarray(cnt), np.asarray(imp), np.asarray(val)
+        base = 2**level - 1  # absolute index of first node in this level
+        sl = slice(base, base + n_nodes)
+        n_samples[sl] = cnt_h
+        impurity[sl] = imp_h
+        # every node records its value; internal nodes keep it for export,
+        # rows that stop here read it as the leaf value
+        leaf_value[sl] = val_h
+        feature[sl] = np.where(ok_h, bf_h, -1)
+        threshold[sl] = np.where(
+            ok_h, edges[np.minimum(bf_h, D - 1), np.minimum(bb_h, edges.shape[1] - 1)], 0.0
+        )
+        if not ok_h.any() or level == max_depth:
+            break
+        rel, abs_node = route_rows_kernel(Xb, rel, abs_node, bf, bb, ok)
+    return TreeArrays(
+        feature=jnp.asarray(feature),
+        threshold=jnp.asarray(threshold),
+        leaf_value=jnp.asarray(leaf_value),
+        n_samples=jnp.asarray(n_samples),
+        impurity=jnp.asarray(impurity),
+    )

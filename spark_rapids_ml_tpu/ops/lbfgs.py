@@ -149,6 +149,11 @@ def minimize_lbfgs(
         _, x_new, f_new, g_new, _, ls_ok = jax.lax.while_loop(
             ls_cond, ls_body, (t0, x, f, g, jnp.array(0, jnp.int32), jnp.array(False))
         )
+        # on line-search exhaustion keep the current iterate (the last trial
+        # point failed Armijo and may be worse) and stop
+        x_new = jnp.where(ls_ok, x_new, x)
+        f_new = jnp.where(ls_ok, f_new, f)
+        g_new = jnp.where(ls_ok, g_new, g)
 
         s = x_new - x
         y = g_new - g

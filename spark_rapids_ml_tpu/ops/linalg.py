@@ -78,16 +78,3 @@ def pca_transform_kernel(X: jax.Array, components: jax.Array) -> jax.Array:
     return X @ components.T
 
 
-def gram_and_xty(
-    X: jax.Array, y: jax.Array, w: jax.Array
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Weighted normal-equation statistics in one fused pass:
-    returns (wsum, x_mean, y_mean, XtWX, XtWy) — inputs row-sharded, outputs
-    replicated (psum'd)."""
-    wsum = w.sum()
-    Xw = X * w[:, None]
-    x_mean = Xw.sum(axis=0) / wsum
-    y_mean = (y * w).sum() / wsum
-    XtWX = Xw.T @ X
-    XtWy = Xw.T @ y
-    return wsum, x_mean, y_mean, XtWX, XtWy

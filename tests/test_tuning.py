@@ -1,0 +1,110 @@
+# CrossValidator single-pass multi-model CV tests (strategy modeled on the
+# reference's test_tuning.py / per-algo test_crossvalidator tests).
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import LinearRegression, LogisticRegression
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.evaluation import (
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+)
+
+
+def _reg_df(n=300, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    y = X @ rng.normal(size=d) + 0.5 * rng.normal(size=n)
+    return DataFrame.from_numpy(X, y=y, num_partitions=4), X, y
+
+
+def test_param_grid_builder():
+    grid = (
+        ParamGridBuilder()
+        .addGrid(LinearRegression.regParam, [0.0, 0.1])
+        .addGrid(LinearRegression.elasticNetParam, [0.0, 0.5, 1.0])
+        .build()
+    )
+    assert len(grid) == 6
+    assert all(LinearRegression.regParam in pm for pm in grid)
+
+
+def test_cv_regression_single_pass():
+    df, X, y = _reg_df()
+    est = LinearRegression(standardization=False)
+    grid = ParamGridBuilder().addGrid(LinearRegression.regParam, [0.0, 10.0]).build()
+    eva = RegressionEvaluator(metricName="rmse")
+    assert est._supportsTransformEvaluate(eva)
+    cv = CrossValidator(
+        estimator=est, estimatorParamMaps=grid, evaluator=eva, numFolds=3, seed=5
+    )
+    cv_model = cv.fit(df)
+    assert len(cv_model.avgMetrics) == 2
+    # regParam=0 must beat absurd regParam=10 on rmse
+    assert cv_model.avgMetrics[0] < cv_model.avgMetrics[1]
+    assert cv_model.bestModel.getOrDefault("regParam") == 0.0
+    out = cv_model.transform(df)
+    assert "prediction" in out.columns
+
+
+def test_cv_classification_single_pass():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(300, 5))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=3)
+    est = LogisticRegression(maxIter=100)
+    grid = ParamGridBuilder().addGrid(LogisticRegression.regParam, [0.01, 50.0]).build()
+    eva = MulticlassClassificationEvaluator(metricName="accuracy")
+    cv = CrossValidator(estimator=est, estimatorParamMaps=grid, evaluator=eva, numFolds=3)
+    cv_model = cv.fit(df)
+    assert cv_model.avgMetrics[0] > cv_model.avgMetrics[1]
+    assert cv_model.bestModel.getOrDefault("regParam") == 0.01
+
+
+def test_cv_parallel_folds_match_serial():
+    df, _, _ = _reg_df()
+    est = LinearRegression(standardization=False)
+    grid = ParamGridBuilder().addGrid(LinearRegression.regParam, [0.0, 1.0]).build()
+    eva = RegressionEvaluator()
+    m1 = CrossValidator(estimator=est, estimatorParamMaps=grid, evaluator=eva, seed=3).fit(df)
+    m2 = CrossValidator(
+        estimator=est, estimatorParamMaps=grid, evaluator=eva, seed=3, parallelism=3
+    ).fit(df)
+    np.testing.assert_allclose(m1.avgMetrics, m2.avgMetrics, rtol=1e-6)
+
+
+def test_cv_collect_sub_models():
+    df, _, _ = _reg_df(n=200)
+    est = LinearRegression(standardization=False)
+    grid = ParamGridBuilder().addGrid(LinearRegression.regParam, [0.0, 0.5]).build()
+    cv = CrossValidator(
+        estimator=est,
+        estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(),
+        numFolds=2,
+        collectSubModels=True,
+    )
+    cv_model = cv.fit(df)
+    assert cv_model.subModels is not None
+    assert len(cv_model.subModels) == 2
+    assert len(cv_model.subModels[0]) == 2
+
+
+def test_cv_model_persistence(tmp_path):
+    df, _, _ = _reg_df(n=150)
+    est = LinearRegression()
+    grid = ParamGridBuilder().addGrid(LinearRegression.regParam, [0.0, 0.1]).build()
+    cv = CrossValidator(estimator=est, estimatorParamMaps=grid, evaluator=RegressionEvaluator())
+    cv_model = cv.fit(df)
+    path = str(tmp_path / "cv")
+    cv_model.save(path)
+    loaded = CrossValidatorModel.load(path)
+    np.testing.assert_allclose(loaded.avgMetrics, cv_model.avgMetrics)
+    p1 = cv_model.transform(df).toPandas()["prediction"]
+    p2 = loaded.transform(df).toPandas()["prediction"]
+    np.testing.assert_allclose(p1, p2, atol=1e-7)
