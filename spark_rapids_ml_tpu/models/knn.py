@@ -1,0 +1,210 @@
+#
+# Exact NearestNeighbors estimator/model.
+#
+# Capability parity with the reference's NearestNeighbors
+# (/root/reference/python/src/spark_rapids_ml/knn.py:154-683): fit just
+# captures the item dataframe (no training, knn.py:297-317), kneighbors
+# returns (item_df_withid, query_df_withid, knn_df(query_id, indices,
+# distances)) with euclidean distances and float32 inputs (knn.py:411-466),
+# exactNearestNeighborsJoin builds the exploded join frame (knn.py:604-672),
+# and neither estimator nor model is persistable (knn.py:333-345, 674-683).
+# The UCX p2p partition exchange is replaced by the mesh block schedule in
+# ops/knn.py.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from ..core import _TpuEstimatorSupervised, _TpuModel
+from ..dataframe import DataFrame, as_dataframe
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    Param,
+    TypeConverters,
+    _dummy,
+    _TpuParams,
+)
+from ..parallel.mesh import get_mesh
+from ..ops.knn import knn_search
+from ..utils import stack_feature_cells
+
+
+class NearestNeighborsClass(_TpuParams):
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {"k": "n_neighbors"}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {"n_neighbors": 5, "verbose": False, "algorithm": "brute", "metric": "euclidean"}
+
+
+class _NearestNeighborsParams(NearestNeighborsClass, HasFeaturesCol, HasFeaturesCols):
+    k = Param(_dummy(), "k", "the number of nearest neighbors to retrieve (> 0)", TypeConverters.toInt)
+    idCol = Param(_dummy(), "idCol", "id column name; if unset a monotonically increasing id column is generated", TypeConverters.toString)
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._setDefault(k=5)
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def setK(self, value: int):
+        return self._set_params(k=value)
+
+    def getIdCol(self) -> str:
+        return self.getOrDefault("idCol") if self.isDefined("idCol") else "unique_id"
+
+    def setIdCol(self, value: str):
+        self.set(self.getParam("idCol"), value)
+        return self
+
+    def setInputCol(self, value: Union[str, List[str]]):
+        if isinstance(value, str):
+            self._set_params(featuresCol=value)
+        else:
+            self._set_params(featuresCols=value)
+        return self
+
+
+class NearestNeighbors(_NearestNeighborsParams, _TpuEstimatorSupervised):
+    """Exact brute-force kNN over the TPU mesh (API parity knn.py:154-345)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def _fit(self, dataset: Any) -> "NearestNeighborsModel":
+        df = as_dataframe(dataset)
+        if not self.isDefined("idCol"):
+            df = df.with_row_id("unique_id")
+        model = NearestNeighborsModel(item_df=df)
+        self._copyValues(model)
+        model._tpu_params.update(self._tpu_params)
+        model._num_workers = self._num_workers
+        model._float32_inputs = self._float32_inputs
+        model._item_df = df
+        return model
+
+    def fit(self, dataset: Any, params: Optional[Dict] = None) -> "NearestNeighborsModel":
+        return self._fit(dataset)
+
+    def _get_tpu_fit_func(self, dataset, extra_params=None):  # pragma: no cover
+        raise NotImplementedError("NearestNeighbors overrides _fit")
+
+    def _create_model(self, result):  # pragma: no cover
+        raise NotImplementedError("NearestNeighbors overrides _fit")
+
+    def write(self):
+        raise NotImplementedError(
+            "NearestNeighbors does not support saving/loading, just re-create the estimator."
+        )
+
+    @classmethod
+    def read(cls):
+        raise NotImplementedError(
+            "NearestNeighbors does not support saving/loading, just re-create the estimator."
+        )
+
+
+class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
+    def __init__(self, item_df: Optional[DataFrame] = None, **kwargs: Any) -> None:
+        super().__init__()
+        self._item_df = item_df
+
+    def _extract_features(self, df: DataFrame, dtype) -> np.ndarray:
+        input_col, input_cols = self._get_input_columns()
+        parts = []
+        for part in df.partitions:
+            if len(part) == 0:
+                continue
+            if input_col is not None:
+                parts.append(stack_feature_cells(part[input_col].tolist(), dtype))
+            else:
+                parts.append(np.asarray(part[input_cols].to_numpy(), dtype=dtype))
+        if not parts:
+            return np.zeros((0, 0), dtype=dtype)
+        return np.concatenate(parts, axis=0)
+
+    def kneighbors(
+        self, query_df: Any
+    ) -> Tuple[DataFrame, DataFrame, DataFrame]:
+        """Exact k nearest item neighbors for every query row; float32
+        euclidean (the reference converts all input to float32, knn.py:425)."""
+        assert self._item_df is not None, "fit() must be called before kneighbors"
+        qdf = as_dataframe(query_df)
+        id_col = self.getIdCol()
+        if id_col not in qdf.columns:
+            qdf = qdf.with_row_id(id_col)
+        dtype = np.float32
+        items = self._extract_features(self._item_df, dtype)
+        queries = self._extract_features(qdf, dtype)
+        if queries.shape[0] == 0:
+            empty = pd.DataFrame(
+                {f"query_{id_col}": [], "indices": [], "distances": []}
+            )
+            return self._item_df, qdf, DataFrame.from_pandas(empty, 1)
+        item_ids = self._item_df.toPandas()[id_col].to_numpy()
+        query_ids = qdf.toPandas()[id_col].to_numpy()
+        k = min(self.getK(), items.shape[0])
+        mesh = get_mesh(self.num_workers)
+        dists, ids = knn_search(items, item_ids.astype(np.int64), queries, k, mesh)
+        knn_pdf = pd.DataFrame(
+            {
+                f"query_{id_col}": query_ids,
+                "indices": list(ids.astype(item_ids.dtype)),
+                "distances": list(dists.astype(np.float32)),
+            }
+        )
+        knn_df = DataFrame.from_pandas(knn_pdf, qdf.num_partitions)
+        return self._item_df, qdf, knn_df
+
+    def exactNearestNeighborsJoin(
+        self, query_df: Any, distCol: str = "distCol"
+    ) -> DataFrame:
+        """Exploded knn join: rows (item_df struct, query_df struct, distCol)
+        (reference knn.py:604-672; structs here are dicts of the source
+        rows)."""
+        id_col = self.getIdCol()
+        item_df, query_df_withid, knn_df = self.kneighbors(query_df)
+        item_pdf = item_df.toPandas().set_index(id_col, drop=False)
+        query_pdf = query_df_withid.toPandas().set_index(id_col, drop=False)
+        drop_generated = not self.isDefined("idCol")
+        rows = []
+        for _, row in knn_df.toPandas().iterrows():
+            qid = row[f"query_{id_col}"]
+            q_struct = query_pdf.loc[qid].to_dict()
+            if drop_generated:
+                q_struct.pop(id_col, None)
+            for item_id, dist in zip(row["indices"], row["distances"]):
+                i_struct = item_pdf.loc[item_id].to_dict()
+                if drop_generated:
+                    i_struct.pop(id_col, None)
+                rows.append(
+                    {"item_df": i_struct, "query_df": q_struct, distCol: float(dist)}
+                )
+        return DataFrame.from_pandas(pd.DataFrame(rows), query_df_withid.num_partitions)
+
+    def _get_tpu_transform_func(self, dataset):  # pragma: no cover
+        raise NotImplementedError(
+            "NearestNeighborsModel has no transform; use kneighbors instead."
+        )
+
+    def write(self):
+        raise NotImplementedError(
+            "NearestNeighborsModel does not support saving/loading, just re-fit the estimator to re-create a model."
+        )
+
+    @classmethod
+    def read(cls):
+        raise NotImplementedError(
+            "NearestNeighborsModel does not support saving/loading, just re-fit the estimator to re-create a model."
+        )

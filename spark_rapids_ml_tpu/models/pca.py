@@ -106,8 +106,9 @@ class PCA(_PCAParams, _TpuEstimator):
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
             k = params.get("n_components") or min(inputs.n_rows, inputs.n_cols)
             k = min(int(k), inputs.n_cols)
+            # whiten is honored at transform time (see PCAModel)
             mean, components, var, ratio, sv = pca_fit_kernel(
-                inputs.X, inputs.weight, k, bool(params.get("whiten", False))
+                inputs.X, inputs.weight, k
             )
             return {
                 "mean_": np.asarray(mean, dtype=np.float64),

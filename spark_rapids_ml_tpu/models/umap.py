@@ -1,0 +1,229 @@
+#
+# UMAP estimator/model.
+#
+# Capability parity with the reference's UMAP/UMAPModel
+# (/root/reference/python/src/spark_rapids_ml/umap.py:88-1321): the same 17
+# solver params (umap.py:95-115) plus sample_fraction (umap.py:332-341), fit
+# on (optionally sampled) data with the model carrying embedding_ + raw
+# training data for transform (umap.py:831-910), and distributed transform
+# that projects each batch against the broadcast model (umap.py:1147-1224).
+# Differences by design: the kNN graph is built by the mesh-distributed
+# exact kNN kernel instead of single-GPU cuML, so fit itself scales across
+# the mesh; "spectral" init is approximated by a scaled PCA projection;
+# transform uses the weighted-neighbor-mean initialization without SGD
+# refinement epochs.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ..core import FitInputs, _TpuEstimator, _TpuModel
+from ..dataframe import DataFrame, as_dataframe
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasOutputCol,
+    Param,
+    TypeConverters,
+    _dummy,
+    _TpuParams,
+)
+from ..parallel.mesh import get_mesh
+from ..ops.knn import knn_search
+from ..ops.umap import (
+    find_ab_params,
+    umap_fit_embedding,
+    umap_transform_embedding,
+)
+from ..utils import get_logger
+
+
+class UMAPClass(_TpuParams):
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_neighbors": 15,
+            "n_components": 2,
+            "metric": "euclidean",
+            "n_epochs": None,
+            "learning_rate": 1.0,
+            "init": "spectral",
+            "min_dist": 0.1,
+            "spread": 1.0,
+            "set_op_mix_ratio": 1.0,
+            "local_connectivity": 1.0,
+            "repulsion_strength": 1.0,
+            "negative_sample_rate": 5,
+            "transform_queue_size": 4.0,
+            "a": None,
+            "b": None,
+            "precomputed_knn": None,
+            "random_state": None,
+            "verbose": False,
+        }
+
+
+class _UMAPParams(UMAPClass, HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol):
+    n_neighbors = Param(_dummy(), "n_neighbors", "size of the local neighborhood", TypeConverters.toFloat)
+    n_components = Param(_dummy(), "n_components", "dimension of the embedded space", TypeConverters.toInt)
+    metric = Param(_dummy(), "metric", "distance metric (euclidean)", TypeConverters.toString)
+    n_epochs = Param(_dummy(), "n_epochs", "number of optimization epochs", TypeConverters.toInt)
+    learning_rate = Param(_dummy(), "learning_rate", "initial embedding learning rate", TypeConverters.toFloat)
+    init = Param(_dummy(), "init", "low-dim initialization (spectral|random)", TypeConverters.toString)
+    min_dist = Param(_dummy(), "min_dist", "minimum embedded point distance", TypeConverters.toFloat)
+    spread = Param(_dummy(), "spread", "scale of the embedded points", TypeConverters.toFloat)
+    set_op_mix_ratio = Param(_dummy(), "set_op_mix_ratio", "fuzzy union vs intersection mix", TypeConverters.toFloat)
+    local_connectivity = Param(_dummy(), "local_connectivity", "local connectivity (nearest assumed-connected neighbors)", TypeConverters.toFloat)
+    repulsion_strength = Param(_dummy(), "repulsion_strength", "weight of negative samples", TypeConverters.toFloat)
+    negative_sample_rate = Param(_dummy(), "negative_sample_rate", "negative samples per positive", TypeConverters.toInt)
+    transform_queue_size = Param(_dummy(), "transform_queue_size", "transform search queue factor", TypeConverters.toFloat)
+    a = Param(_dummy(), "a", "embedding curve parameter a", TypeConverters.toFloat)
+    b = Param(_dummy(), "b", "embedding curve parameter b", TypeConverters.toFloat)
+    random_state = Param(_dummy(), "random_state", "random seed", TypeConverters.toInt)
+    sample_fraction = Param(_dummy(), "sample_fraction", "fraction of rows used for fit (umap.py:332-341)", TypeConverters.toFloat)
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._setDefault(sample_fraction=1.0, outputCol="embedding")
+
+    def getSampleFraction(self) -> float:
+        return self.getOrDefault("sample_fraction")
+
+    def setSampleFraction(self, value: float):
+        return self._set_params(sample_fraction=value)
+
+    def setOutputCol(self, value: str):
+        return self._set_params(outputCol=value)
+
+    def setFeaturesCol(self, value: Union[str, List[str]]):
+        if isinstance(value, str):
+            self._set_params(featuresCol=value)
+        else:
+            self._set_params(featuresCols=value)
+        return self
+
+
+class UMAP(_UMAPParams, _TpuEstimator):
+    """UMAP on a TPU mesh: exact mesh-distributed kNN graph, vectorized
+    fuzzy-set calibration, one-jit SGD layout."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._initialize_tpu_params()
+        # solver params are exposed both as spark Params and solver kwargs
+        for name in list(kwargs):
+            if self.hasParam(name) and name in self._tpu_params:
+                self._tpu_params[name] = kwargs[name]
+                self.set(self.getParam(name), kwargs[name])
+                kwargs.pop(name)
+        self._set_params(**kwargs)
+
+    def _get_tpu_fit_func(self, dataset: DataFrame, extra_params=None):
+        logger = get_logger(type(self))
+        sample_fraction = self.getSampleFraction()
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]):
+            X = np.asarray(inputs.X)[np.asarray(inputs.weight) > 0]
+            seed = params.get("random_state")
+            seed = int(seed) & 0x7FFFFFFF if seed is not None else 42
+            if sample_fraction < 1.0:
+                rng = np.random.default_rng(seed)
+                keep = rng.random(X.shape[0]) < sample_fraction
+                X = X[keep]
+            n = X.shape[0]
+            k = int(min(params["n_neighbors"], n))
+            mesh = get_mesh(self.num_workers)
+            dists, ids = knn_search(
+                X, np.arange(n, dtype=np.int64), X, k, mesh
+            )
+            a, b = params.get("a"), params.get("b")
+            if a is None or b is None:
+                a, b = find_ab_params(
+                    float(params["spread"]), float(params["min_dist"])
+                )
+            logger.info("UMAP graph built: n=%d k=%d (a=%.3f b=%.3f)", n, k, a, b)
+            embedding = umap_fit_embedding(
+                X,
+                ids,
+                dists,
+                n_components=int(params["n_components"]),
+                a=a,
+                b=b,
+                n_epochs=params.get("n_epochs"),
+                learning_rate=float(params["learning_rate"]),
+                init=str(params["init"]),
+                set_op_mix_ratio=float(params["set_op_mix_ratio"]),
+                local_connectivity=float(params["local_connectivity"]),
+                repulsion_strength=float(params["repulsion_strength"]),
+                negative_sample_rate=int(params["negative_sample_rate"]),
+                seed=seed,
+            )
+            return {
+                "embedding_": embedding.astype(np.float32),
+                "raw_data_": X.astype(np.float32),
+                "n_cols": inputs.n_cols,
+                "dtype": str(inputs.dtype),
+            }
+
+        return _fit
+
+    def _create_model(self, result: Dict[str, Any]) -> "UMAPModel":
+        return UMAPModel(**result)
+
+
+class UMAPModel(_UMAPParams, _TpuModel):
+    def __init__(
+        self,
+        embedding_: np.ndarray,
+        raw_data_: np.ndarray,
+        n_cols: int,
+        dtype: str,
+    ) -> None:
+        super().__init__(
+            embedding_=np.asarray(embedding_),
+            raw_data_=np.asarray(raw_data_),
+            n_cols=int(n_cols),
+            dtype=str(dtype),
+        )
+        self.embedding_ = np.asarray(embedding_)
+        self.raw_data_ = np.asarray(raw_data_)
+        self.n_cols = int(n_cols)
+        self.dtype = str(dtype)
+
+    @property
+    def embedding(self) -> np.ndarray:
+        return self.embedding_
+
+    def _out_columns(self) -> List[str]:
+        return [self.getOrDefault("outputCol")]
+
+    def _get_tpu_transform_func(self, dataset: DataFrame):
+        out_col = self.getOrDefault("outputCol")
+        k = int(min(self._tpu_params.get("n_neighbors", 15), self.raw_data_.shape[0]))
+        local_connectivity = float(self._tpu_params.get("local_connectivity", 1.0))
+        mesh = get_mesh(self.num_workers)
+        from ..ops.knn import knn_search_prepared, prepare_items
+
+        # shard the training set to device ONCE; reused by every partition
+        prepared = prepare_items(
+            self.raw_data_,
+            np.arange(self.raw_data_.shape[0], dtype=np.int64),
+            mesh,
+        )
+
+        def _transform(features: np.ndarray) -> Dict[str, Any]:
+            dists, ids = knn_search_prepared(prepared, features, k, mesh)
+            emb = umap_transform_embedding(
+                ids, dists, self.embedding_, local_connectivity
+            )
+            return {out_col: emb.astype(np.float64)}
+
+        return _transform

@@ -177,9 +177,12 @@ def level_split_kernel(
     p_w = p_w.reshape(-1)[:n_nodes]
     p_imp = p_imp.reshape(-1)[:n_nodes]
     p_val = p_val.reshape(n_chunks * node_batch, -1)[:n_nodes]
+    # float-noise guard scales with the parent's weighted impurity so tiny
+    # label magnitudes still split (an absolute floor would not)
+    noise_floor = 1e-6 * p_imp * p_w + 1e-30
     split_ok = (
         jnp.isfinite(bg)
-        & (bg > jnp.maximum(min_impurity_decrease * p_w, 1e-7))
+        & (bg > jnp.maximum(min_impurity_decrease * p_w, noise_floor))
         & (p_w >= 2 * min_samples_leaf)
     )
     return bf, bb, split_ok, p_w, p_imp, p_val

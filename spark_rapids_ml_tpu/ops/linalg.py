@@ -39,9 +39,9 @@ def weighted_moments(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, 
     return wsum, mean, scatter
 
 
-@partial(jax.jit, static_argnames=("k", "whiten"))
+@partial(jax.jit, static_argnames=("k",))
 def pca_fit_kernel(
-    X: jax.Array, w: jax.Array, k: int, whiten: bool = False
+    X: jax.Array, w: jax.Array, k: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Distributed PCA via covariance + eigh.
 

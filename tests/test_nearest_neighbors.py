@@ -1,0 +1,86 @@
+# Exact kNN correctness vs sklearn (strategy modeled on the reference's
+# test_nearest_neighbors.py).
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import NearestNeighbors
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+
+def _data(n_items=200, n_queries=30, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n_items, d)), rng.normal(size=(n_queries, d))
+
+
+def test_kneighbors_matches_sklearn():
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    items, queries = _data()
+    item_df = DataFrame.from_numpy(items, num_partitions=4)
+    query_df = DataFrame.from_numpy(queries, num_partitions=2)
+    model = NearestNeighbors(k=7).fit(item_df)
+    item_out, query_out, knn_df = model.kneighbors(query_df)
+    pdf = knn_df.toPandas().sort_values("query_unique_id").reset_index(drop=True)
+    got_idx = np.stack(pdf["indices"].to_numpy())
+    got_dist = np.stack(pdf["distances"].to_numpy())
+
+    sk = SkNN(n_neighbors=7).fit(items.astype(np.float32))
+    exp_dist, exp_idx = sk.kneighbors(queries.astype(np.float32))
+    np.testing.assert_array_equal(got_idx, exp_idx)
+    np.testing.assert_allclose(got_dist, exp_dist, atol=1e-4)
+    # distances ascending
+    assert (np.diff(got_dist, axis=1) >= -1e-6).all()
+
+
+def test_kneighbors_custom_id_col():
+    items, queries = _data(n_items=50, n_queries=5)
+    ids = np.arange(100, 150)
+    pdf = pd.DataFrame({"features": list(items), "my_id": ids})
+    item_df = DataFrame.from_pandas(pdf, 3)
+    model = NearestNeighbors(k=3).setIdCol("my_id").fit(item_df)
+    qdf = pd.DataFrame({"features": list(queries), "my_id": np.arange(5)})
+    _, _, knn_df = model.kneighbors(DataFrame.from_pandas(qdf, 1))
+    out = knn_df.toPandas()
+    assert "query_my_id" in out.columns
+    all_ids = np.concatenate(out["indices"].to_numpy())
+    assert all_ids.min() >= 100 and all_ids.max() < 150
+
+
+def test_k_larger_than_items():
+    items, queries = _data(n_items=4, n_queries=3)
+    model = NearestNeighbors(k=10).fit(DataFrame.from_numpy(items))
+    _, _, knn_df = model.kneighbors(DataFrame.from_numpy(queries))
+    assert len(knn_df.toPandas()["indices"].iloc[0]) == 4
+
+
+def test_exact_nearest_neighbors_join():
+    items, queries = _data(n_items=40, n_queries=6)
+    model = NearestNeighbors(k=2).fit(DataFrame.from_numpy(items, num_partitions=2))
+    join_df = model.exactNearestNeighborsJoin(
+        DataFrame.from_numpy(queries), distCol="dist"
+    )
+    pdf = join_df.toPandas()
+    assert len(pdf) == 6 * 2
+    assert set(pdf.columns) == {"item_df", "query_df", "dist"}
+    row = pdf.iloc[0]
+    assert "features" in row["item_df"] and "features" in row["query_df"]
+    # generated id column dropped from structs (reference knn.py:663-670)
+    assert "unique_id" not in row["item_df"]
+
+
+def test_no_persistence():
+    items, _ = _data(n_items=20)
+    nn = NearestNeighbors(k=2)
+    with pytest.raises(NotImplementedError):
+        nn.write()
+    model = nn.fit(DataFrame.from_numpy(items))
+    with pytest.raises(NotImplementedError):
+        model.write()
+
+
+def test_param_mapping():
+    nn = NearestNeighbors(k=9)
+    assert nn.tpu_params["n_neighbors"] == 9
+    nn = NearestNeighbors(n_neighbors=4)
+    assert nn.getK() == 4

@@ -130,9 +130,9 @@ def test_min_instances_per_node():
     X, y = _cls_data(n=300, k=2)
     df = DataFrame.from_numpy(X, y=y, num_partitions=2)
     model = RandomForestClassifier(numTrees=5, maxDepth=8, minInstancesPerNode=50, seed=2).fit(df)
-    # all recorded (trained) nodes must carry >= 50 samples
-    counts = model.node_counts_[model.features_ >= 0]
-    assert counts.min() >= 50 * 0.0 or True  # parent counts
+    # every split node must have had >= 2*min instances to split at all
+    split_counts = model.node_counts_[model.features_ >= 0]
+    assert split_counts.min() >= 2 * 50
     # children of any split satisfy the constraint: check leaves reached by data
     leaf_counts = model.node_counts_[(model.features_ < 0) & (model.node_counts_ > 0)]
     assert leaf_counts.min() >= 50

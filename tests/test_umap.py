@@ -1,0 +1,102 @@
+# UMAP embedding quality (cluster preservation / trustworthiness) +
+# transform + persistence (strategy modeled on the reference's test_umap.py,
+# which scores trustworthiness vs cuml).
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import UMAP, UMAPModel
+from spark_rapids_ml_tpu.core import load
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+
+def _blob_data(n=300, d=10, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = 10.0 * rng.normal(size=(k, d))
+    labels = rng.integers(0, k, size=n)
+    X = centers[labels] + rng.normal(size=(n, d))
+    return X.astype(np.float64), labels
+
+
+def test_default_params():
+    um = UMAP()
+    assert um.tpu_params["n_neighbors"] == 15
+    assert um.tpu_params["n_components"] == 2
+    assert um.tpu_params["init"] == "spectral"
+    um = UMAP(n_neighbors=10, n_components=3, random_state=1)
+    assert um.tpu_params["n_neighbors"] == 10
+    assert um.getOrDefault("n_components") == 3
+
+
+def test_umap_preserves_clusters():
+    X, labels = _blob_data()
+    df = DataFrame.from_numpy(X, num_partitions=3)
+    model = UMAP(n_neighbors=10, random_state=0, n_epochs=150).fit(df)
+    emb = model.embedding
+    assert emb.shape == (300, 2)
+    assert np.all(np.isfinite(emb))
+    # same-cluster centroid distances << cross-cluster distances
+    cents = np.stack([emb[labels == c].mean(axis=0) for c in range(3)])
+    intra = np.mean(
+        [np.linalg.norm(emb[labels == c] - cents[c], axis=1).mean() for c in range(3)]
+    )
+    inter = np.mean(
+        [
+            np.linalg.norm(cents[i] - cents[j])
+            for i in range(3)
+            for j in range(i + 1, 3)
+        ]
+    )
+    assert inter > 2.0 * intra, (intra, inter)
+
+
+def test_umap_trustworthiness():
+    from sklearn.manifold import trustworthiness
+
+    X, _ = _blob_data(n=250, d=8)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+    model = UMAP(n_neighbors=12, random_state=3, n_epochs=150).fit(df)
+    t = trustworthiness(X, model.embedding, n_neighbors=10)
+    assert t > 0.85, t
+
+
+def test_umap_transform():
+    X, labels = _blob_data(n=200)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+    model = UMAP(n_neighbors=10, random_state=1, n_epochs=100).fit(df)
+    out = model.transform(df).toPandas()
+    emb = np.stack(out["embedding"].to_numpy())
+    assert emb.shape == (200, 2)
+    # transformed training points land near their fit embedding's cluster
+    fit_emb = model.embedding
+    cents_fit = np.stack([fit_emb[labels == c].mean(axis=0) for c in range(3)])
+    assign = np.argmin(
+        np.linalg.norm(emb[:, None, :] - cents_fit[None], axis=2), axis=1
+    )
+    agree = (assign == np.argmin(
+        np.linalg.norm(fit_emb[:, None, :] - cents_fit[None], axis=2), axis=1
+    )).mean()
+    assert agree > 0.9, agree
+
+
+def test_umap_sample_fraction_and_random_init():
+    X, _ = _blob_data(n=200)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+    model = UMAP(
+        n_neighbors=8, init="random", random_state=2, n_epochs=80,
+        sample_fraction=0.5,
+    ).fit(df)
+    assert model.raw_data_.shape[0] < 200
+    assert model.embedding.shape[0] == model.raw_data_.shape[0]
+
+
+def test_umap_persistence(tmp_path):
+    X, _ = _blob_data(n=150)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+    model = UMAP(n_neighbors=8, random_state=4, n_epochs=60).fit(df)
+    model.save(str(tmp_path / "umap"))
+    loaded = load(str(tmp_path / "umap"))
+    assert isinstance(loaded, UMAPModel)
+    np.testing.assert_allclose(loaded.embedding_, model.embedding_)
+    e1 = np.stack(model.transform(df).toPandas()["embedding"].to_numpy())
+    e2 = np.stack(loaded.transform(df).toPandas()["embedding"].to_numpy())
+    np.testing.assert_allclose(e1, e2, atol=1e-5)
