@@ -1,0 +1,86 @@
+#
+# Headline benchmark: KMeans fit throughput, mirroring the reference's
+# flagship workload (k=1000, maxIter=30, initMode=random on 1M x 3000
+# float32 rows; /root/reference/python/benchmark/databricks/run_benchmark.sh:45-55,
+# results in databricks/results/running_times.png: CPU 9526 s, GPU 82 s on
+# 2x A10G => ~12,195 rows/s).
+#
+# Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
+# is fit rows/sec on this host's devices and vs_baseline is the ratio to the
+# reference GPU cluster's 12,195 rows/s.
+#
+# Row count is scaled to the available memory by default (full 1M x 3000 is
+# 12 GB resident before solver workspace); override with env vars
+# SRML_BENCH_ROWS / SRML_BENCH_COLS / SRML_BENCH_K / SRML_BENCH_ITERS.
+#
+
+import json
+import os
+import time
+
+import numpy as np
+
+REF_GPU_SECONDS = 82.0  # running_times.png, 2x g5.2xlarge (A10G)
+REF_ROWS = 1_000_000
+BASELINE_ROWS_PER_SEC = REF_ROWS / REF_GPU_SECONDS
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    default_rows = 200_000 if platform != "cpu" else 20_000
+    default_cols = 3000 if platform != "cpu" else 256
+    default_k = 1000 if platform != "cpu" else 64
+    rows = int(os.environ.get("SRML_BENCH_ROWS", default_rows))
+    cols = int(os.environ.get("SRML_BENCH_COLS", default_cols))
+    k = int(os.environ.get("SRML_BENCH_K", default_k))
+    iters = int(os.environ.get("SRML_BENCH_ITERS", 30))
+
+    from spark_rapids_ml_tpu.ops.kmeans import lloyd_iterations, random_init
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh, shard_rows, data_sharding
+
+    rng = np.random.default_rng(42)
+    # blob-ish data so Lloyd doesn't converge degenerately in one step
+    centers_true = rng.standard_normal((k, cols)).astype(np.float32) * 3.0
+    assign = rng.integers(0, k, size=rows)
+    X_host = centers_true[assign] + rng.standard_normal((rows, cols)).astype(np.float32)
+
+    mesh = get_mesh()
+    Xs, _ = shard_rows(X_host, mesh)
+    w = jax.device_put(np.ones(Xs.shape[0], dtype=np.float32), data_sharding(mesh))
+    # Force the host->device transfer to finish before timing fit (through the
+    # axon dev tunnel block_until_ready is a no-op and device_put is lazy, so
+    # sync via a dependent scalar fetched to host).
+    float(np.asarray(Xs.sum()))
+    chunk = min(32768, Xs.shape[0])
+
+    def fit():
+        c0 = random_init(Xs, w, k, seed=1)
+        centers, n_iter, inertia = lloyd_iterations(
+            Xs, w, c0, mesh, max_iter=iters, tol=0.0, chunk=chunk
+        )
+        # np.asarray forces execution + fetch (block_until_ready alone does
+        # not synchronize through the tunnel)
+        return np.asarray(centers)
+
+    fit()  # compile (cached for the timed run)
+    t0 = time.perf_counter()
+    fit()
+    elapsed = time.perf_counter() - t0
+
+    rows_per_sec = rows / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"kmeans_fit_throughput_k{k}_d{cols}_iter{iters}",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/sec",
+                "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -45,7 +45,31 @@ from ..utils import get_logger
 class UMAPClass(_TpuParams):
     @classmethod
     def _param_mapping(cls) -> Dict[str, Optional[str]]:
-        return {}
+        # identity mapping: the reference exposes the solver params directly
+        # as Spark Params (umap.py:121-603), so any route that sets the Spark
+        # Param (copy(extra), tuning param maps, set()) must reach the solver
+        # dict too
+        return {
+            name: name
+            for name in (
+                "n_neighbors",
+                "n_components",
+                "metric",
+                "n_epochs",
+                "learning_rate",
+                "init",
+                "min_dist",
+                "spread",
+                "set_op_mix_ratio",
+                "local_connectivity",
+                "repulsion_strength",
+                "negative_sample_rate",
+                "transform_queue_size",
+                "a",
+                "b",
+                "random_state",
+            )
+        }
 
     @classmethod
     def _get_tpu_params_default(cls) -> Dict[str, Any]:
@@ -118,12 +142,6 @@ class UMAP(_UMAPParams, _TpuEstimator):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._initialize_tpu_params()
-        # solver params are exposed both as spark Params and solver kwargs
-        for name in list(kwargs):
-            if self.hasParam(name) and name in self._tpu_params:
-                self._tpu_params[name] = kwargs[name]
-                self.set(self.getParam(name), kwargs[name])
-                kwargs.pop(name)
         self._set_params(**kwargs)
 
     def _get_tpu_fit_func(self, dataset: DataFrame, extra_params=None):
@@ -139,11 +157,29 @@ class UMAP(_UMAPParams, _TpuEstimator):
                 keep = rng.random(X.shape[0]) < sample_fraction
                 X = X[keep]
             n = X.shape[0]
+            if n == 0:
+                raise RuntimeError(
+                    "UMAP fit received 0 rows after sampling "
+                    f"(sample_fraction={sample_fraction}); increase "
+                    "sample_fraction or the dataset size"
+                )
             k = int(min(params["n_neighbors"], n))
             mesh = get_mesh(self.num_workers)
-            dists, ids = knn_search(
-                X, np.arange(n, dtype=np.int64), X, k, mesh
-            )
+            if params.get("precomputed_knn") is not None:
+                # (knn_indices, knn_dists) as in cuML's precomputed_knn
+                # (reference umap.py:95-115 param list)
+                pre_ids, pre_dists = params["precomputed_knn"]
+                ids = np.asarray(pre_ids)[:, :k]
+                dists = np.asarray(pre_dists)[:, :k]
+                if ids.shape[0] != n:
+                    raise ValueError(
+                        f"precomputed_knn has {ids.shape[0]} rows but the "
+                        f"(sampled) training set has {n}"
+                    )
+            else:
+                dists, ids = knn_search(
+                    X, np.arange(n, dtype=np.int64), X, k, mesh
+                )
             a, b = params.get("a"), params.get("b")
             if a is None or b is None:
                 a, b = find_ab_params(

@@ -178,10 +178,13 @@ def level_split_kernel(
     p_imp = p_imp.reshape(-1)[:n_nodes]
     p_val = p_val.reshape(n_chunks * node_batch, -1)[:n_nodes]
     # float-noise guard scales with the parent's weighted impurity so tiny
-    # label magnitudes still split (an absolute floor would not)
+    # label magnitudes still split (an absolute floor would not); pure
+    # parents (p_imp == 0) are gated explicitly because any positive gain
+    # there is float32 noise
     noise_floor = 1e-6 * p_imp * p_w + 1e-30
     split_ok = (
         jnp.isfinite(bg)
+        & (p_imp > 0)
         & (bg > jnp.maximum(min_impurity_decrease * p_w, noise_floor))
         & (p_w >= 2 * min_samples_leaf)
     )

@@ -31,7 +31,7 @@ from ..parallel.mesh import DATA_AXIS, data_sharding
 @partial(jax.jit, static_argnames=("mesh", "k"))
 def knn_block_kernel(
     items: jax.Array,      # (N_pad, D) row-sharded
-    item_ids: jax.Array,   # (N_pad,) int64 row-sharded, -1 for padding
+    item_pos: jax.Array,   # (N_pad,) int32 row-sharded position in the padded item set
     valid: jax.Array,      # (N_pad,) bool row-sharded
     queries: jax.Array,    # (Q, D) replicated
     mesh: Mesh,
@@ -39,7 +39,10 @@ def knn_block_kernel(
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k nearest items for each query row.
 
-    Returns (distances (Q, k) ascending euclidean, ids (Q, k))."""
+    Returns (distances (Q, k) ascending euclidean, positions (Q, k)).
+    Positions index the *padded* item set; callers map them to user ids on
+    the host (user ids can be int64, which jax would silently truncate to
+    int32 — see PreparedItems.ids)."""
 
     def per_shard(items_loc, ids_loc, valid_loc, q):
         x_norm = (items_loc * items_loc).sum(axis=1)
@@ -61,37 +64,51 @@ def knn_block_kernel(
         final_ids = jnp.take_along_axis(cand_ids, fidx, axis=1)
         return -neg_final, final_ids
 
-    d2, ids = shard_map(
+    d2, pos = shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=(P(), P()),
         check_vma=False,
-    )(items, item_ids, valid, queries)
-    return jnp.sqrt(jnp.maximum(d2, 0.0)), ids
+    )(items, item_pos, valid, queries)
+    return jnp.sqrt(jnp.maximum(d2, 0.0)), pos
+
+
+class PreparedItems:
+    """Item set padded + row-sharded to device once, reusable across many
+    knn_search_prepared calls (e.g. one per transform partition).  User ids
+    stay on the host in full int64 precision; the device only sees int32
+    positions."""
+
+    __slots__ = ("items", "pos", "valid", "ids")
+
+    def __init__(self, items: jax.Array, pos: jax.Array, valid: jax.Array, ids: np.ndarray):
+        self.items = items
+        self.pos = pos
+        self.valid = valid
+        self.ids = ids  # (N_pad,) int64 host array, -1 in padding slots
 
 
 def prepare_items(
     items: np.ndarray, item_ids: np.ndarray, mesh: Mesh, dtype=np.float32
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Pad + row-shard the item set once; the returned device arrays can be
-    reused across many knn_search_prepared calls (e.g. one per transform
-    partition) without re-uploading the data."""
+) -> PreparedItems:
     from ..utils import pad_rows
 
     n_dev = mesh.shape[DATA_AXIS]
     items = np.asarray(items, dtype=dtype)
     n_items = items.shape[0]
     items_pad = pad_rows(items, n_dev)
-    ids_pad = np.full(items_pad.shape[0], -1, np.int64)
+    n_pad = items_pad.shape[0]
+    ids_pad = np.full(n_pad, -1, np.int64)
     ids_pad[:n_items] = item_ids
-    valid = np.zeros(items_pad.shape[0], bool)
+    valid = np.zeros(n_pad, bool)
     valid[:n_items] = True
     sharding = data_sharding(mesh)
-    return (
+    return PreparedItems(
         jax.device_put(items_pad, sharding),
-        jax.device_put(ids_pad, sharding),
+        jax.device_put(np.arange(n_pad, dtype=np.int32), sharding),
         jax.device_put(valid, sharding),
+        ids_pad,
     )
 
 
@@ -105,24 +122,34 @@ def knn_search(
     dtype=np.float32,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Host orchestration: shard items once, stream query blocks through the
-    jitted kernel (one compile per block shape; last block padded)."""
+    jitted kernel (block sizes are power-of-two buckets so the number of
+    compiled shapes is bounded; partial blocks padded)."""
     prepared = prepare_items(items, item_ids, mesh, dtype)
     return knn_search_prepared(prepared, queries, k, mesh, query_block, dtype)
 
 
 def knn_search_prepared(
-    prepared: Tuple[jax.Array, jax.Array, jax.Array],
+    prepared: PreparedItems,
     queries: np.ndarray,
     k: int,
     mesh: Mesh,
     query_block: int = 8192,
     dtype=np.float32,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    items_d, ids_d, valid_d = prepared
-
-    out_d, out_i = [], []
     q = np.asarray(queries, dtype=dtype)
-    block = min(query_block, max(1, q.shape[0]))
+    k_eff = min(k, prepared.ids.shape[0])
+    if q.shape[0] == 0:
+        return (
+            np.zeros((0, k_eff), dtype=dtype),
+            np.zeros((0, k_eff), dtype=np.int64),
+        )
+    # bucket the block size to a power of two (>=64, <=query_block) so
+    # varying partition sizes reuse a handful of compiled kernels instead of
+    # recompiling per distinct query count
+    block = 64
+    while block < min(query_block, q.shape[0]):
+        block *= 2
+    out_d, out_i = [], []
     for start in range(0, q.shape[0], block):
         qb = q[start : start + block]
         n_q = qb.shape[0]
@@ -130,7 +157,10 @@ def knn_search_prepared(
             qb = np.concatenate(
                 [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)], axis=0
             )
-        d, i = knn_block_kernel(items_d, ids_d, valid_d, jnp.asarray(qb), mesh, k)
+        d, pos = knn_block_kernel(
+            prepared.items, prepared.pos, prepared.valid, jnp.asarray(qb), mesh, k
+        )
         out_d.append(np.asarray(d[:n_q]))
-        out_i.append(np.asarray(i[:n_q]))
+        # map device positions -> user ids on the host (int64-safe)
+        out_i.append(prepared.ids[np.asarray(pos[:n_q])])
     return np.concatenate(out_d), np.concatenate(out_i)

@@ -84,3 +84,25 @@ def test_param_mapping():
     assert nn.tpu_params["n_neighbors"] == 9
     nn = NearestNeighbors(n_neighbors=4)
     assert nn.getK() == 4
+
+
+def test_int64_ids_survive():
+    # ids above 2**31 (e.g. Spark monotonically_increasing_id) must not be
+    # truncated by the device path, which only ever sees int32 positions
+    items, queries = _data(n_items=30, n_queries=4)
+    big = np.int64(1) << 40
+    ids = big + np.arange(30, dtype=np.int64) * (np.int64(1) << 33)
+    item_pdf = pd.DataFrame({"features": list(items), "my_id": ids})
+    item_df = DataFrame([item_pdf])
+    model = NearestNeighbors(k=3)
+    model.setIdCol("my_id")
+    model = model.fit(item_df)
+    _, _, knn_df = model.kneighbors(DataFrame.from_numpy(queries))
+    got = np.stack(knn_df.toPandas()["indices"].to_numpy())
+    assert got.min() >= big
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    _, exp_idx = SkNN(n_neighbors=3).fit(items.astype(np.float32)).kneighbors(
+        queries.astype(np.float32)
+    )
+    np.testing.assert_array_equal(got, ids[exp_idx])

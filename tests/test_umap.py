@@ -100,3 +100,38 @@ def test_umap_persistence(tmp_path):
     e1 = np.stack(model.transform(df).toPandas()["embedding"].to_numpy())
     e2 = np.stack(loaded.transform(df).toPandas()["embedding"].to_numpy())
     np.testing.assert_allclose(e1, e2, atol=1e-5)
+
+
+def test_umap_params_reach_solver_via_spark_api():
+    # copy(extra) / set() must reach the solver dict (identity _param_mapping)
+    um = UMAP()
+    um2 = um.copy({um.getParam("n_neighbors"): 30})
+    assert um2.tpu_params["n_neighbors"] == 30
+    um._set_params(min_dist=0.4)
+    assert um._tpu_params["min_dist"] == 0.4
+    assert um.getOrDefault("min_dist") == 0.4
+
+
+def test_umap_precomputed_knn():
+    X, _ = _blob_data(n=60)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    k = 10
+    dists, ids = SkNN(n_neighbors=k).fit(X.astype(np.float32)).kneighbors(
+        X.astype(np.float32)
+    )
+    m = UMAP(
+        n_neighbors=k, precomputed_knn=(ids, dists), random_state=5, n_epochs=60
+    ).fit(df)
+    assert m.embedding_.shape == (60, 2)
+    # a wrong-sized graph must be rejected loudly
+    with pytest.raises((ValueError, RuntimeError)):
+        UMAP(n_neighbors=k, precomputed_knn=(ids[:10], dists[:10])).fit(df)
+
+
+def test_umap_empty_sample_raises():
+    X, _ = _blob_data(n=20)
+    df = DataFrame.from_numpy(X, num_partitions=1)
+    with pytest.raises(RuntimeError, match="0 rows"):
+        UMAP(n_neighbors=3, sample_fraction=1e-9, random_state=0).fit(df)
