@@ -1,0 +1,8 @@
+#
+# TPU-native benchmark harness (counterpart of the reference's
+# /root/reference/python/benchmark/: benchmark_runner.py, benchmark/base.py,
+# gen_data.py).  The harness times estimator fit/transform on parquet (or
+# synthetic in-memory) datasets and scores model quality per algorithm, with
+# an optional sklearn CPU baseline mode standing in for the reference's
+# Spark-CPU comparison runs.
+#

@@ -1,0 +1,92 @@
+#
+# KMeans benchmark (reference benchmark/bench_kmeans.py): times fit +
+# transform and scores inertia — the sum of squared distances to assigned
+# centers (bench_kmeans.py:59-113).
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkKMeans(BenchmarkBase):
+    def _supported_class_params(self) -> Dict[str, Any]:
+        return {
+            "k": 200,
+            "maxIter": 30,
+            "tol": 1e-8,
+            "initMode": "random",
+            "seed": 1,
+        }
+
+    def score(
+        self,
+        centers: np.ndarray,
+        transformed_df: DataFrame,
+        features_col: Union[str, List[str]],
+        prediction_col: str,
+    ) -> float:
+        """Inertia of the assignment (reference bench_kmeans.py:59-113)."""
+        centers64 = np.asarray(centers, dtype=np.float64)
+        total = 0.0
+        for part in transformed_df.partitions:
+            if isinstance(features_col, str):
+                vecs = np.asarray(list(part[features_col]), dtype=np.float64)
+            else:
+                vecs = part[features_col].to_numpy(dtype=np.float64)
+            pred = part[prediction_col].to_numpy(dtype=np.int64)
+            total += float(np.sum((vecs - centers64[pred]) ** 2))
+        return total
+
+    def run_once(
+        self,
+        train_df: DataFrame,
+        features_col: Union[str, List[str]],
+        transform_df: Optional[DataFrame],
+        label_col: Optional[str],
+    ) -> Dict[str, Any]:
+        params = dict(self._class_params)
+        transform_df = transform_df or train_df
+        if self.args.mode == "tpu":
+            from spark_rapids_ml_tpu import KMeans
+
+            est = KMeans(**params, **self.num_workers_arg())
+            est.setFeaturesCol(features_col)
+            model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
+            out, transform_time = with_benchmark(
+                "transform", lambda: model.transform(transform_df)
+            )
+            centers = np.asarray(model.cluster_centers_)
+            pred_col = model.getOrDefault("predictionCol")
+            score = self.score(centers, out, features_col, pred_col)
+        else:
+            from sklearn.cluster import KMeans as SkKMeans
+
+            X, _ = self.to_numpy(train_df, features_col, None)
+            sk = SkKMeans(
+                n_clusters=params["k"],
+                max_iter=params["maxIter"],
+                tol=params["tol"],
+                init="random",
+                n_init=1,
+                random_state=params["seed"],
+            )
+            _, fit_time = with_benchmark("fit", lambda: sk.fit(X))
+            Xt, _ = self.to_numpy(transform_df, features_col, None)
+            labels, transform_time = with_benchmark(
+                "transform", lambda: sk.predict(Xt)
+            )
+            score = float(np.sum((Xt - sk.cluster_centers_[labels]) ** 2))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "total_time": fit_time + transform_time,
+            "score": score,
+        }

@@ -1,0 +1,81 @@
+#
+# LinearRegression benchmark (reference benchmark/bench_linear_regression.py):
+# times fit + transform; score = RMSE on the transform set.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+def _rmse(df: DataFrame, label_col: str, pred_col: str) -> float:
+    se, n = 0.0, 0
+    for part in df.partitions:
+        y = part[label_col].to_numpy(dtype=np.float64)
+        p = part[pred_col].to_numpy(dtype=np.float64)
+        se += float(np.sum((y - p) ** 2))
+        n += len(y)
+    return float(np.sqrt(se / max(n, 1)))
+
+
+class BenchmarkLinearRegression(BenchmarkBase):
+    def _supported_class_params(self) -> Dict[str, Any]:
+        return {
+            "regParam": 0.0,
+            "elasticNetParam": 0.0,
+            "maxIter": 100,
+            "tol": 1e-6,
+            "standardization": False,
+        }
+
+    def run_once(
+        self,
+        train_df: DataFrame,
+        features_col: Union[str, List[str]],
+        transform_df: Optional[DataFrame],
+        label_col: Optional[str],
+    ) -> Dict[str, Any]:
+        assert label_col is not None, "regression benchmark needs a label column"
+        params = dict(self._class_params)
+        transform_df = transform_df or train_df
+        if self.args.mode == "tpu":
+            from spark_rapids_ml_tpu import LinearRegression
+
+            est = (
+                LinearRegression(**params, **self.num_workers_arg())
+                .setFeaturesCol(features_col)
+                .setLabelCol(label_col)
+            )
+            model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
+            out, transform_time = with_benchmark(
+                "transform", lambda: model.transform(transform_df)
+            )
+            score = _rmse(out, label_col, model.getOrDefault("predictionCol"))
+        else:
+            from sklearn.linear_model import ElasticNet, LinearRegression as SkLR, Ridge
+
+            X, y = self.to_numpy(train_df, features_col, label_col)
+            reg, l1r = params["regParam"], params["elasticNetParam"]
+            if reg == 0.0:
+                sk: Any = SkLR()
+            elif l1r == 0.0:
+                sk = Ridge(alpha=reg * X.shape[0])
+            else:
+                sk = ElasticNet(alpha=reg, l1_ratio=l1r, max_iter=params["maxIter"])
+            _, fit_time = with_benchmark("fit", lambda: sk.fit(X, y))
+            Xt, yt = self.to_numpy(transform_df, features_col, label_col)
+            pred, transform_time = with_benchmark("transform", lambda: sk.predict(Xt))
+            score = float(np.sqrt(np.mean((yt - pred) ** 2)))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "total_time": fit_time + transform_time,
+            "score": score,
+        }

@@ -1,0 +1,80 @@
+#
+# LogisticRegression benchmark (reference benchmark/bench_logistic_regression.py):
+# times fit + transform; score = accuracy on the transform set.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+def _accuracy(df: DataFrame, label_col: str, pred_col: str) -> float:
+    correct, n = 0, 0
+    for part in df.partitions:
+        y = part[label_col].to_numpy(dtype=np.float64)
+        p = part[pred_col].to_numpy(dtype=np.float64)
+        correct += int(np.sum(y == p))
+        n += len(y)
+    return correct / max(n, 1)
+
+
+class BenchmarkLogisticRegression(BenchmarkBase):
+    def _supported_class_params(self) -> Dict[str, Any]:
+        return {
+            "maxIter": 200,
+            "regParam": 1e-5,
+            "elasticNetParam": 0.0,
+            "tol": 1e-6,
+            "standardization": False,
+        }
+
+    def run_once(
+        self,
+        train_df: DataFrame,
+        features_col: Union[str, List[str]],
+        transform_df: Optional[DataFrame],
+        label_col: Optional[str],
+    ) -> Dict[str, Any]:
+        assert label_col is not None, "classification benchmark needs a label column"
+        params = dict(self._class_params)
+        transform_df = transform_df or train_df
+        if self.args.mode == "tpu":
+            from spark_rapids_ml_tpu import LogisticRegression
+
+            est = (
+                LogisticRegression(**params, **self.num_workers_arg())
+                .setFeaturesCol(features_col)
+                .setLabelCol(label_col)
+            )
+            model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
+            out, transform_time = with_benchmark(
+                "transform", lambda: model.transform(transform_df)
+            )
+            score = _accuracy(out, label_col, model.getOrDefault("predictionCol"))
+        else:
+            from sklearn.linear_model import LogisticRegression as SkLogReg
+
+            X, y = self.to_numpy(train_df, features_col, label_col)
+            reg = params["regParam"]
+            sk = SkLogReg(
+                C=(1.0 / (reg * X.shape[0])) if reg > 0 else 1e12,
+                max_iter=params["maxIter"],
+                tol=params["tol"],
+            )
+            _, fit_time = with_benchmark("fit", lambda: sk.fit(X, y))
+            Xt, yt = self.to_numpy(transform_df, features_col, label_col)
+            pred, transform_time = with_benchmark("transform", lambda: sk.predict(Xt))
+            score = float(np.mean(yt == pred))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "total_time": fit_time + transform_time,
+            "score": score,
+        }

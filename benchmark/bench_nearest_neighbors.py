@@ -1,0 +1,62 @@
+#
+# NearestNeighbors benchmark (reference benchmark/bench_nearest_neighbors.py):
+# times the kneighbors batch query; score = mean distance to the k-th
+# neighbor (a stability diagnostic, since exact kNN has no quality knob).
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkNearestNeighbors(BenchmarkBase):
+    def _supported_class_params(self) -> Dict[str, Any]:
+        return {"k": 200}
+
+    def run_once(
+        self,
+        train_df: DataFrame,
+        features_col: Union[str, List[str]],
+        transform_df: Optional[DataFrame],
+        label_col: Optional[str],
+    ) -> Dict[str, Any]:
+        params = dict(self._class_params)
+        query_df = transform_df or train_df
+        if self.args.mode == "tpu":
+            from spark_rapids_ml_tpu import NearestNeighbors
+
+            est = NearestNeighbors(**params, **self.num_workers_arg()).setInputCol(
+                features_col
+            )
+            model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
+            (item_df, q_df, knn_df), transform_time = with_benchmark(
+                "kneighbors", lambda: model.kneighbors(query_df)
+            )
+            dists = np.concatenate(
+                [np.asarray(list(p["distances"]), dtype=np.float64) for p in knn_df.partitions if len(p)]
+            )
+            score = float(np.mean(dists[:, -1]))
+        else:
+            from sklearn.neighbors import NearestNeighbors as SkNN
+
+            X, _ = self.to_numpy(train_df, features_col, None)
+            sk = SkNN(n_neighbors=params["k"], algorithm="brute")
+            _, fit_time = with_benchmark("fit", lambda: sk.fit(X))
+            Q, _ = self.to_numpy(query_df, features_col, None)
+            (dists, _), transform_time = with_benchmark(
+                "kneighbors", lambda: sk.kneighbors(Q)
+            )
+            score = float(np.mean(dists[:, -1]))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "total_time": fit_time + transform_time,
+            "score": score,
+        }

@@ -1,0 +1,54 @@
+#
+# PCA benchmark (reference benchmark/bench_pca.py): times fit + transform and
+# scores total explained variance of the k components.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkPCA(BenchmarkBase):
+    def _supported_class_params(self) -> Dict[str, Any]:
+        return {"k": 3}
+
+    def run_once(
+        self,
+        train_df: DataFrame,
+        features_col: Union[str, List[str]],
+        transform_df: Optional[DataFrame],
+        label_col: Optional[str],
+    ) -> Dict[str, Any]:
+        params = dict(self._class_params)
+        transform_df = transform_df or train_df
+        if self.args.mode == "tpu":
+            from spark_rapids_ml_tpu import PCA
+
+            est = PCA(**params, **self.num_workers_arg()).setInputCol(features_col)
+            model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
+            _, transform_time = with_benchmark(
+                "transform", lambda: model.transform(transform_df)
+            )
+            score = float(np.sum(model.explained_variance_ratio_))
+        else:
+            from sklearn.decomposition import PCA as SkPCA
+
+            X, _ = self.to_numpy(train_df, features_col, None)
+            sk = SkPCA(n_components=params["k"])
+            _, fit_time = with_benchmark("fit", lambda: sk.fit(X))
+            Xt, _ = self.to_numpy(transform_df, features_col, None)
+            _, transform_time = with_benchmark("transform", lambda: sk.transform(Xt))
+            score = float(np.sum(sk.explained_variance_ratio_))
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "total_time": fit_time + transform_time,
+            "score": score,
+        }

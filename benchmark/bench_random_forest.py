@@ -1,0 +1,103 @@
+#
+# RandomForest benchmarks (reference benchmark/bench_random_forest.py):
+# classifier scored by accuracy, regressor by RMSE.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+from .base import BenchmarkBase
+from .bench_linear_regression import _rmse
+from .bench_logistic_regression import _accuracy
+from .utils import with_benchmark
+
+
+class _BenchmarkRandomForestBase(BenchmarkBase):
+    _is_classifier = True
+
+    def _supported_class_params(self) -> Dict[str, Any]:
+        return {
+            "numTrees": 50,
+            "maxDepth": 13,
+            "maxBins": 128,
+            "featureSubsetStrategy": "auto",
+            "seed": 1,
+        }
+
+    def run_once(
+        self,
+        train_df: DataFrame,
+        features_col: Union[str, List[str]],
+        transform_df: Optional[DataFrame],
+        label_col: Optional[str],
+    ) -> Dict[str, Any]:
+        assert label_col is not None, "random forest benchmark needs a label column"
+        params = dict(self._class_params)
+        transform_df = transform_df or train_df
+        if self.args.mode == "tpu":
+            from spark_rapids_ml_tpu import (
+                RandomForestClassifier,
+                RandomForestRegressor,
+            )
+
+            cls = RandomForestClassifier if self._is_classifier else RandomForestRegressor
+            est = (
+                cls(**params, **self.num_workers_arg())
+                .setFeaturesCol(features_col)
+                .setLabelCol(label_col)
+            )
+            model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
+            out, transform_time = with_benchmark(
+                "transform", lambda: model.transform(transform_df)
+            )
+            pred_col = model.getOrDefault("predictionCol")
+            score = (
+                _accuracy(out, label_col, pred_col)
+                if self._is_classifier
+                else _rmse(out, label_col, pred_col)
+            )
+        else:
+            from sklearn.ensemble import (
+                RandomForestClassifier as SkRFC,
+                RandomForestRegressor as SkRFR,
+            )
+
+            X, y = self.to_numpy(train_df, features_col, label_col)
+            sk_cls = SkRFC if self._is_classifier else SkRFR
+            sk = sk_cls(
+                n_estimators=params["numTrees"],
+                max_depth=params["maxDepth"],
+                random_state=params["seed"],
+            )
+            _, fit_time = with_benchmark("fit", lambda: sk.fit(X, y))
+            Xt, yt = self.to_numpy(transform_df, features_col, label_col)
+            pred, transform_time = with_benchmark("transform", lambda: sk.predict(Xt))
+            score = (
+                float(np.mean(yt == pred))
+                if self._is_classifier
+                else float(np.sqrt(np.mean((yt - pred) ** 2)))
+            )
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "total_time": fit_time + transform_time,
+            "score": score,
+        }
+
+
+class BenchmarkRandomForestClassifier(_BenchmarkRandomForestBase):
+    _is_classifier = True
+
+
+class BenchmarkRandomForestRegressor(_BenchmarkRandomForestBase):
+    _is_classifier = False
+
+    def _supported_class_params(self) -> Dict[str, Any]:
+        params = super()._supported_class_params()
+        params.update({"numTrees": 30, "maxDepth": 6})
+        return params

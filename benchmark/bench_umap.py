@@ -1,0 +1,70 @@
+#
+# UMAP benchmark (reference benchmark/bench_umap.py): times fit + transform;
+# score = trustworthiness of the embedding (bench_umap.py uses the same
+# sklearn.manifold metric).
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkUMAP(BenchmarkBase):
+    def _supported_class_params(self) -> Dict[str, Any]:
+        return {
+            "n_neighbors": 15,
+            "n_components": 2,
+            "n_epochs": 200,
+            "min_dist": 0.1,
+            "random_state": 1,
+        }
+
+    def _trustworthiness(self, X: np.ndarray, emb: np.ndarray, k: int) -> float:
+        from sklearn.manifold import trustworthiness
+
+        cap = min(len(X), 5000)  # trustworthiness is O(n^2); sample like the
+        rng = np.random.default_rng(0)  # reference's subsampled scoring
+        idx = rng.permutation(len(X))[:cap]
+        return float(trustworthiness(X[idx], emb[idx], n_neighbors=min(k, cap // 2)))
+
+    def run_once(
+        self,
+        train_df: DataFrame,
+        features_col: Union[str, List[str]],
+        transform_df: Optional[DataFrame],
+        label_col: Optional[str],
+    ) -> Dict[str, Any]:
+        params = dict(self._class_params)
+        transform_df = transform_df or train_df
+        if self.args.mode != "tpu":
+            raise NotImplementedError(
+                "cpu mode needs umap-learn, which is not bundled; run --mode tpu"
+            )
+        from spark_rapids_ml_tpu import UMAP
+
+        est = UMAP(**params, **self.num_workers_arg()).setFeaturesCol(features_col)
+        model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
+        out, transform_time = with_benchmark(
+            "transform", lambda: model.transform(transform_df)
+        )
+        # score the transform OUTPUT against the transform input so the timed
+        # path is also the evaluated path
+        X, _ = self.to_numpy(transform_df, features_col, None)
+        out_col = model.getOrDefault("outputCol")
+        emb = np.concatenate(
+            [np.asarray(list(p[out_col]), dtype=np.float64) for p in out.partitions if len(p)]
+        )
+        score = self._trustworthiness(X, emb, params["n_neighbors"])
+        return {
+            "fit_time": fit_time,
+            "transform_time": transform_time,
+            "total_time": fit_time + transform_time,
+            "score": score,
+        }

@@ -1,0 +1,61 @@
+#
+# Benchmark CLI (reference python/benchmark/benchmark_runner.py:31-66):
+#
+#   python -m benchmark.benchmark_runner <algorithm> \
+#       --train_path data/ [--num_devices N] [--mode tpu|cpu] [algo args]
+#
+# Generate input data first with `python -m benchmark.gen_data ...`.
+#
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .bench_kmeans import BenchmarkKMeans
+from .bench_linear_regression import BenchmarkLinearRegression
+from .bench_logistic_regression import BenchmarkLogisticRegression
+from .bench_nearest_neighbors import BenchmarkNearestNeighbors
+from .bench_pca import BenchmarkPCA
+from .bench_random_forest import (
+    BenchmarkRandomForestClassifier,
+    BenchmarkRandomForestRegressor,
+)
+from .bench_umap import BenchmarkUMAP
+
+
+class BenchmarkRunner:
+    def __init__(self) -> None:
+        registered = {
+            "kmeans": BenchmarkKMeans,
+            "knn": BenchmarkNearestNeighbors,
+            "linear_regression": BenchmarkLinearRegression,
+            "logistic_regression": BenchmarkLogisticRegression,
+            "pca": BenchmarkPCA,
+            "random_forest_classifier": BenchmarkRandomForestClassifier,
+            "random_forest_regressor": BenchmarkRandomForestRegressor,
+            "umap": BenchmarkUMAP,
+        }
+        algorithms = "\n    ".join(registered)
+        parser = argparse.ArgumentParser(
+            description="Benchmark spark_rapids_ml_tpu algorithms",
+            usage=f"""benchmark_runner.py <algorithm> [<args>]
+
+    Supported algorithms:
+    {algorithms}
+    """,
+        )
+        parser.add_argument("algorithm")
+        args = parser.parse_args(sys.argv[1:2])
+        if args.algorithm not in registered:
+            print(f"Unrecognized algorithm: {args.algorithm}")
+            parser.print_help()
+            raise SystemExit(1)
+        self._runner = registered[args.algorithm](sys.argv[2:])
+
+    def run(self) -> None:
+        self._runner.run()
+
+
+if __name__ == "__main__":
+    BenchmarkRunner().run()

@@ -1,0 +1,237 @@
+#
+# Synthetic dataset generators (counterpart of the reference's
+# python/benchmark/gen_data.py:48-508 and the pandas-UDF distributed variants
+# in gen_data_distributed.py).  Generators are chunked: each output file is
+# produced independently from a per-chunk seeded RNG, so generation
+# parallelizes across files and never materializes the full dataset in
+# memory — the same property the reference gets from its mapInPandas UDFs
+# (gen_data.py:243-253).
+#
+# CLI:
+#   python -m benchmark.gen_data [default|blobs|low_rank_matrix|regression|
+#       classification] --num_rows N --num_cols D --output_dir PATH
+#       [--output_num_files F] [--dtype float32] [generator args...]
+#
+# Output layout matches the reference (gen_data.py:466-506): parquet files
+# with scalar feature columns "c0".."c{D-1}" plus optional "label".
+#
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from abc import abstractmethod
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+
+class DataGenBase:
+    """Common arg parsing + chunked generation (reference DataGenBase
+    gen_data.py:56-171)."""
+
+    def __init__(self, argv: List[str]) -> None:
+        self._parser = argparse.ArgumentParser(description=type(self).__name__)
+        self._parser.add_argument("--num_rows", type=int, default=100_000)
+        self._parser.add_argument("--num_cols", type=int, default=30)
+        self._parser.add_argument(
+            "--dtype", type=str, default="float32", choices=["float32", "float64"]
+        )
+        self._parser.add_argument("--output_dir", type=str, required=True)
+        self._parser.add_argument(
+            "--output_num_files",
+            type=int,
+            default=1,
+            help="number of parquet files (= facade partitions on load)",
+        )
+        self._parser.add_argument("--overwrite", action="store_true")
+        self._parser.add_argument("--random_state", type=int, default=1)
+        self._add_extra_arguments()
+        self.args = self._parser.parse_args(argv)
+
+    def _add_extra_arguments(self) -> None:
+        pass
+
+    @property
+    def feature_cols(self) -> List[str]:
+        return [f"c{i}" for i in range(self.args.num_cols)]
+
+    def _chunk_sizes(self) -> List[int]:
+        n, f = self.args.num_rows, max(1, self.args.output_num_files)
+        base = n // f
+        sizes = [base + (1 if i < n % f else 0) for i in range(f)]
+        return [s for s in sizes if s > 0] or [0]
+
+    @abstractmethod
+    def gen_chunk(self, n_rows: int, seed: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Return (features (n_rows, D), labels (n_rows,) or None)."""
+        raise NotImplementedError
+
+    def gen_dataframes(self) -> Iterator[pd.DataFrame]:
+        dtype = np.dtype(self.args.dtype)
+        for i, size in enumerate(self._chunk_sizes()):
+            X, y = self.gen_chunk(size, self.args.random_state + i)
+            pdf = pd.DataFrame(np.asarray(X, dtype=dtype), columns=self.feature_cols)
+            if y is not None:
+                pdf["label"] = np.asarray(y, dtype=dtype)
+            yield pdf
+
+    def write(self) -> None:
+        out = self.args.output_dir
+        if os.path.exists(out) and not self.args.overwrite:
+            raise RuntimeError(f"{out} exists; pass --overwrite to replace")
+        os.makedirs(out, exist_ok=True)
+        for stale in os.listdir(out):
+            # clear old parts so a re-gen with fewer files can't leave a
+            # mixed dataset behind
+            if stale.endswith(".parquet"):
+                os.remove(os.path.join(out, stale))
+        for i, pdf in enumerate(self.gen_dataframes()):
+            pdf.to_parquet(os.path.join(out, f"part-{i:05d}.parquet"), index=False)
+        print(f"wrote {self.args.num_rows} rows x {self.args.num_cols} cols to {out}")
+
+
+class DefaultDataGen(DataGenBase):
+    """Uniform random features, no label (reference DefaultDataGen
+    gen_data.py:173-206, spark.ml.RandomRDDs analog)."""
+
+    def gen_chunk(self, n_rows: int, seed: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        rng = np.random.default_rng(seed)
+        return rng.uniform(-1.0, 1.0, size=(n_rows, self.args.num_cols)), None
+
+
+class BlobsDataGen(DataGenBase):
+    """Gaussian blobs for KMeans/kNN (reference BlobsDataGen gen_data.py:209-253,
+    sklearn.datasets.make_blobs)."""
+
+    def _add_extra_arguments(self) -> None:
+        self._parser.add_argument("--n_clusters", type=int, default=20)
+        self._parser.add_argument("--cluster_std", type=float, default=1.0)
+        self._parser.add_argument("--center_box_min", type=float, default=-10.0)
+        self._parser.add_argument("--center_box_max", type=float, default=10.0)
+
+    def gen_chunk(self, n_rows: int, seed: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        # centers are derived from random_state only (not the chunk seed) so
+        # every chunk samples the same mixture — the distributed-generation
+        # invariant of gen_data_distributed.py's shared-centers design
+        crng = np.random.default_rng(self.args.random_state)
+        centers = crng.uniform(
+            self.args.center_box_min,
+            self.args.center_box_max,
+            size=(self.args.n_clusters, self.args.num_cols),
+        )
+        rng = np.random.default_rng(seed)
+        assign = rng.integers(0, self.args.n_clusters, size=n_rows)
+        X = centers[assign] + rng.normal(
+            0.0, self.args.cluster_std, size=(n_rows, self.args.num_cols)
+        )
+        return X, assign.astype(np.float64)
+
+
+class LowRankMatrixDataGen(DataGenBase):
+    """Low effective-rank matrix for PCA (reference LowRankMatrixDataGen
+    gen_data.py:255-297, sklearn.datasets.make_low_rank_matrix)."""
+
+    def _add_extra_arguments(self) -> None:
+        self._parser.add_argument("--effective_rank", type=int, default=10)
+        self._parser.add_argument("--tail_strength", type=float, default=0.5)
+
+    def gen_chunk(self, n_rows: int, seed: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        d = self.args.num_cols
+        rank = self.args.effective_rank
+        # shared right singular vectors across chunks (same subspace), chunked
+        # left factors: X_chunk = G_chunk @ diag(s) @ V^T with G ~ N(0,1)
+        crng = np.random.default_rng(self.args.random_state)
+        V, _ = np.linalg.qr(crng.standard_normal((d, d)))
+        singular = np.arange(d, dtype=np.float64)
+        low = np.exp(-((singular / rank) ** 2))
+        tail = self.args.tail_strength * np.exp(-0.1 * singular / rank)
+        s = low + tail
+        rng = np.random.default_rng(seed)
+        # normalize by the TOTAL row count so the distribution is invariant
+        # to --output_num_files (chunking must not change the data law)
+        G = rng.standard_normal((n_rows, d)) / np.sqrt(max(self.args.num_rows, 1))
+        return (G * s) @ V.T, None
+
+
+class RegressionDataGen(DataGenBase):
+    """Linear-model data for LinearRegression (reference RegressionDataGen
+    gen_data.py:300-356, sklearn.datasets.make_regression)."""
+
+    def _add_extra_arguments(self) -> None:
+        self._parser.add_argument("--n_informative", type=int, default=10)
+        self._parser.add_argument("--bias", type=float, default=0.0)
+        self._parser.add_argument("--noise", type=float, default=1.0)
+
+    def gen_chunk(self, n_rows: int, seed: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        d = self.args.num_cols
+        n_inf = min(self.args.n_informative, d)
+        # ground-truth coefficients shared across chunks
+        crng = np.random.default_rng(self.args.random_state)
+        coef = np.zeros(d)
+        coef[:n_inf] = 100.0 * crng.uniform(size=n_inf)
+        rng = np.random.default_rng(seed)
+        X = rng.standard_normal((n_rows, d))
+        y = X @ coef + self.args.bias
+        if self.args.noise > 0:
+            y = y + rng.normal(scale=self.args.noise, size=n_rows)
+        return X, y
+
+
+class ClassificationDataGen(DataGenBase):
+    """Classification data (reference ClassificationDataGen gen_data.py:358-414,
+    sklearn.datasets.make_classification, generated per-chunk)."""
+
+    def _add_extra_arguments(self) -> None:
+        self._parser.add_argument("--n_classes", type=int, default=2)
+        self._parser.add_argument("--n_informative", type=int, default=10)
+        self._parser.add_argument("--n_redundant", type=int, default=2)
+        self._parser.add_argument("--class_sep", type=float, default=1.0)
+
+    def gen_chunk(self, n_rows: int, seed: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        d = self.args.num_cols
+        n_classes = self.args.n_classes
+        n_inf = min(self.args.n_informative, d)
+        n_red = min(self.args.n_redundant, d - n_inf)
+        # class geometry (make_classification semantics: hypercube-vertex
+        # centroids, random informative rotation, redundant = linear combos)
+        # comes from random_state only, so every chunk samples the SAME
+        # classification problem with fresh points
+        crng = np.random.default_rng(self.args.random_state)
+        signs = crng.choice([-1.0, 1.0], size=(n_classes, n_inf))
+        centroids = signs * self.args.class_sep
+        rotate = crng.standard_normal((n_inf, n_inf))
+        redundant = crng.standard_normal((n_inf, n_red)) if n_red else None
+        rng = np.random.default_rng(seed)
+        y = rng.integers(0, n_classes, size=n_rows)
+        X_inf = (centroids[y] + rng.standard_normal((n_rows, n_inf))) @ rotate
+        blocks = [X_inf]
+        if redundant is not None:
+            blocks.append(X_inf @ redundant)
+        n_noise = d - n_inf - n_red
+        if n_noise > 0:
+            blocks.append(rng.standard_normal((n_rows, n_noise)))
+        return np.concatenate(blocks, axis=1), y.astype(np.float64)
+
+
+_REGISTERED: Dict[str, Any] = {
+    "default": DefaultDataGen,
+    "blobs": BlobsDataGen,
+    "low_rank_matrix": LowRankMatrixDataGen,
+    "regression": RegressionDataGen,
+    "classification": ClassificationDataGen,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] not in _REGISTERED:
+        print(f"usage: gen_data.py [{'|'.join(_REGISTERED)}] [--args]", file=sys.stderr)
+        raise SystemExit(1)
+    _REGISTERED[argv[0]](argv[1:]).write()
+
+
+if __name__ == "__main__":
+    main()

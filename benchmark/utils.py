@@ -1,0 +1,41 @@
+#
+# Timing and report helpers (reference python/benchmark/benchmark/utils.py:
+# with_benchmark :42-50, to_bool :28-39, WithSparkSession :20-26 — session
+# management is not needed here since the TPU runtime is in-process).
+#
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+def with_benchmark(phrase: str, action: Callable[[], T]) -> Tuple[T, float]:
+    """Run `action`, print '<phrase>: <seconds> s', return (result, seconds)."""
+    start = time.perf_counter()
+    result = action()
+    elapsed = round(time.perf_counter() - start, 4)
+    print(f"{phrase}: {elapsed} s")
+    return result, elapsed
+
+
+def to_bool(literal: str) -> bool:
+    if str(literal).lower() in ("1", "true", "yes", "y"):
+        return True
+    if str(literal).lower() in ("0", "false", "no", "n"):
+        return False
+    raise ValueError(f"Invalid boolean literal: {literal}")
+
+
+def append_report(report_path: str, record: Dict[str, Any]) -> None:
+    """Append one benchmark-run record as a JSON line (the reference appends
+    pandas rows to a csv at report_path, base.py:241-265)."""
+    if not report_path:
+        return
+    os.makedirs(os.path.dirname(os.path.abspath(report_path)), exist_ok=True)
+    with open(report_path, "a") as f:
+        f.write(json.dumps(record) + "\n")

@@ -1,0 +1,155 @@
+#
+# Data generator tests (reference python/benchmark/test_gen_data.py): shape,
+# determinism, chunk invariants, and parquet round-trip of every generator.
+#
+
+import glob
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.gen_data import (  # noqa: E402
+    BlobsDataGen,
+    ClassificationDataGen,
+    DefaultDataGen,
+    LowRankMatrixDataGen,
+    RegressionDataGen,
+    _REGISTERED,
+    main,
+)
+
+COMMON = ["--num_rows", "1000", "--num_cols", "8", "--output_dir", "ignored"]
+
+
+def _collect(gen):
+    parts = list(gen.gen_dataframes())
+    return pd.concat(parts, ignore_index=True), parts
+
+
+@pytest.mark.parametrize("name", sorted(_REGISTERED))
+def test_shapes_and_chunking(name):
+    gen = _REGISTERED[name](COMMON + ["--output_num_files", "4"])
+    full, parts = _collect(gen)
+    assert len(parts) == 4
+    assert len(full) == 1000
+    feat = full[gen.feature_cols].to_numpy()
+    assert feat.shape == (1000, 8)
+    assert feat.dtype == np.float32
+    has_label = name in ("blobs", "regression", "classification")
+    assert ("label" in full.columns) == has_label
+
+
+def test_determinism_and_chunk_independence():
+    gen_a = RegressionDataGen(COMMON + ["--output_num_files", "2"])
+    gen_b = RegressionDataGen(COMMON + ["--output_num_files", "2"])
+    full_a, _ = _collect(gen_a)
+    full_b, _ = _collect(gen_b)
+    pd.testing.assert_frame_equal(full_a, full_b)
+    # different chunk counts draw from different per-chunk streams but the
+    # same ground-truth coefficients: labels stay linearly explainable
+    gen_c = RegressionDataGen(COMMON + ["--output_num_files", "5"])
+    full_c, _ = _collect(gen_c)
+    X = full_c[gen_c.feature_cols].to_numpy(dtype=np.float64)
+    y = full_c["label"].to_numpy(dtype=np.float64)
+    coef, *_ = np.linalg.lstsq(np.c_[X, np.ones(len(X))], y, rcond=None)
+    resid = y - np.c_[X, np.ones(len(X))] @ coef
+    assert np.std(resid) < 2.0  # noise=1.0 default
+
+
+def test_blobs_share_centers_across_chunks():
+    gen = BlobsDataGen(
+        COMMON + ["--output_num_files", "3", "--n_clusters", "4", "--cluster_std", "0.1"]
+    )
+    full, parts = _collect(gen)
+    # per-chunk cluster means must agree (chunks sample the same mixture)
+    means = []
+    for part in parts:
+        X = part[gen.feature_cols].to_numpy(dtype=np.float64)
+        lab = part["label"].to_numpy(dtype=np.int64)
+        means.append(
+            np.stack([X[lab == c].mean(axis=0) for c in range(4) if (lab == c).any()])
+        )
+    assert np.allclose(means[0], means[1], atol=0.2)
+
+
+def test_low_rank_matrix_is_low_rank():
+    gen = LowRankMatrixDataGen(
+        COMMON + ["--effective_rank", "2", "--tail_strength", "0.01"]
+    )
+    full, _ = _collect(gen)
+    X = full[gen.feature_cols].to_numpy(dtype=np.float64)
+    s = np.linalg.svd(X, compute_uv=False)
+    assert s[3] < 0.2 * s[0]  # spectrum decays fast past the effective rank
+
+
+def test_classification_labels():
+    gen = ClassificationDataGen(COMMON + ["--n_classes", "3"])
+    full, _ = _collect(gen)
+    assert set(np.unique(full["label"])) == {0.0, 1.0, 2.0}
+
+
+def test_classification_chunks_are_distinct_points_same_problem():
+    gen = ClassificationDataGen(COMMON + ["--output_num_files", "4"])
+    full, parts = _collect(gen)
+    feats = full[gen.feature_cols].to_numpy()
+    assert len(np.unique(feats, axis=0)) == len(feats)  # no duplicated pool
+    # same class geometry in every chunk: per-chunk class means agree
+    m = []
+    for part in parts[:2]:
+        X = part[gen.feature_cols].to_numpy(dtype=np.float64)
+        lab = part["label"].to_numpy(dtype=np.int64)
+        m.append(np.stack([X[lab == c].mean(axis=0) for c in (0, 1)]))
+    assert np.allclose(m[0], m[1], atol=0.8)
+
+
+def test_low_rank_scale_invariant_to_file_count():
+    stds = []
+    for files in ("1", "10"):
+        gen = LowRankMatrixDataGen(COMMON + ["--output_num_files", files])
+        full, _ = _collect(gen)
+        stds.append(full[gen.feature_cols].to_numpy(dtype=np.float64).std())
+    assert abs(stds[0] - stds[1]) < 0.15 * stds[0]
+
+
+def test_cli_writes_parquet(tmp_path):
+    out = str(tmp_path / "data")
+    main(
+        [
+            "default",
+            "--num_rows",
+            "100",
+            "--num_cols",
+            "4",
+            "--output_dir",
+            out,
+            "--output_num_files",
+            "3",
+        ]
+    )
+    files = sorted(glob.glob(os.path.join(out, "*.parquet")))
+    assert len(files) == 3
+    total = sum(len(pd.read_parquet(f)) for f in files)
+    assert total == 100
+    with pytest.raises(RuntimeError):
+        main(["default", "--num_rows", "10", "--num_cols", "2", "--output_dir", out])
+    # --overwrite with fewer files must not leave stale parts behind
+    main(
+        [
+            "default",
+            "--num_rows",
+            "100",
+            "--num_cols",
+            "4",
+            "--output_dir",
+            out,
+            "--output_num_files",
+            "2",
+            "--overwrite",
+        ]
+    )
+    assert len(glob.glob(os.path.join(out, "*.parquet"))) == 2
