@@ -108,18 +108,35 @@ class BenchmarkBase:
         files: List[str] = []
         for p in paths:
             if os.path.isdir(p):
-                files.extend(sorted(glob.glob(os.path.join(p, "*.parquet"))))
+                found = sorted(glob.glob(os.path.join(p, "*.parquet"))) or sorted(
+                    glob.glob(os.path.join(p, "*.csv"))
+                )
+                files.extend(found)
             else:
                 files.extend(sorted(glob.glob(p)))
         if not files:
-            raise FileNotFoundError(f"No parquet files under {paths}")
+            raise FileNotFoundError(f"No parquet/csv files under {paths}")
         return files
+
+    @staticmethod
+    def _read_file(path: str) -> pd.DataFrame:
+        if path.endswith(".csv"):
+            # header line = column names; numeric payload loads through the
+            # native threaded CSV reader (numpy fallback inside native.load_csv)
+            from spark_rapids_ml_tpu import native
+
+            with open(path) as f:
+                header = f.readline().strip().split(",")
+                n_rows = sum(1 for _ in f)
+            data = native.load_csv(path, n_rows, len(header), skip_rows=1)
+            return pd.DataFrame(data, columns=header)
+        return pd.read_parquet(path)
 
     def load_dataframe(self, paths: List[str]) -> Tuple[DataFrame, Union[str, List[str]], Optional[str]]:
         """Parquet files -> facade DataFrame (one partition per file, like one
         Spark partition per file in the reference's 50-file datasets), plus
         (features_col, label_col)."""
-        parts = [pd.read_parquet(f) for f in self._expand_paths(paths)]
+        parts = [self._read_file(f) for f in self._expand_paths(paths)]
         cols = list(parts[0].columns)
         label_col = "label" if "label" in cols else None
         feature_cols = [c for c in cols if c != label_col]

@@ -74,7 +74,8 @@ class BenchmarkKMeans(BenchmarkBase):
                 n_clusters=params["k"],
                 max_iter=params["maxIter"],
                 tol=params["tol"],
-                init="random",
+                # honor --initMode so cross-mode runs compare like for like
+                init="random" if params["initMode"] == "random" else "k-means++",
                 n_init=1,
                 random_state=params["seed"],
             )

@@ -32,7 +32,9 @@ class BenchmarkUMAP(BenchmarkBase):
         cap = min(len(X), 5000)  # trustworthiness is O(n^2); sample like the
         rng = np.random.default_rng(0)  # reference's subsampled scoring
         idx = rng.permutation(len(X))[:cap]
-        return float(trustworthiness(X[idx], emb[idx], n_neighbors=min(k, cap // 2)))
+        # sklearn requires n_neighbors < n_samples / 2
+        k_eff = max(1, min(k, (cap - 1) // 2))
+        return float(trustworthiness(X[idx], emb[idx], n_neighbors=k_eff))
 
     def run_once(
         self,

@@ -47,6 +47,9 @@ class DataGenBase:
             help="number of parquet files (= facade partitions on load)",
         )
         self._parser.add_argument("--overwrite", action="store_true")
+        self._parser.add_argument(
+            "--output_format", type=str, default="parquet", choices=["parquet", "csv"]
+        )
         self._parser.add_argument("--random_state", type=int, default=1)
         self._add_extra_arguments()
         self.args = self._parser.parse_args(argv)
@@ -86,10 +89,15 @@ class DataGenBase:
         for stale in os.listdir(out):
             # clear old parts so a re-gen with fewer files can't leave a
             # mixed dataset behind
-            if stale.endswith(".parquet"):
+            if stale.endswith(".parquet") or stale.endswith(".csv"):
                 os.remove(os.path.join(out, stale))
+        fmt = self.args.output_format
         for i, pdf in enumerate(self.gen_dataframes()):
-            pdf.to_parquet(os.path.join(out, f"part-{i:05d}.parquet"), index=False)
+            path = os.path.join(out, f"part-{i:05d}.{fmt}")
+            if fmt == "csv":
+                pdf.to_csv(path, index=False)
+            else:
+                pdf.to_parquet(path, index=False)
         print(f"wrote {self.args.num_rows} rows x {self.args.num_cols} cols to {out}")
 
 

@@ -1,0 +1,84 @@
+/*
+ * C API of the host-side native runtime (libsrml_native.so).
+ *
+ * TPU-native counterpart of the reference's in-repo native layer
+ * (jvm/native/src/rapidsml_jni.{cpp,cu}: dgemmCov, calSVD, signFlip) and of
+ * the executor-side ingest hot loop (python/src/spark_rapids_ml/core.py:583-606).
+ * On TPU the device math belongs to XLA, so the native layer owns what runs
+ * on the HOST around the device: threaded data loading/conversion/concat
+ * (feeding jax.device_put), a pooled pinned-size allocator for staging
+ * buffers, covariance/eigh for driver-local PCA (the JNI path equivalent),
+ * and top-k merge for kNN tile results.
+ *
+ * All functions return 0 on success, negative on error, and are exported
+ * with C linkage for ctypes.
+ */
+
+#ifndef SRML_NATIVE_H
+#define SRML_NATIVE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- runtime info ---- */
+const char* srml_version(void);
+int srml_hardware_threads(void);
+
+/* ---- staging allocator ----
+ * Size-bucketed free-list allocator for host staging buffers (role of RMM's
+ * pool on the reference's GPU side, core.py:569-577: avoid per-batch
+ * malloc/free churn during ingest). Thread-safe. */
+void* srml_buf_alloc(size_t bytes);
+void  srml_buf_free(void* ptr);
+void  srml_buf_trim(void);           /* release cached blocks to the OS */
+size_t srml_buf_cached_bytes(void);
+
+/* ---- threaded ingest (HOT LOOP 1 equivalent) ----
+ * Parallel copy of n_parts row-blocks into one contiguous C-order matrix,
+ * with optional dtype widening/narrowing. srcs[i] points to parts[i] of
+ * rows[i] x cols elements. */
+int srml_concat_f32(const float* const* srcs, const int64_t* rows,
+                    int n_parts, int64_t cols, float* dst);
+int srml_concat_f64_to_f32(const double* const* srcs, const int64_t* rows,
+                           int n_parts, int64_t cols, float* dst);
+int srml_concat_f64(const double* const* srcs, const int64_t* rows,
+                    int n_parts, int64_t cols, double* dst);
+
+/* Threaded CSV loader: numeric csv (no header handling beyond skip_rows)
+ * into a preallocated f32 C-order matrix. Returns rows parsed or <0. */
+int64_t srml_load_csv_f32(const char* path, int64_t max_rows, int64_t cols,
+                          int skip_rows, char delimiter, float* dst);
+
+/* ---- driver-local PCA math (JNI calSVD / dgemmCov equivalents) ----
+ * Threaded upper-triangle accumulation: cov += X^T X and colsum += sum(X).
+ * X is n x d C-order. Call once per partition, then srml_cov_finalize. */
+int srml_cov_accumulate(const double* X, int64_t n, int64_t d,
+                        double* xtx, double* colsum);
+/* Finalize covariance: cov = (xtx - n * mean mean^T) / (n - 1), mean out. */
+int srml_cov_finalize(double* xtx, const double* colsum, int64_t n, int64_t d,
+                      double* mean);
+/* Cyclic-Jacobi symmetric eigendecomposition, eigenvalues descending,
+ * deterministic eigenvector signs (largest-|component| positive — the
+ * signFlip semantics of rapidsml_jni.cu:35-61). A is d x d, destroyed.
+ * evecs is d x d C-order, row i = component i. */
+int srml_eigh_jacobi(double* A, int64_t d, double* evals, double* evecs);
+
+/* ---- kNN host-side merge ----
+ * Merge two sorted-by-distance candidate lists per query row into the first:
+ * (da, ia) and (db, ib) are n x k. */
+int srml_topk_merge(float* da, int64_t* ia, const float* db, const int64_t* ib,
+                    int64_t n, int k);
+/* Select k smallest from an n x m distance tile per row (heap select),
+ * writing sorted distances + source ids (ids = id_base + col). */
+int srml_topk_select(const float* dists, int64_t n, int64_t m, int k,
+                     int64_t id_base, float* out_d, int64_t* out_i);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SRML_NATIVE_H */

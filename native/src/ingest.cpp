@@ -1,0 +1,141 @@
+//
+// Threaded ingest: partition concat with dtype conversion, and a CSV loader.
+//
+// Host-side counterpart of the reference executor's data loop
+// (core.py:583-606: Arrow batches -> numpy -> C-order concat) and of
+// _concat_and_free (utils.py:199-221). The concat feeds jax.device_put, so
+// it is the host bandwidth hot path; each destination row-block is copied by
+// a different thread.
+//
+
+#include "srml_native.h"
+
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <vector>
+
+namespace srml {
+void parallel_for(int64_t n, const std::function<void(int64_t, int64_t)>& fn);
+}
+
+namespace {
+
+template <typename SRC, typename DST>
+int concat_impl(const SRC* const* srcs, const int64_t* rows, int n_parts,
+                int64_t cols, DST* dst) {
+  if (!srcs || !rows || !dst || n_parts < 0 || cols <= 0) return -1;
+  std::vector<int64_t> offsets(n_parts + 1, 0);
+  for (int i = 0; i < n_parts; ++i) {
+    if (rows[i] < 0 || (!srcs[i] && rows[i] > 0)) return -2;
+    offsets[i + 1] = offsets[i] + rows[i];
+  }
+  srml::parallel_for(n_parts, [&](int64_t lo, int64_t hi) {
+    for (int64_t p = lo; p < hi; ++p) {
+      const SRC* src = srcs[p];
+      DST* out = dst + offsets[p] * cols;
+      int64_t count = rows[p] * cols;
+      if (std::is_same<SRC, DST>::value) {
+        std::memcpy(out, src, sizeof(DST) * count);
+      } else {
+        for (int64_t j = 0; j < count; ++j) out[j] = static_cast<DST>(src[j]);
+      }
+    }
+  });
+  return 0;
+}
+
+}  // namespace
+
+extern "C" int srml_concat_f32(const float* const* srcs, const int64_t* rows,
+                               int n_parts, int64_t cols, float* dst) {
+  return concat_impl(srcs, rows, n_parts, cols, dst);
+}
+
+extern "C" int srml_concat_f64_to_f32(const double* const* srcs,
+                                      const int64_t* rows, int n_parts,
+                                      int64_t cols, float* dst) {
+  return concat_impl(srcs, rows, n_parts, cols, dst);
+}
+
+extern "C" int srml_concat_f64(const double* const* srcs, const int64_t* rows,
+                               int n_parts, int64_t cols, double* dst) {
+  return concat_impl(srcs, rows, n_parts, cols, dst);
+}
+
+// ---------------------------------------------------------------------------
+// CSV loader: read whole file, split line ranges across threads
+// ---------------------------------------------------------------------------
+
+extern "C" int64_t srml_load_csv_f32(const char* path, int64_t max_rows,
+                                     int64_t cols, int skip_rows,
+                                     char delimiter, float* dst) {
+  if (!path || !dst || cols <= 0 || max_rows < 0) return -1;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -2;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size < 0) {
+    std::fclose(f);
+    return -2;
+  }
+  // stage the file through the pooled allocator (repeated loads reuse the
+  // same block instead of faulting fresh pages each call)
+  char* buf = static_cast<char*>(srml_buf_alloc(static_cast<size_t>(size) + 1));
+  if (!buf) {
+    std::fclose(f);
+    return -4;
+  }
+  size_t got = std::fread(buf, 1, static_cast<size_t>(size), f);
+  std::fclose(f);
+  buf[got] = '\0';
+
+  // index line starts and NUL-terminate each line so field parsing can never
+  // run past its own row (a short row must not steal the next row's values)
+  std::vector<char*> lines;
+  char* p = buf;
+  char* end = buf + got;
+  while (p < end) {
+    lines.push_back(p);
+    char* nl = static_cast<char*>(std::memchr(p, '\n', end - p));
+    if (nl) {
+      *nl = '\0';
+      p = nl + 1;
+    } else {
+      p = end;
+    }
+  }
+  int64_t first = std::min<int64_t>(skip_rows, (int64_t)lines.size());
+  int64_t n_rows = std::min<int64_t>(max_rows, (int64_t)lines.size() - first);
+  if (n_rows <= 0) {
+    srml_buf_free(buf);
+    return 0;
+  }
+
+  std::atomic<int64_t> bad_row{-1};
+  srml::parallel_for(n_rows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      const char* q = lines[first + r];
+      float* out = dst + r * cols;
+      for (int64_t c = 0; c < cols; ++c) {
+        char* next = nullptr;
+        out[c] = std::strtof(q, &next);
+        if (next == q) {  // short/garbage row: report malformed input
+          out[c] = 0.0f;
+          int64_t expect = -1;
+          bad_row.compare_exchange_strong(expect, first + r);
+        } else {
+          q = next;
+        }
+        while (*q == delimiter || *q == ' ' || *q == '\r') ++q;
+      }
+    }
+  });
+  srml_buf_free(buf);
+  if (bad_row.load() >= 0) return -3;  // consistent with np.loadtxt raising
+  return n_rows;
+}

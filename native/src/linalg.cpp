@@ -1,0 +1,150 @@
+//
+// Driver-local PCA math: covariance accumulation and Jacobi eigh.
+//
+// Functional equivalent of the reference's JNI kernels
+// (jvm/native/src/rapidsml_jni.cu): dgemmCov (:109-127) becomes a blocked,
+// threaded X^T X accumulation; calSVD (:215-269, raft eigDC + reverse +
+// signFlip) becomes cyclic-Jacobi eigendecomposition with descending sort
+// and the same deterministic sign convention (rapidsml_jni.cu:35-61: flip a
+// component so its max-|.| coordinate is positive).
+//
+
+#include "srml_native.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <numeric>
+#include <vector>
+
+namespace srml {
+void parallel_for(int64_t n, const std::function<void(int64_t, int64_t)>& fn);
+}
+
+extern "C" int srml_cov_accumulate(const double* X, int64_t n, int64_t d,
+                                   double* xtx, double* colsum) {
+  if (!X || !xtx || !colsum || n < 0 || d <= 0) return -1;
+  // blocked upper-triangle accumulation, rows split across threads into
+  // thread-local d x d tiles merged under a lock (partition-parallel like
+  // the per-partition dgemmCov calls reduced on the reference driver,
+  // RapidsRowMatrix.scala:110-141)
+  std::mutex mu;
+  constexpr int64_t kRowBlock = 256;
+  int64_t n_blocks = (n + kRowBlock - 1) / kRowBlock;
+  srml::parallel_for(n_blocks, [&](int64_t blo, int64_t bhi) {
+    std::vector<double> local_xtx(static_cast<size_t>(d) * d, 0.0);
+    std::vector<double> local_sum(static_cast<size_t>(d), 0.0);
+    for (int64_t b = blo; b < bhi; ++b) {
+      int64_t r0 = b * kRowBlock;
+      int64_t r1 = std::min(n, r0 + kRowBlock);
+      for (int64_t r = r0; r < r1; ++r) {
+        const double* row = X + r * d;
+        for (int64_t i = 0; i < d; ++i) {
+          local_sum[i] += row[i];
+          const double xi = row[i];
+          double* out = local_xtx.data() + i * d;
+          for (int64_t j = i; j < d; ++j) out[j] += xi * row[j];
+        }
+      }
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    for (int64_t i = 0; i < d; ++i) {
+      colsum[i] += local_sum[i];
+      for (int64_t j = i; j < d; ++j) xtx[i * d + j] += local_xtx[i * d + j];
+    }
+  });
+  return 0;
+}
+
+extern "C" int srml_cov_finalize(double* xtx, const double* colsum, int64_t n,
+                                 int64_t d, double* mean) {
+  if (!xtx || !colsum || !mean || n < 2 || d <= 0) return -1;
+  for (int64_t i = 0; i < d; ++i) mean[i] = colsum[i] / static_cast<double>(n);
+  const double denom = static_cast<double>(n - 1);
+  for (int64_t i = 0; i < d; ++i) {
+    for (int64_t j = i; j < d; ++j) {
+      double v = (xtx[i * d + j] - n * mean[i] * mean[j]) / denom;
+      xtx[i * d + j] = v;
+      xtx[j * d + i] = v;  // mirror lower triangle
+    }
+  }
+  return 0;
+}
+
+extern "C" int srml_eigh_jacobi(double* A, int64_t d, double* evals,
+                                double* evecs) {
+  if (!A || !evals || !evecs || d <= 0) return -1;
+  // V = I
+  std::memset(evecs, 0, sizeof(double) * d * d);
+  for (int64_t i = 0; i < d; ++i) evecs[i * d + i] = 1.0;
+
+  const int max_sweeps = 64;
+  const double eps = 1e-14;
+  for (int sweep = 0; sweep < max_sweeps; ++sweep) {
+    double off = 0.0;
+    for (int64_t i = 0; i < d; ++i)
+      for (int64_t j = i + 1; j < d; ++j) off += A[i * d + j] * A[i * d + j];
+    double norm = 0.0;
+    for (int64_t i = 0; i < d * d; ++i) norm += A[i] * A[i];
+    if (off <= eps * eps * (norm > 0 ? norm : 1.0)) break;
+
+    for (int64_t p = 0; p < d; ++p) {
+      for (int64_t q = p + 1; q < d; ++q) {
+        double apq = A[p * d + q];
+        if (std::fabs(apq) < 1e-300) continue;
+        double app = A[p * d + p], aqq = A[q * d + q];
+        double tau = (aqq - app) / (2.0 * apq);
+        double t = (tau >= 0 ? 1.0 : -1.0) /
+                   (std::fabs(tau) + std::sqrt(1.0 + tau * tau));
+        double c = 1.0 / std::sqrt(1.0 + t * t);
+        double s = t * c;
+        for (int64_t k = 0; k < d; ++k) {  // rotate rows/cols p,q of A
+          double akp = A[k * d + p], akq = A[k * d + q];
+          A[k * d + p] = c * akp - s * akq;
+          A[k * d + q] = s * akp + c * akq;
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          double apk = A[p * d + k], aqk = A[q * d + k];
+          A[p * d + k] = c * apk - s * aqk;
+          A[q * d + k] = s * apk + c * aqk;
+        }
+        for (int64_t k = 0; k < d; ++k) {  // accumulate V
+          double vkp = evecs[k * d + p], vkq = evecs[k * d + q];
+          evecs[k * d + p] = c * vkp - s * vkq;
+          evecs[k * d + q] = s * vkp + c * vkq;
+        }
+      }
+    }
+  }
+
+  // descending eigenvalue order
+  std::vector<int64_t> order(d);
+  std::iota(order.begin(), order.end(), 0);
+  std::vector<double> diag(d);
+  for (int64_t i = 0; i < d; ++i) diag[i] = A[i * d + i];
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return diag[a] > diag[b]; });
+
+  // write evals + components (row i of output = i-th eigenvector), with the
+  // deterministic sign flip of rapidsml_jni.cu:35-61
+  std::vector<double> sorted(static_cast<size_t>(d) * d);
+  for (int64_t i = 0; i < d; ++i) {
+    evals[i] = diag[order[i]];
+    double maxabs = 0.0;
+    int64_t argmax = 0;
+    for (int64_t k = 0; k < d; ++k) {
+      double v = evecs[k * d + order[i]];
+      sorted[i * d + k] = v;
+      if (std::fabs(v) > maxabs) {
+        maxabs = std::fabs(v);
+        argmax = k;
+      }
+    }
+    if (sorted[i * d + argmax] < 0.0)
+      for (int64_t k = 0; k < d; ++k) sorted[i * d + k] = -sorted[i * d + k];
+  }
+  std::memcpy(evecs, sorted.data(), sizeof(double) * d * d);
+  return 0;
+}

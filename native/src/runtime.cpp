@@ -1,0 +1,123 @@
+//
+// Runtime info + staging allocator + thread pool shared by the native layer.
+//
+// The allocator plays the role the RMM pool plays on the reference's GPU
+// side (core.py:569-577): ingest repeatedly needs large staging buffers per
+// Arrow batch; caching them in size buckets avoids malloc/page-fault churn.
+//
+
+#include "srml_native.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <new>
+#include <thread>
+#include <vector>
+
+extern "C" const char* srml_version(void) { return "0.1.0"; }
+
+extern "C" int srml_hardware_threads(void) {
+  unsigned n = std::thread::hardware_concurrency();
+  return n == 0 ? 1 : static_cast<int>(n);
+}
+
+// ---------------------------------------------------------------------------
+// staging allocator: power-of-two buckets, bounded cache
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Block {
+  size_t bytes;
+  // payload follows
+};
+
+constexpr size_t kHeader = 64;  // keep payload cacheline-aligned
+constexpr size_t kMaxCached = size_t(1) << 31;  // 2 GiB cache ceiling
+
+std::mutex g_pool_mu;
+std::multimap<size_t, void*> g_pool;  // bucket size -> raw block
+std::atomic<size_t> g_cached{0};
+
+size_t bucket_of(size_t bytes) {
+  size_t b = 256;
+  while (b < bytes) b <<= 1;
+  return b;
+}
+
+}  // namespace
+
+extern "C" void* srml_buf_alloc(size_t bytes) {
+  if (bytes == 0) bytes = 1;
+  size_t bucket = bucket_of(bytes);
+  {
+    std::lock_guard<std::mutex> lk(g_pool_mu);
+    auto it = g_pool.find(bucket);
+    if (it != g_pool.end()) {
+      void* raw = it->second;
+      g_pool.erase(it);
+      g_cached -= bucket;
+      return static_cast<char*>(raw) + kHeader;
+    }
+  }
+  void* raw = std::malloc(kHeader + bucket);
+  if (!raw) return nullptr;
+  static_cast<Block*>(raw)->bytes = bucket;
+  return static_cast<char*>(raw) + kHeader;
+}
+
+extern "C" void srml_buf_free(void* ptr) {
+  if (!ptr) return;
+  void* raw = static_cast<char*>(ptr) - kHeader;
+  size_t bucket = static_cast<Block*>(raw)->bytes;
+  {
+    std::lock_guard<std::mutex> lk(g_pool_mu);
+    if (g_cached + bucket <= kMaxCached) {
+      g_pool.emplace(bucket, raw);
+      g_cached += bucket;
+      return;
+    }
+  }
+  std::free(raw);
+}
+
+extern "C" void srml_buf_trim(void) {
+  std::lock_guard<std::mutex> lk(g_pool_mu);
+  for (auto& kv : g_pool) std::free(kv.second);
+  g_pool.clear();
+  g_cached = 0;
+}
+
+extern "C" size_t srml_buf_cached_bytes(void) { return g_cached.load(); }
+
+// ---------------------------------------------------------------------------
+// minimal parallel-for used by the other translation units
+// ---------------------------------------------------------------------------
+
+namespace srml {
+
+void parallel_for(int64_t n, const std::function<void(int64_t, int64_t)>& fn) {
+  int nthreads = srml_hardware_threads();
+  if (n <= 1 || nthreads <= 1) {
+    fn(0, n);
+    return;
+  }
+  if (nthreads > n) nthreads = static_cast<int>(n);
+  std::vector<std::thread> ts;
+  ts.reserve(nthreads);
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace srml

@@ -1,0 +1,83 @@
+//
+// kNN host-side top-k: per-row k-smallest selection from a distance tile and
+// two-way sorted-list merge.
+//
+// These are the host halves of the distributed exact-kNN path: the device
+// computes tile distances and per-tile top-k (lax.top_k in ops/knn.py); when
+// tiles stream back per ring step the host merges candidate lists without
+// re-sorting everything (the role the reference's NearestNeighborsMG
+// reduce step plays on GPU, knn.py:549-560).
+//
+
+#include "srml_native.h"
+
+#include <algorithm>
+#include <cmath>
+#include <functional>
+#include <utility>
+#include <vector>
+
+namespace srml {
+void parallel_for(int64_t n, const std::function<void(int64_t, int64_t)>& fn);
+}
+
+extern "C" int srml_topk_select(const float* dists, int64_t n, int64_t m,
+                                int k, int64_t id_base, float* out_d,
+                                int64_t* out_i) {
+  if (!dists || !out_d || !out_i || n < 0 || m <= 0 || k <= 0) return -1;
+  if (k > m) return -2;
+  srml::parallel_for(n, [&](int64_t lo, int64_t hi) {
+    std::vector<std::pair<float, int64_t>> heap;  // max-heap of k smallest
+    for (int64_t r = lo; r < hi; ++r) {
+      heap.clear();
+      const float* row = dists + r * m;
+      for (int64_t c = 0; c < m; ++c) {
+        float v = row[c];
+        if ((int64_t)heap.size() < k) {
+          heap.emplace_back(v, id_base + c);
+          std::push_heap(heap.begin(), heap.end());
+        } else if (v < heap.front().first) {
+          std::pop_heap(heap.begin(), heap.end());
+          heap.back() = {v, id_base + c};
+          std::push_heap(heap.begin(), heap.end());
+        }
+      }
+      std::sort_heap(heap.begin(), heap.end());
+      for (int j = 0; j < k; ++j) {
+        out_d[r * k + j] = heap[j].first;
+        out_i[r * k + j] = heap[j].second;
+      }
+    }
+  });
+  return 0;
+}
+
+extern "C" int srml_topk_merge(float* da, int64_t* ia, const float* db,
+                               const int64_t* ib, int64_t n, int k) {
+  if (!da || !ia || !db || !ib || n < 0 || k <= 0) return -1;
+  srml::parallel_for(n, [&](int64_t lo, int64_t hi) {
+    std::vector<float> md(k);
+    std::vector<int64_t> mi(k);
+    for (int64_t r = lo; r < hi; ++r) {
+      const float* a_d = da + r * k;
+      const int64_t* a_i = ia + r * k;
+      const float* b_d = db + r * k;
+      const int64_t* b_i = ib + r * k;
+      int i = 0, j = 0;
+      for (int out = 0; out < k; ++out) {
+        if (j >= k || (i < k && a_d[i] <= b_d[j])) {
+          md[out] = a_d[i];
+          mi[out] = a_i[i];
+          ++i;
+        } else {
+          md[out] = b_d[j];
+          mi[out] = b_i[j];
+          ++j;
+        }
+      }
+      std::copy(md.begin(), md.end(), da + r * k);
+      std::copy(mi.begin(), mi.end(), ia + r * k);
+    }
+  });
+  return 0;
+}

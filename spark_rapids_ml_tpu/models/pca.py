@@ -38,7 +38,7 @@ from ..params import (
     _dummy,
     _TpuParams,
 )
-from ..ops.linalg import pca_fit_kernel, pca_transform_kernel
+from ..ops.linalg import pca_fit, pca_transform_kernel
 from ..parallel.mesh import data_sharding
 
 
@@ -106,10 +106,9 @@ class PCA(_PCAParams, _TpuEstimator):
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
             k = params.get("n_components") or min(inputs.n_rows, inputs.n_cols)
             k = min(int(k), inputs.n_cols)
-            # whiten is honored at transform time (see PCAModel)
-            mean, components, var, ratio, sv = pca_fit_kernel(
-                inputs.X, inputs.weight, k
-            )
+            # whiten is honored at transform time (see PCAModel); wide inputs
+            # route the eigh through the native host runtime (ops.linalg.pca_fit)
+            mean, components, var, ratio, sv = pca_fit(inputs.X, inputs.weight, k)
             return {
                 "mean_": np.asarray(mean, dtype=np.float64),
                 "components_": np.asarray(components, dtype=np.float64),

@@ -112,6 +112,13 @@ def prepare_items(
     )
 
 
+# Item sets larger than this many bytes (per replica) are processed
+# out-of-core: item blocks stream through HBM one at a time and per-block
+# top-k candidate lists merge on the host via the native runtime
+# (native.topk_merge).  Overridable with SRML_KNN_HBM_BUDGET (bytes).
+_DEFAULT_HBM_BUDGET = 4 << 30
+
+
 def knn_search(
     items: np.ndarray,
     item_ids: np.ndarray,
@@ -123,9 +130,69 @@ def knn_search(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Host orchestration: shard items once, stream query blocks through the
     jitted kernel (block sizes are power-of-two buckets so the number of
-    compiled shapes is bounded; partial blocks padded)."""
+    compiled shapes is bounded; partial blocks padded).  Item sets too large
+    for HBM take the out-of-core route (knn_search_out_of_core)."""
+    import os
+
+    items = np.asarray(items, dtype=dtype)
+    budget = int(os.environ.get("SRML_KNN_HBM_BUDGET", _DEFAULT_HBM_BUDGET))
+    if items.nbytes > budget:
+        n_dev = mesh.shape[DATA_AXIS]
+        block_rows = max(n_dev, budget // max(items.shape[1] * items.itemsize, 1))
+        block_rows -= block_rows % n_dev
+        return knn_search_out_of_core(
+            items, item_ids, queries, k, mesh, max(block_rows, n_dev), query_block, dtype
+        )
     prepared = prepare_items(items, item_ids, mesh, dtype)
     return knn_search_prepared(prepared, queries, k, mesh, query_block, dtype)
+
+
+def knn_search_out_of_core(
+    items: np.ndarray,
+    item_ids: np.ndarray,
+    queries: np.ndarray,
+    k: int,
+    mesh: Mesh,
+    item_block: int,
+    query_block: int = 8192,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact kNN over an item set that exceeds HBM: stream item row-blocks
+    through the device kernel, keep a running per-query best-k merged on the
+    host by the native runtime (threaded two-way merge; numpy fallback).
+
+    This is the TPU shape of the reference's partition-at-a-time
+    NearestNeighborsMG exchange (knn.py:549-560): device does the MXU tile +
+    per-block top-k, host does the cheap (Q, k) candidate merge."""
+    from .. import native
+
+    best_d: np.ndarray = None  # type: ignore[assignment]
+    best_i: np.ndarray = None  # type: ignore[assignment]
+    n_items = items.shape[0]
+    for start in range(0, n_items, item_block):
+        stop = min(start + item_block, n_items)
+        prepared = prepare_items(items[start:stop], item_ids[start:stop], mesh, dtype)
+        d, i = knn_search_prepared(prepared, queries, k, mesh, query_block, dtype)
+        if best_d is None:
+            best_d, best_i = d, i
+        else:
+            # pad candidate lists to a common k (last block can return fewer)
+            width = max(best_d.shape[1], d.shape[1])
+
+            def _pad(dd, ii):
+                if dd.shape[1] == width:
+                    return dd, ii
+                pad = width - dd.shape[1]
+                return (
+                    np.pad(dd, ((0, 0), (0, pad)), constant_values=np.inf),
+                    np.pad(ii, ((0, 0), (0, pad)), constant_values=-1),
+                )
+
+            best_d, best_i = _pad(best_d, best_i)
+            d, i = _pad(d, i)
+            best_d, best_i = native.topk_merge(best_d, best_i, d, i)
+    k_eff = min(k, n_items)
+    return best_d[:, :k_eff], best_i[:, :k_eff]
 
 
 def knn_search_prepared(

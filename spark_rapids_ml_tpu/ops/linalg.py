@@ -14,6 +14,8 @@ from __future__ import annotations
 from functools import partial
 from typing import Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -67,6 +69,52 @@ def pca_fit_kernel(
     ratio = top_vals / total_var
     singular_values = jnp.sqrt(jnp.maximum(top_vals, 0.0) * (wsum - 1.0))
     return mean, components, top_vals, ratio, singular_values
+
+
+@jax.jit
+def covariance_kernel(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mesh-distributed (wsum, mean, cov): the MXU/ICI half of PCA."""
+    wsum, mean, scatter = weighted_moments(X, w)
+    cov = (scatter - wsum * jnp.outer(mean, mean)) / (wsum - 1.0)
+    return wsum, mean, (cov + cov.T) * 0.5
+
+
+# Above this column count the dense eigh leaves the jitted kernel for the
+# host: a (D, D) symmetric eigensolve has no MXU-friendly formulation, while
+# the native runtime (spark_rapids_ml_tpu.native: threaded LAPACK-or-Jacobi
+# with calSVD sign semantics) handles it in host DRAM — the same split the
+# reference uses when it runs raft eigDC on a single device after reducing
+# partial covariances on the driver (RapidsRowMatrix.scala:59-89).
+HOST_EIGH_MIN_D = 512
+
+
+def pca_fit(
+    X: jax.Array, w: jax.Array, k: int, host_eigh: bool = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Hybrid PCA fit: covariance on the mesh, eigh on device (small D) or on
+    the host native runtime (large D).  Returns numpy arrays
+    (mean, components, explained_variance, ratio, singular_values)."""
+    d = X.shape[1]
+    if host_eigh is None:
+        host_eigh = d >= HOST_EIGH_MIN_D
+    if not host_eigh:
+        return tuple(np.asarray(o) for o in pca_fit_kernel(X, w, k))  # type: ignore[return-value]
+    from .. import native
+
+    wsum_d, mean_d, cov_d = covariance_kernel(X, w)
+    wsum = float(np.asarray(wsum_d))
+    mean = np.asarray(mean_d, dtype=np.float64)
+    cov = np.asarray(cov_d, dtype=np.float64)
+    evals, comps = native.eigh_descending(cov)
+    top = np.maximum(evals[:k], 0.0)
+    total = max(evals.sum(), np.finfo(np.float64).tiny)
+    return (
+        mean,
+        comps[:k],
+        evals[:k],
+        evals[:k] / total,
+        np.sqrt(top * (wsum - 1.0)),
+    )
 
 
 @jax.jit

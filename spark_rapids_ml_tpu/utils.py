@@ -50,10 +50,23 @@ def dtype_to_pyspark_type(dtype: Union[np.dtype, str]) -> str:
 
 def _concat_and_free(array_list: List[np.ndarray], order: str = "F") -> np.ndarray:
     """Concatenate row chunks while freeing inputs incrementally to bound peak
-    host memory (behavioral analog of reference utils.py:199-221)."""
+    host memory (behavioral analog of reference utils.py:199-221).  C-order
+    float matrices route through the threaded native runtime when built
+    (native.concat_rows), the host-bandwidth half of ingest."""
     if len(array_list) == 1:
         arr = array_list.pop()
         return np.asarray(arr, order=order)  # type: ignore[call-overload]
+    if (
+        order == "C"
+        and array_list[0].ndim == 2
+        and array_list[0].dtype in (np.float32, np.float64)
+    ):
+        from . import native
+
+        if native.available():
+            out = native.concat_rows(array_list, array_list[0].dtype)
+            array_list.clear()
+            return out
     rows = sum(a.shape[0] for a in array_list)
     if array_list[0].ndim == 1:
         out = np.empty((rows,), dtype=array_list[0].dtype)
