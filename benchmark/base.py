@@ -122,13 +122,13 @@ class BenchmarkBase:
     def _read_file(path: str) -> pd.DataFrame:
         if path.endswith(".csv"):
             # header line = column names; numeric payload loads through the
-            # native threaded CSV reader (numpy fallback inside native.load_csv)
+            # native threaded CSV reader (numpy fallback inside native.load_csv),
+            # which row-counts natively — no Python pass over the file
             from spark_rapids_ml_tpu import native
 
             with open(path) as f:
                 header = f.readline().strip().split(",")
-                n_rows = sum(1 for _ in f)
-            data = native.load_csv(path, n_rows, len(header), skip_rows=1)
+            data = native.load_csv(path, None, len(header), skip_rows=1)
             return pd.DataFrame(data, columns=header)
         return pd.read_parquet(path)
 

@@ -1,0 +1,31 @@
+#!/bin/bash
+# CI entry point (counterpart of the reference's ci/test.sh: lint -> unit
+# tests -> benchmark smoke on tiny data).
+set -ex
+
+cd "$(dirname "$0")/.."
+
+# 1. lint / static checks (byte-compile everything; mypy/black optional in
+#    this image)
+python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
+
+# 2. native runtime build
+make -C native
+
+# 3. unit tests on the virtual 8-device CPU mesh
+python -m pytest tests/ -x -q
+
+# 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
+SMOKE_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR"' EXIT
+python -m benchmark.gen_data blobs --num_rows 1000 --num_cols 8 --n_clusters 4 \
+    --output_dir "$SMOKE_DIR/blobs" --output_num_files 2
+python -m benchmark.gen_data regression --num_rows 1000 --num_cols 8 \
+    --output_dir "$SMOKE_DIR/reg" --output_num_files 2
+python -m benchmark.benchmark_runner kmeans --train_path "$SMOKE_DIR/blobs" \
+    --k 4 --maxIter 5 --report_path "$SMOKE_DIR/report.jsonl"
+python -m benchmark.benchmark_runner linear_regression --train_path "$SMOKE_DIR/reg" \
+    --report_path "$SMOKE_DIR/report.jsonl"
+test "$(wc -l < "$SMOKE_DIR/report.jsonl")" -eq 2
+
+echo "CI OK"

@@ -48,8 +48,13 @@ int srml_concat_f64_to_f32(const double* const* srcs, const int64_t* rows,
 int srml_concat_f64(const double* const* srcs, const int64_t* rows,
                     int n_parts, int64_t cols, double* dst);
 
+/* Count data rows (newlines, plus an unterminated final line) in one
+ * buffered sweep, so callers can size the destination exactly. */
+int64_t srml_csv_count_rows(const char* path);
+
 /* Threaded CSV loader: numeric csv (no header handling beyond skip_rows)
- * into a preallocated f32 C-order matrix. Returns rows parsed or <0. */
+ * into a preallocated f32 C-order matrix. Returns rows parsed, or <0
+ * (-3 = a row had fewer than `cols` numeric fields). */
 int64_t srml_load_csv_f32(const char* path, int64_t max_rows, int64_t cols,
                           int skip_rows, char delimiter, float* dst);
 

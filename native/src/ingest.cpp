@@ -70,6 +70,30 @@ extern "C" int srml_concat_f64(const double* const* srcs, const int64_t* rows,
 // CSV loader: read whole file, split line ranges across threads
 // ---------------------------------------------------------------------------
 
+extern "C" int64_t srml_csv_count_rows(const char* path) {
+  // one memchr sweep over the file; orders of magnitude faster than a Python
+  // line iteration and lets callers size the destination exactly
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -2;
+  constexpr size_t kChunk = 1 << 20;
+  std::vector<char> chunk(kChunk);
+  int64_t rows = 0;
+  size_t got;
+  char last = '\n';
+  while ((got = std::fread(chunk.data(), 1, kChunk, f)) > 0) {
+    const char* p = chunk.data();
+    const char* end = p + got;
+    while ((p = static_cast<const char*>(std::memchr(p, '\n', end - p)))) {
+      ++rows;
+      ++p;
+    }
+    last = chunk[got - 1];
+  }
+  std::fclose(f);
+  if (last != '\n') ++rows;  // unterminated final line
+  return rows;
+}
+
 extern "C" int64_t srml_load_csv_f32(const char* path, int64_t max_rows,
                                      int64_t cols, int skip_rows,
                                      char delimiter, float* dst) {

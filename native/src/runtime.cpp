@@ -39,6 +39,10 @@ struct Block {
 
 constexpr size_t kHeader = 64;  // keep payload cacheline-aligned
 constexpr size_t kMaxCached = size_t(1) << 31;  // 2 GiB cache ceiling
+// Blocks above this bypass the pool entirely: power-of-two rounding of a
+// multi-GiB staging buffer would double peak memory, and caching it would
+// pin it for the process lifetime.  bytes==0 in the header marks them.
+constexpr size_t kMaxPooled = size_t(64) << 20;
 
 std::mutex g_pool_mu;
 std::multimap<size_t, void*> g_pool;  // bucket size -> raw block
@@ -54,6 +58,12 @@ size_t bucket_of(size_t bytes) {
 
 extern "C" void* srml_buf_alloc(size_t bytes) {
   if (bytes == 0) bytes = 1;
+  if (bytes > kMaxPooled) {
+    void* raw = std::malloc(kHeader + bytes);
+    if (!raw) return nullptr;
+    static_cast<Block*>(raw)->bytes = 0;  // non-pooled marker
+    return static_cast<char*>(raw) + kHeader;
+  }
   size_t bucket = bucket_of(bytes);
   {
     std::lock_guard<std::mutex> lk(g_pool_mu);
@@ -75,6 +85,10 @@ extern "C" void srml_buf_free(void* ptr) {
   if (!ptr) return;
   void* raw = static_cast<char*>(ptr) - kHeader;
   size_t bucket = static_cast<Block*>(raw)->bytes;
+  if (bucket == 0) {  // non-pooled big block
+    std::free(raw);
+    return;
+  }
   {
     std::lock_guard<std::mutex> lk(g_pool_mu);
     if (g_cached + bucket <= kMaxCached) {

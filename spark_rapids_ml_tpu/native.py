@@ -46,6 +46,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.srml_buf_alloc.restype = ctypes.c_void_p
     lib.srml_buf_alloc.argtypes = [ctypes.c_size_t]
     lib.srml_buf_free.argtypes = [ctypes.c_void_p]
+    lib.srml_buf_trim.argtypes = []
     lib.srml_buf_cached_bytes.restype = ctypes.c_size_t
     lib.srml_concat_f32.restype = ctypes.c_int
     lib.srml_concat_f32.argtypes = [
@@ -59,6 +60,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.srml_concat_f64.argtypes = [
         ctypes.POINTER(_c_double_p), _c_int64_p, ctypes.c_int, ctypes.c_int64, _c_double_p,
     ]
+    lib.srml_csv_count_rows.restype = ctypes.c_int64
+    lib.srml_csv_count_rows.argtypes = [ctypes.c_char_p]
     lib.srml_load_csv_f32.restype = ctypes.c_int64
     lib.srml_load_csv_f32.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_char, _c_float_p,
@@ -163,9 +166,23 @@ def concat_rows(parts: List[np.ndarray], dtype: np.dtype) -> np.ndarray:
     return dst
 
 
-def load_csv(path: str, rows: int, cols: int, skip_rows: int = 0, delimiter: str = ",") -> np.ndarray:
+def csv_count_rows(path: str) -> int:
+    """Rows in a text file, counted natively (fallback: Python iteration)."""
+    l = lib()
+    if l is None:
+        with open(path, "rb") as f:
+            return sum(1 for _ in f)
+    got = l.srml_csv_count_rows(path.encode())
+    if got < 0:
+        raise RuntimeError(f"srml_csv_count_rows failed: {got}")
+    return int(got)
+
+
+def load_csv(path: str, rows: Optional[int] = None, cols: int = 0, skip_rows: int = 0, delimiter: str = ",") -> np.ndarray:
     """Threaded numeric-CSV load into an f32 matrix (falls back to
-    np.loadtxt)."""
+    np.loadtxt).  rows=None sizes the destination with a native row count."""
+    if rows is None:
+        rows = csv_count_rows(path) - skip_rows
     l = lib()
     if l is None:
         out = np.loadtxt(path, delimiter=delimiter, skiprows=skip_rows, dtype=np.float32, ndmin=2)

@@ -136,9 +136,12 @@ def knn_search(
 
     items = np.asarray(items, dtype=dtype)
     budget = int(os.environ.get("SRML_KNN_HBM_BUDGET", _DEFAULT_HBM_BUDGET))
-    if items.nbytes > budget:
-        n_dev = mesh.shape[DATA_AXIS]
-        block_rows = max(n_dev, budget // max(items.shape[1] * items.itemsize, 1))
+    n_dev = mesh.shape[DATA_AXIS]
+    # items are row-sharded, so the per-replica residency is nbytes / n_dev
+    if items.nbytes > budget * n_dev:
+        block_rows = max(
+            n_dev, (budget * n_dev) // max(items.shape[1] * items.itemsize, 1)
+        )
         block_rows -= block_rows % n_dev
         return knn_search_out_of_core(
             items, item_ids, queries, k, mesh, max(block_rows, n_dev), query_block, dtype
@@ -173,23 +176,23 @@ def knn_search_out_of_core(
         stop = min(start + item_block, n_items)
         prepared = prepare_items(items[start:stop], item_ids[start:stop], mesh, dtype)
         d, i = knn_search_prepared(prepared, queries, k, mesh, query_block, dtype)
+        # pad every block's candidate list out to k columns (a block smaller
+        # than k returns fewer) so the running merge always keeps k
+        # candidates — merging at a narrower width would silently drop
+        # neighbors contributed by later blocks
+        def _pad(dd, ii):
+            if dd.shape[1] >= k:
+                return dd[:, :k], ii[:, :k]
+            pad = k - dd.shape[1]
+            return (
+                np.pad(dd, ((0, 0), (0, pad)), constant_values=np.inf),
+                np.pad(ii, ((0, 0), (0, pad)), constant_values=-1),
+            )
+
+        d, i = _pad(d, i)
         if best_d is None:
             best_d, best_i = d, i
         else:
-            # pad candidate lists to a common k (last block can return fewer)
-            width = max(best_d.shape[1], d.shape[1])
-
-            def _pad(dd, ii):
-                if dd.shape[1] == width:
-                    return dd, ii
-                pad = width - dd.shape[1]
-                return (
-                    np.pad(dd, ((0, 0), (0, pad)), constant_values=np.inf),
-                    np.pad(ii, ((0, 0), (0, pad)), constant_values=-1),
-                )
-
-            best_d, best_i = _pad(best_d, best_i)
-            d, i = _pad(d, i)
             best_d, best_i = native.topk_merge(best_d, best_i, d, i)
     k_eff = min(k, n_items)
     return best_d[:, :k_eff], best_i[:, :k_eff]

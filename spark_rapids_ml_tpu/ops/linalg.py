@@ -81,11 +81,12 @@ def covariance_kernel(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array,
 
 # Above this column count the dense eigh leaves the jitted kernel for the
 # host: a (D, D) symmetric eigensolve has no MXU-friendly formulation, while
-# the native runtime (spark_rapids_ml_tpu.native: threaded LAPACK-or-Jacobi
-# with calSVD sign semantics) handles it in host DRAM — the same split the
-# reference uses when it runs raft eigDC on a single device after reducing
-# partial covariances on the driver (RapidsRowMatrix.scala:59-89).
-HOST_EIGH_MIN_D = 512
+# the native runtime (spark_rapids_ml_tpu.native.eigh_descending: the C++
+# Jacobi kernel up to d=256, blocked LAPACK beyond, both with calSVD sign
+# semantics) handles it in host DRAM — the same split the reference uses
+# when it runs raft eigDC on a single device after reducing partial
+# covariances on the driver (RapidsRowMatrix.scala:59-89).
+HOST_EIGH_MIN_D = 128
 
 
 def pca_fit(

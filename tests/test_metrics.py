@@ -1,0 +1,177 @@
+#
+# Metrics tests (reference python/tests/test_metrics.py): MulticlassMetrics
+# and RegressionMetrics checked against sklearn ground truth, plus the
+# mergeability property the distributed evaluate path depends on — metrics
+# from per-partition partials must equal metrics from the whole array.
+#
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_rapids_ml_tpu.evaluation import (  # noqa: E402
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.metrics import (  # noqa: E402
+    MulticlassMetrics,
+    RegressionMetrics,
+    log_loss,
+)
+
+
+@pytest.fixture
+def cls_data():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, size=500).astype(np.float64)
+    preds = labels.copy()
+    flip = rng.random(500) < 0.3  # 30% errors
+    preds[flip] = rng.integers(0, 4, size=int(flip.sum())).astype(np.float64)
+    probs = rng.dirichlet(np.ones(4), size=500)
+    # make probs consistent-ish with preds
+    probs[np.arange(500), preds.astype(int)] += 1.0
+    probs /= probs.sum(axis=1, keepdims=True)
+    return labels, preds, probs
+
+
+@pytest.fixture
+def reg_data():
+    rng = np.random.default_rng(1)
+    labels = rng.standard_normal(400) * 3.0 + 1.0
+    preds = labels + rng.standard_normal(400) * 0.7
+    return labels, preds
+
+
+class TestMulticlassMetrics:
+    def test_against_sklearn(self, cls_data):
+        from sklearn.metrics import (
+            accuracy_score,
+            f1_score,
+            precision_score,
+            recall_score,
+        )
+
+        labels, preds, probs = cls_data
+        m = MulticlassMetrics.from_arrays(labels, preds, probs=probs, eps=1e-15)
+        assert m.accuracy() == pytest.approx(accuracy_score(labels, preds))
+        assert m.weighted_fmeasure() == pytest.approx(
+            f1_score(labels, preds, average="weighted")
+        )
+        assert m.weighted_precision() == pytest.approx(
+            precision_score(labels, preds, average="weighted")
+        )
+        assert m.weighted_recall() == pytest.approx(
+            recall_score(labels, preds, average="weighted")
+        )
+        assert m.hamming_loss() == pytest.approx(1.0 - accuracy_score(labels, preds))
+
+    def test_log_loss_against_sklearn(self, cls_data):
+        from sklearn.metrics import log_loss as sk_log_loss
+
+        labels, _, probs = cls_data
+        ours = log_loss(labels, probs, eps=1e-15)
+        want = sk_log_loss(labels, probs, labels=[0.0, 1.0, 2.0, 3.0]) * len(labels)
+        assert ours == pytest.approx(want, rel=1e-9)
+
+    def test_merge_equals_whole(self, cls_data):
+        labels, preds, probs = cls_data
+        whole = MulticlassMetrics.from_arrays(labels, preds, probs=probs, eps=1e-15)
+        partials = [
+            MulticlassMetrics.from_arrays(
+                labels[i::3], preds[i::3], probs=probs[i::3], eps=1e-15
+            )
+            for i in range(3)
+        ]
+        merged = partials[0].merge(partials[1]).merge(partials[2])
+        assert merged.accuracy() == pytest.approx(whole.accuracy())
+        assert merged.weighted_fmeasure() == pytest.approx(whole.weighted_fmeasure())
+        assert merged.log_loss_metric() == pytest.approx(whole.log_loss_metric())
+
+    def test_by_label_metrics(self, cls_data):
+        from sklearn.metrics import precision_score, recall_score
+
+        labels, preds, _ = cls_data
+        m = MulticlassMetrics.from_arrays(labels, preds)
+        assert m._precision(2.0) == pytest.approx(
+            precision_score(labels, preds, labels=[2.0], average="macro")
+        )
+        assert m._recall(1.0) == pytest.approx(
+            recall_score(labels, preds, labels=[1.0], average="macro")
+        )
+
+    def test_evaluator_routing(self, cls_data):
+        labels, preds, probs = cls_data
+        m = MulticlassMetrics.from_arrays(labels, preds, probs=probs, eps=1e-15)
+        for name, want in [
+            ("accuracy", m.accuracy()),
+            ("f1", m.weighted_fmeasure()),
+            ("weightedPrecision", m.weighted_precision()),
+            ("weightedRecall", m.weighted_recall()),
+            ("logLoss", m.log_loss_metric()),
+            ("hammingLoss", m.hamming_loss()),
+        ]:
+            ev = MulticlassClassificationEvaluator(metricName=name)
+            assert m.evaluate(ev) == pytest.approx(want)
+        larger = MulticlassClassificationEvaluator(metricName="accuracy")
+        assert larger.isLargerBetter()
+        smaller = MulticlassClassificationEvaluator(metricName="logLoss")
+        assert not smaller.isLargerBetter()
+
+
+class TestRegressionMetrics:
+    def test_against_sklearn(self, reg_data):
+        from sklearn.metrics import (
+            mean_absolute_error,
+            mean_squared_error,
+            r2_score,
+        )
+
+        labels, preds = reg_data
+        m = RegressionMetrics.from_arrays(labels, preds)
+        assert m.mean_squared_error == pytest.approx(mean_squared_error(labels, preds))
+        assert m.root_mean_squared_error == pytest.approx(
+            np.sqrt(mean_squared_error(labels, preds))
+        )
+        assert m.mean_absolute_error == pytest.approx(
+            mean_absolute_error(labels, preds)
+        )
+        assert m.r2(through_origin=False) == pytest.approx(r2_score(labels, preds))
+
+    def test_merge_equals_whole(self, reg_data):
+        labels, preds = reg_data
+        whole = RegressionMetrics.from_arrays(labels, preds)
+        parts = [
+            RegressionMetrics.from_arrays(labels[i::4], preds[i::4]) for i in range(4)
+        ]
+        merged = parts[0]
+        for p in parts[1:]:
+            merged = merged.merge(p)
+        assert merged.mean_squared_error == pytest.approx(whole.mean_squared_error)
+        assert merged.r2(False) == pytest.approx(whole.r2(False))
+        assert merged.mean_absolute_error == pytest.approx(whole.mean_absolute_error)
+
+    def test_evaluator_routing(self, reg_data):
+        labels, preds = reg_data
+        m = RegressionMetrics.from_arrays(labels, preds)
+        for name, want in [
+            ("rmse", m.root_mean_squared_error),
+            ("mse", m.mean_squared_error),
+            ("mae", m.mean_absolute_error),
+            ("r2", m.r2(False)),
+        ]:
+            ev = RegressionEvaluator(metricName=name)
+            assert m.evaluate(ev) == pytest.approx(want)
+        assert not RegressionEvaluator(metricName="rmse").isLargerBetter()
+        assert RegressionEvaluator(metricName="r2").isLargerBetter()
+
+    def test_explained_variance(self, reg_data):
+        labels, preds = reg_data
+        m = RegressionMetrics.from_arrays(labels, preds)
+        # Spark's explainedVariance = SSreg / n (not sklearn's
+        # explained_variance_score); check against the direct formula
+        want = np.sum((preds - labels.mean()) ** 2) / len(labels)
+        assert m.explained_variance == pytest.approx(want, rel=1e-6)

@@ -60,6 +60,17 @@ def test_allocator_reuses_buffers():
     assert l.srml_buf_cached_bytes() == 0
 
 
+def test_allocator_big_blocks_bypass_pool():
+    l = native.lib()
+    l.srml_buf_trim()
+    big = (64 << 20) + 1  # just over the pooling ceiling
+    p = l.srml_buf_alloc(big)
+    assert p
+    l.srml_buf_free(p)
+    # big blocks are returned to the OS, never cached
+    assert l.srml_buf_cached_bytes() == 0
+
+
 @pytest.mark.parametrize(
     "src_dtype,dst_dtype",
     [(np.float32, np.float32), (np.float64, np.float32), (np.float64, np.float64)],
@@ -91,6 +102,14 @@ def test_load_csv(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def test_csv_count_rows(tmp_path):
+    path = tmp_path / "count.csv"
+    path.write_text("h\n1\n2\n3")  # unterminated last line counts
+    assert native.csv_count_rows(str(path)) == 4
+    got = native.load_csv(str(path), None, 1, skip_rows=1)
+    np.testing.assert_allclose(got[:, 0], [1.0, 2.0, 3.0])
+
+
 def test_load_csv_rejects_short_rows(tmp_path):
     path = tmp_path / "bad.csv"
     path.write_text("1.0,2.0,3.0\n4.0,5.0\n6.0,7.0,8.0\n")
@@ -111,6 +130,13 @@ def test_out_of_core_knn_matches_in_core():
     d_ooc, i_ooc = knn_search_out_of_core(items, ids, queries, 5, mesh, item_block=256)
     np.testing.assert_allclose(d_ooc, d_full, rtol=1e-5, atol=1e-5)
     np.testing.assert_array_equal(i_ooc, i_full)
+    # item_block < k: blocks return fewer than k candidates each, but the
+    # merged result must still produce all k true neighbors
+    d_tiny, i_tiny = knn_search_out_of_core(items, ids, queries, 16, mesh, item_block=8)
+    d_want, i_want = knn_search(items, ids, queries, 16, mesh)
+    assert d_tiny.shape == (37, 16)
+    np.testing.assert_allclose(d_tiny, d_want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(i_tiny, i_want)
 
 
 def test_covariance_matches_numpy():
@@ -156,6 +182,33 @@ def test_topk_merge():
     want = np.sort(np.concatenate([a, b], axis=1), axis=1)[:, :8]
     np.testing.assert_allclose(d, want, rtol=1e-6)
     assert ((i < 8) | (i >= 100)).all()
+
+
+def test_wide_pca_host_eigh_route_matches_device_route():
+    """PCA beyond HOST_EIGH_MIN_D columns routes eigh through the host native
+    runtime; both routes must agree."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+    from spark_rapids_ml_tpu.ops import linalg
+
+    assert linalg.HOST_EIGH_MIN_D <= 150
+    rng = np.random.default_rng(8)
+    X = (rng.standard_normal((400, 150)) @ rng.standard_normal((150, 150))).astype(
+        np.float32
+    )
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=4)
+    model = PCA(k=5).setInputCol("features").fit(df)  # host-eigh route (d>=128)
+    from sklearn.decomposition import PCA as SkPCA
+
+    sk = SkPCA(n_components=5).fit(X.astype(np.float64))
+    np.testing.assert_allclose(
+        model.explained_variance_ratio_, sk.explained_variance_ratio_, rtol=1e-2
+    )
+    for i in range(5):
+        dot = abs(np.dot(model.components_[i], sk.components_[i]))
+        assert dot > 0.99
 
 
 def test_pca_via_native_matches_sklearn():
