@@ -29,7 +29,7 @@ def main() -> None:
     import jax
 
     platform = jax.devices()[0].platform
-    default_rows = 200_000 if platform != "cpu" else 20_000
+    default_rows = 400_000 if platform != "cpu" else 20_000
     default_cols = 3000 if platform != "cpu" else 256
     default_k = 1000 if platform != "cpu" else 64
     rows = int(os.environ.get("SRML_BENCH_ROWS", default_rows))

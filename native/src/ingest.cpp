@@ -89,7 +89,9 @@ extern "C" int64_t srml_csv_count_rows(const char* path) {
     }
     last = chunk[got - 1];
   }
+  bool read_error = std::ferror(f) != 0;
   std::fclose(f);
+  if (read_error) return -2;  // short count must not pass as success
   if (last != '\n') ++rows;  // unterminated final line
   return rows;
 }

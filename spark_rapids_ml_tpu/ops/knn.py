@@ -31,6 +31,7 @@ from ..parallel.mesh import DATA_AXIS, data_sharding
 @partial(jax.jit, static_argnames=("mesh", "k"))
 def knn_block_kernel(
     items: jax.Array,      # (N_pad, D) row-sharded
+    item_norm: jax.Array,  # (N_pad,) row-sharded ||item||^2, cached across blocks
     item_pos: jax.Array,   # (N_pad,) int32 row-sharded position in the padded item set
     valid: jax.Array,      # (N_pad,) bool row-sharded
     queries: jax.Array,    # (Q, D) replicated
@@ -42,10 +43,11 @@ def knn_block_kernel(
     Returns (distances (Q, k) ascending euclidean, positions (Q, k)).
     Positions index the *padded* item set; callers map them to user ids on
     the host (user ids can be int64, which jax would silently truncate to
-    int32 — see PreparedItems.ids)."""
+    int32 — see PreparedItems.ids).  ||item||^2 is iteration-invariant, so
+    it is computed once at prepare time instead of once per query block (a
+    full HBM sweep over the item shard per block otherwise)."""
 
-    def per_shard(items_loc, ids_loc, valid_loc, q):
-        x_norm = (items_loc * items_loc).sum(axis=1)
+    def per_shard(items_loc, x_norm, ids_loc, valid_loc, q):
         d2 = (
             (q * q).sum(axis=1)[:, None]
             - 2.0 * (q @ items_loc.T)
@@ -67,23 +69,31 @@ def knn_block_kernel(
     d2, pos = shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=(P(), P()),
         check_vma=False,
-    )(items, item_pos, valid, queries)
+    )(items, item_norm, item_pos, valid, queries)
     return jnp.sqrt(jnp.maximum(d2, 0.0)), pos
 
 
 class PreparedItems:
-    """Item set padded + row-sharded to device once, reusable across many
-    knn_search_prepared calls (e.g. one per transform partition).  User ids
-    stay on the host in full int64 precision; the device only sees int32
-    positions."""
+    """Item set padded + row-sharded to device once (with cached ||x||^2),
+    reusable across many knn_search_prepared calls (e.g. one per transform
+    partition).  User ids stay on the host in full int64 precision; the
+    device only sees int32 positions."""
 
-    __slots__ = ("items", "pos", "valid", "ids")
+    __slots__ = ("items", "norm", "pos", "valid", "ids")
 
-    def __init__(self, items: jax.Array, pos: jax.Array, valid: jax.Array, ids: np.ndarray):
+    def __init__(
+        self,
+        items: jax.Array,
+        norm: jax.Array,
+        pos: jax.Array,
+        valid: jax.Array,
+        ids: np.ndarray,
+    ):
         self.items = items
+        self.norm = norm
         self.pos = pos
         self.valid = valid
         self.ids = ids  # (N_pad,) int64 host array, -1 in padding slots
@@ -104,8 +114,13 @@ def prepare_items(
     valid = np.zeros(n_pad, bool)
     valid[:n_items] = True
     sharding = data_sharding(mesh)
+    items_dev = jax.device_put(items_pad, sharding)
+    # jitted so the square fuses into the reduction — an eager x*x would
+    # materialize a second full-size item array in HBM at prepare time
+    norm = jax.jit(lambda x: jnp.einsum("nd,nd->n", x, x))(items_dev)
     return PreparedItems(
-        jax.device_put(items_pad, sharding),
+        items_dev,
+        norm,
         jax.device_put(np.arange(n_pad, dtype=np.int32), sharding),
         jax.device_put(valid, sharding),
         ids_pad,
@@ -228,7 +243,8 @@ def knn_search_prepared(
                 [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)], axis=0
             )
         d, pos = knn_block_kernel(
-            prepared.items, prepared.pos, prepared.valid, jnp.asarray(qb), mesh, k
+            prepared.items, prepared.norm, prepared.pos, prepared.valid,
+            jnp.asarray(qb), mesh, k,
         )
         out_d.append(np.asarray(d[:n_q]))
         # map device positions -> user ids on the host (int64-safe)
