@@ -148,7 +148,10 @@ class _TpuCaller(_TpuParams):
         mesh = get_mesh(self.num_workers)
         y_np = np.concatenate(labels) if labels is not None else None
         w_np = np.concatenate(weights) if weights is not None else np.ones(n_rows, dtype=dtype)
-        Xs, _ = shard_rows(X, mesh)
+        from . import profiling
+
+        with profiling.phase("srml.device_put"):
+            Xs, _ = shard_rows(X, mesh)
         n_pad = Xs.shape[0]
         mask = np.zeros(n_pad, dtype=dtype)
         mask[:n_rows] = w_np
@@ -179,9 +182,13 @@ class _TpuCaller(_TpuParams):
         """Dispatch one (or a batch of) fits on the device mesh (reference
         _call_cuml_fit_func core.py:488-640, single data load for all param
         maps as in _fit_internal core.py:723-752)."""
+        from . import profiling
+
+        profiling.reset_phase_times()
         df = as_dataframe(dataset)
         self._validate_parameters(df)
-        inputs = self._build_fit_inputs(df)
+        with profiling.phase("srml.ingest"):
+            inputs = self._build_fit_inputs(df)
         extra_params = None
         if paramMaps is not None:
             extra_params = [self._paramMap_to_tpu_overrides(pm) for pm in paramMaps]
@@ -191,7 +198,11 @@ class _TpuCaller(_TpuParams):
             "Invoking TPU fit: %d rows x %d cols on %d-device mesh",
             inputs.n_rows, inputs.n_cols, inputs.mesh.devices.size,
         )
-        return fit_func(inputs, dict(self._tpu_params))
+        with profiling.maybe_trace(type(self).__name__):
+            with profiling.phase("srml.fit"):
+                result = fit_func(inputs, dict(self._tpu_params))
+        self._last_fit_phase_times = profiling.phase_times()
+        return result
 
     def _paramMap_to_tpu_overrides(self, paramMap: Dict[Param, Any]) -> Dict[str, Any]:
         mapping = self._param_mapping()

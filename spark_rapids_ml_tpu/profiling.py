@@ -1,0 +1,117 @@
+#
+# Tracing / profiling hooks.
+#
+# TPU-native equivalent of the reference's observability surface (SURVEY.md
+# §5): the Scala path wraps phases in NVTX ranges
+# (/root/reference/jvm/src/main/scala/org/apache/spark/ml/linalg/distributed/RapidsRowMatrix.scala:62,70)
+# and the Python path logs coarse phase lines inside the fit UDF
+# (/root/reference/python/src/spark_rapids_ml/core.py:583,617) with wall-clock
+# timers in the benchmark harness
+# (/root/reference/python/benchmark/benchmark/utils.py:42-50).
+#
+# Here the same three ideas map to jax:
+#   - phase(name): a context manager emitting a jax.profiler.TraceAnnotation
+#     (named range in an xprof/tensorboard trace — the NVTX analog on TPU)
+#     plus a DEBUG log line with host wall-clock, and recording the duration
+#     in a per-thread registry that estimators expose after fit.
+#   - maybe_trace(): opt-in whole-program capture — set SRML_PROFILE=/some/dir
+#     and every top-level fit() writes an xprof trace there, the moral
+#     equivalent of running the reference benchmarks with NCCL_DEBUG=INFO.
+#   - with_benchmark(name, fn): wall-clock helper with the same shape as the
+#     reference's benchmark/utils.py:42-50.
+#
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+_log = logging.getLogger("spark_rapids_ml_tpu.profiling")
+
+PROFILE_ENV = "SRML_PROFILE"
+
+_tls = threading.local()
+
+
+def _registry() -> Dict[str, float]:
+    reg = getattr(_tls, "phases", None)
+    if reg is None:
+        reg = {}
+        _tls.phases = reg
+    return reg
+
+
+def reset_phase_times() -> None:
+    """Clear the current thread's phase registry (called at fit entry)."""
+    _registry().clear()
+
+
+def phase_times() -> Dict[str, float]:
+    """Seconds per named phase recorded on this thread since the last reset."""
+    return dict(_registry())
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Named range: xprof TraceAnnotation + wall-clock accounting.
+
+    The TraceAnnotation shows up in a tensorboard/xprof capture exactly where
+    NVTX ranges show up in nsys for the reference's Scala path."""
+    try:
+        import jax.profiler
+
+        annotation: contextlib.AbstractContextManager = jax.profiler.TraceAnnotation(
+            name
+        )
+    except Exception:  # pragma: no cover - profiler always importable with jax
+        annotation = contextlib.nullcontext()
+    t0 = time.perf_counter()
+    with annotation:
+        yield
+    dt = time.perf_counter() - t0
+    reg = _registry()
+    reg[name] = reg.get(name, 0.0) + dt
+    _log.debug("phase %s: %.3fs", name, dt)
+
+
+@contextlib.contextmanager
+def maybe_trace(tag: str = "fit") -> Iterator[None]:
+    """If SRML_PROFILE=<dir> is set, capture an xprof trace of the enclosed
+    region into <dir>/<tag>.  No-op (zero overhead) otherwise."""
+    out_dir = os.environ.get(PROFILE_ENV)
+    if not out_dir:
+        yield
+        return
+    import jax.profiler
+
+    target = os.path.join(out_dir, tag)
+    os.makedirs(target, exist_ok=True)
+    with jax.profiler.trace(target):
+        yield
+    _log.info("xprof trace for %r written to %s", tag, target)
+
+
+def with_benchmark(name: str, fn: Callable[[], Any]) -> Tuple[Any, float]:
+    """Run fn, returning (result, elapsed_seconds) and logging the timing —
+    the reference's benchmark/utils.py:42-50 helper."""
+    t0 = time.perf_counter()
+    result = fn()
+    dt = time.perf_counter() - t0
+    _log.info("-" * 100)
+    _log.info("%s took: %s sec", name, dt)
+    return result, dt
+
+
+def device_step_annotation(step: int) -> contextlib.AbstractContextManager:
+    """StepTraceAnnotation for iteration-granular traces (opt-in use in
+    benchmark loops)."""
+    try:
+        import jax.profiler
+
+        return jax.profiler.StepTraceAnnotation("step", step_num=step)
+    except Exception:  # pragma: no cover
+        return contextlib.nullcontext()

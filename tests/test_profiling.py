@@ -1,0 +1,60 @@
+# Tracing/profiling hooks (SURVEY.md §5: NVTX-range analog via
+# jax.profiler.TraceAnnotation + coarse phase logging, reference
+# RapidsRowMatrix.scala:62,70 and core.py:583,617).
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import profiling
+
+
+def test_phase_registry_accumulates():
+    profiling.reset_phase_times()
+    with profiling.phase("unit.a"):
+        pass
+    with profiling.phase("unit.a"):
+        pass
+    with profiling.phase("unit.b"):
+        pass
+    times = profiling.phase_times()
+    assert set(times) == {"unit.a", "unit.b"}
+    assert times["unit.a"] >= 0.0
+
+
+def test_with_benchmark_returns_result_and_elapsed():
+    result, elapsed = profiling.with_benchmark("unit", lambda: 42)
+    assert result == 42
+    assert elapsed >= 0.0
+
+
+def test_fit_records_phase_times():
+    from spark_rapids_ml_tpu import KMeans
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 8)).astype(np.float32)
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=2)
+    est = KMeans(k=3, maxIter=2).setFeaturesCol("features")
+    est.fit(df)
+    times = est._last_fit_phase_times
+    assert "srml.ingest" in times and "srml.fit" in times
+    assert times["srml.fit"] > 0.0
+
+
+def test_maybe_trace_writes_profile(tmp_path, monkeypatch):
+    # opt-in whole-fit xprof capture via SRML_PROFILE (NCCL_DEBUG analog)
+    monkeypatch.setenv(profiling.PROFILE_ENV, str(tmp_path))
+    with profiling.maybe_trace("unittrace"):
+        np.zeros(4).sum()
+    target = tmp_path / "unittrace"
+    assert target.is_dir()
+    # jax writes a plugins/profile subtree with at least one trace artifact
+    contents = [str(p) for p in target.rglob("*") if p.is_file()]
+    assert contents, "expected xprof trace files"
+
+
+def test_maybe_trace_noop_without_env(monkeypatch):
+    monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+    with profiling.maybe_trace("x"):
+        pass
