@@ -226,7 +226,9 @@ def random_init(X: jax.Array, w: jax.Array, k: int, seed: int):
 
 
 def kmeans_predict_kernel(X: jax.Array, centers: jax.Array) -> jax.Array:
-    c_norm = (centers * centers).sum(axis=1)
-    x_norm = (X * X).sum(axis=1)
-    d2 = x_norm[:, None] - 2.0 * (X @ centers.T) + c_norm[None, :]
-    return jnp.argmin(d2, axis=1).astype(jnp.int32)
+    # routes through the fused Pallas distance+argmin kernel on TPU (the
+    # (N, k) distance tile never touches HBM); identical-math XLA otherwise
+    from .pallas_tpu import min_dist_argmin
+
+    _, assign = min_dist_argmin(X, centers)
+    return assign

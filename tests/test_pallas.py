@@ -1,0 +1,76 @@
+# Pallas TPU kernel correctness (interpreter mode on the CPU test mesh).
+# The same kernel compiles with Mosaic on real TPU; the hardware-exactness
+# A/B record (v5e, argmin mismatch 0 vs the XLA path) is quoted in the
+# ops/pallas_tpu.py module header.  Set SRML_TPU_TESTS=1 to re-run this file
+# against real TPU devices, where min_dist_argmin takes the compiled Mosaic
+# path instead of the interpreter.
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.pallas_tpu import (
+    DISABLE_ENV,
+    _min_dist_argmin_xla,
+    min_dist_argmin,
+    pallas_enabled,
+)
+
+
+@pytest.mark.parametrize(
+    "n,d,k",
+    [
+        (300, 70, 33),     # nothing aligned
+        (512, 256, 128),   # everything aligned
+        (129, 1, 2),       # degenerate feature dim
+        (64, 515, 700),    # k > n, unaligned d
+    ],
+)
+def test_min_dist_argmin_matches_xla(n, d, k):
+    rng = np.random.default_rng(n + d + k)
+    X = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    C = jnp.asarray(rng.standard_normal((k, d)).astype(np.float32))
+    md, am = min_dist_argmin(X, C, interpret=True)
+    md_ref, am_ref = _min_dist_argmin_xla(
+        X, C, (X**2).sum(axis=1), (C**2).sum(axis=1)
+    )
+    assert md.shape == (n,) and am.shape == (n,)
+    # padded center slots (norm=+inf) must never win
+    assert int(np.asarray(am).max()) < k
+    np.testing.assert_array_equal(np.asarray(am), np.asarray(am_ref))
+    np.testing.assert_allclose(
+        np.asarray(md), np.asarray(md_ref), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_min_dist_argmin_precomputed_norms():
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((100, 40)).astype(np.float32))
+    C = jnp.asarray(rng.standard_normal((7, 40)).astype(np.float32))
+    xn = (X**2).sum(axis=1)
+    cn = (C**2).sum(axis=1)
+    md1, am1 = min_dist_argmin(X, C, xn, cn, interpret=True)
+    md2, am2 = min_dist_argmin(X, C, interpret=True)
+    np.testing.assert_array_equal(np.asarray(am1), np.asarray(am2))
+    np.testing.assert_allclose(np.asarray(md1), np.asarray(md2), rtol=1e-5)
+
+
+def test_pallas_disabled_by_env(monkeypatch):
+    monkeypatch.setenv(DISABLE_ENV, "1")
+    assert not pallas_enabled()
+
+
+def test_cpu_fallback_is_xla_path():
+    # on the CPU test mesh, min_dist_argmin without interpret must route to
+    # the XLA formulation and still be correct
+    if jax.devices()[0].platform == "tpu":
+        pytest.skip("CPU-only routing test")
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.standard_normal((50, 9)).astype(np.float32))
+    C = jnp.asarray(rng.standard_normal((4, 9)).astype(np.float32))
+    md, am = min_dist_argmin(X, C)
+    brute = np.argmin(
+        ((np.asarray(X)[:, None, :] - np.asarray(C)[None]) ** 2).sum(-1), axis=1
+    )
+    np.testing.assert_array_equal(np.asarray(am), brute)
