@@ -35,13 +35,20 @@ def _pad_chunks(n_loc: int, chunk: int) -> Tuple[int, int]:
     return n_chunks, n_chunks * chunk - n_loc
 
 
-def _chunked_assign_stats(X_loc, w_loc, centers, chunk, x_norm_loc):
+def _chunked_assign_stats(X_loc, w_loc, centers, chunk, x_norm_loc, exact_inertia=False):
     """Scan local rows in `chunk`-sized blocks; returns (sums[k,D], counts[k],
     inertia) for this device's rows.  Distances use the expanded form
     ||x||^2 - 2 x·c + ||c||^2 so the hot op is a (chunk, D) @ (D, k) matmul.
     ||x||^2 is invariant across Lloyd iterations, so it is computed once per
     fit and passed in — recomputing it per iteration costs a full extra HBM
-    sweep over X (measured ~45% of iteration time at d=3000)."""
+    sweep over X (measured ~45% of iteration time at d=3000).
+
+    exact_inertia=True recomputes each row's cost as ||x - c_assign||^2 from
+    a gathered-center difference: the expanded form cancels catastrophically
+    when distances are small relative to the norms, and on TPU the MXU's
+    single-pass bf16 products make that error ~0.4% of the *norm* magnitude
+    (measured 4.7x inflated inertia on tight blobs).  The difference form is
+    O(chunk*D) elementwise work — cheaper than the matmul it corrects."""
     n_loc, d = X_loc.shape
     k = centers.shape[0]
     n_chunks, pad = _pad_chunks(n_loc, chunk)
@@ -57,11 +64,12 @@ def _chunked_assign_stats(X_loc, w_loc, centers, chunk, x_norm_loc):
         xb, wb, x_norm = xw
         d2 = x_norm[:, None] - 2.0 * (xb @ centers.T) + c_norm[None, :]
         assign = jnp.argmin(d2, axis=1)
-        best = jnp.maximum(jnp.min(d2, axis=1), 0.0)
         onehot = jax.nn.one_hot(assign, k, dtype=xb.dtype) * wb[:, None]
         sums = sums + onehot.T @ xb
         counts = counts + onehot.sum(axis=0)
-        inertia = inertia + (best * wb).sum()
+        if exact_inertia:
+            diff = xb - centers[assign]
+            inertia = inertia + ((diff * diff).sum(axis=1) * wb).sum()
         return (sums, counts, inertia), None
 
     init = (
@@ -96,29 +104,30 @@ def lloyd_iterations(
         x_norm_loc = (X_loc * X_loc).sum(axis=1)  # hoisted out of the loop
 
         def cond(state):
-            centers, prev_shift, it, inertia = state
+            _, prev_shift, it = state
             return (it < max_iter) & (prev_shift > tol)
 
         def body(state):
-            centers, _, it, _ = state
-            sums, counts, inertia = _chunked_assign_stats(
+            centers, _, it = state
+            sums, counts, _ = _chunked_assign_stats(
                 X_loc, w_loc, centers, chunk, x_norm_loc
             )
             sums = jax.lax.psum(sums, DATA_AXIS)
             counts = jax.lax.psum(counts, DATA_AXIS)
-            inertia = jax.lax.psum(inertia, DATA_AXIS)
             nonempty = counts > 0
             new_centers = jnp.where(
                 nonempty[:, None], sums / jnp.maximum(counts, 1.0)[:, None], centers
             )
             shift = ((new_centers - centers) ** 2).sum()
-            return (new_centers, shift, it + 1, inertia)
+            return (new_centers, shift, it + 1)
 
-        init = (centers0, jnp.array(jnp.inf, X_loc.dtype), jnp.array(0, jnp.int32), jnp.array(0.0, X_loc.dtype))
-        centers, _, n_iter, inertia = jax.lax.while_loop(cond, body, init)
+        init = (centers0, jnp.array(jnp.inf, X_loc.dtype), jnp.array(0, jnp.int32))
+        centers, _, n_iter = jax.lax.while_loop(cond, body, init)
         # one final stats pass so inertia reflects the returned centers
+        # (exact difference-form cost: the reported inertia must not carry
+        # the training loop's fast-matmul cancellation error)
         _, _, final_inertia = _chunked_assign_stats(
-            X_loc, w_loc, centers, chunk, x_norm_loc
+            X_loc, w_loc, centers, chunk, x_norm_loc, exact_inertia=True
         )
         final_inertia = jax.lax.psum(final_inertia, DATA_AXIS)
         return centers, n_iter, final_inertia
@@ -226,8 +235,10 @@ def random_init(X: jax.Array, w: jax.Array, k: int, seed: int):
 
 
 def kmeans_predict_kernel(X: jax.Array, centers: jax.Array) -> jax.Array:
-    # routes through the fused Pallas distance+argmin kernel on TPU (the
-    # (N, k) distance tile never touches HBM); identical-math XLA otherwise
+    # min_dist_argmin routes by regime: the fused Pallas kernel on TPU in the
+    # memory-bound low-d/large-k regime (the (N, k) distance tile never
+    # touches HBM), exact-f32 XLA everywhere else — see
+    # pallas_tpu.min_dist_argmin for the measured crossover.
     from .pallas_tpu import min_dist_argmin
 
     _, assign = min_dist_argmin(X, centers)

@@ -11,22 +11,25 @@
 # when d % 128 != 0 — acceptable for the inference path this kernel serves.)
 #
 # Where it is used: KMeansModel.predict / transform
-# (ops/kmeans.py:kmeans_predict_kernel).  The Lloyd *training* loop
+# (ops/kmeans.py:kmeans_predict_kernel), routed by regime — see
+# min_dist_argmin() for the measured crossover.  The Lloyd *training* loop
 # deliberately keeps the XLA formulation: its assignment step feeds a
-# one-hot-matmul stats accumulation that wants the same X block anyway, and a
-# hardware A/B on a v5e (2026-07-29, n=32768 d=3000 k=1000: pallas 22.4 ms vs
-# XLA 19.4 ms per dispatch, argmin mismatch 0, max |min_d2| diff 0) showed
-# XLA's own fusion of this pattern is already at par, so fusing the training
-# path would add complexity for no measured win.  The same A/B is the
-# hardware-exactness record for this kernel: Mosaic-compiled argmin/min
-# matched the XLA path bit-for-bit on that shape.
+# one-hot-matmul stats accumulation that wants the same X block anyway, and
+# hardware A/Bs on a v5e (2026-07-29 default precision, 2026-07-30 HIGHEST)
+# showed XLA's fusion of this pattern wins whenever FLOPs dominate
+# (n=32768 d=3000 k=1000: pallas 13.5 ms vs XLA 10.0 ms at HIGHEST), while
+# the fused kernel wins the memory-bound low-d/large-k regime
+# (n=131072 d=32 k=16384: 27.4 vs 34.5 ms).  Hardware-exactness record at
+# HIGHEST precision: argmin mismatch 0, max |min_d2| diff 4.9e-4 on the
+# d=3000 shape.
 #
 # Grid layout: (row_tiles, center_tiles), center tiles innermost.  The row
 # block of X stays resident in VMEM across the inner sweep (its index map
 # ignores j), a running (min, argmin) pair persists in VMEM scratch, and the
-# final j step writes the result block.  Tile sizes are chosen from the
-# feature width so that X-block + double-buffered center blocks fit in ~10 MB
-# of VMEM (v5e has ~16 MB/core usable).
+# final j step writes the result block.  Tile sizes are chosen per feature
+# width by the scoped-VMEM model at _pick_tiles (2x double-buffered X/C
+# blocks + the f32 distance tile, against the _VMEM_BUDGET slice of the
+# ~16 MB/core).
 #
 # CPU fallback: everything routes through min_dist_argmin(), which uses the
 # plain XLA formulation off-TPU (tests exercise the kernel itself in
@@ -45,9 +48,14 @@ import numpy as np
 
 DISABLE_ENV = "SRML_DISABLE_PALLAS"
 
-# VMEM working-set budget for tile selection (bytes).  Conservative slice of
-# the ~16 MB/core so the Mosaic pipeliner has room to double-buffer.
-_VMEM_BUDGET = 10 * 1024 * 1024
+# Scoped-VMEM model for tile selection (bytes).  The estimate below charges
+# 2x the X/C input blocks (Mosaic double-buffers them, and the
+# HIGHEST-precision f32 dot keeps extra scratch) plus the (TILE_N, TILE_K)
+# f32 distance tile itself; 15 MB leaves margin under the ~16 MB/core scoped
+# limit.  Calibrated on v5e 2026-07-30: (256,256)@d_pad=3072 est 19.1 MB
+# really OOMs at 18.35 MB allocated; (1024,2048)@d_pad=128 est 13.2 MB
+# compiles; (2048,2048)@d_pad=128 est 22.2 MB OOMs.
+_VMEM_BUDGET = 15 * 1024 * 1024
 
 
 def pallas_enabled() -> bool:
@@ -64,11 +72,29 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+# Candidate (TILE_N, TILE_K) shapes, best-first.  Large center tiles win in
+# the low-d regime this kernel targets (fewer inner sweeps, d2 tile still
+# VMEM-resident); (2048, 1024) is deliberately absent — it fits the model
+# but fails Mosaic compilation on v5e.
+_TILE_CANDIDATES = (
+    (1024, 2048),
+    (1024, 1024),
+    (512, 1024),
+    (512, 512),
+    (512, 256),
+    (256, 256),
+    (256, 128),
+    (128, 128),
+)
+
+
 def _pick_tiles(d_pad: int, itemsize: int) -> Optional[Tuple[int, int]]:
-    """(TILE_N, TILE_K) so that (TILE_N + 2*TILE_K) * d_pad * itemsize fits
-    the VMEM budget; None if the feature dim is too wide for this kernel."""
-    for tile_n, tile_k in ((512, 512), (512, 256), (256, 256), (128, 128)):
-        if (tile_n + 2 * tile_k) * d_pad * itemsize <= _VMEM_BUDGET:
+    """Largest candidate (TILE_N, TILE_K) whose modeled scoped-VMEM use
+    (2x double-buffered X/C blocks + the f32 distance tile) fits the budget;
+    None if the feature dim is too wide for this kernel."""
+    for tile_n, tile_k in _TILE_CANDIDATES:
+        est = 2 * (tile_n + 2 * tile_k) * d_pad * itemsize + tile_n * tile_k * 4
+        if est <= _VMEM_BUDGET:
             return tile_n, tile_k
     return None
 
@@ -84,8 +110,16 @@ def _min_dist_kernel(xn_ref, x_ref, c_ref, cn_ref, min_ref, arg_ref, mins, args)
         mins[:] = jnp.full_like(mins, jnp.inf)
         args[:] = jnp.zeros_like(args)
 
-    # (TILE_N, TILE_K) distance tile — exists only in VMEM
-    cross = jnp.dot(x_ref[:], c_ref[:].T, preferred_element_type=jnp.float32)
+    # (TILE_N, TILE_K) distance tile — exists only in VMEM.  HIGHEST keeps
+    # the MXU multiply at full f32 (matching cuML's exact-f32 distances);
+    # the norm-expansion form cancels catastrophically, so single-pass bf16
+    # products can flip argmins between nearly-equidistant centers.
+    cross = jnp.dot(
+        x_ref[:],
+        c_ref[:].T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
     d2 = xn_ref[:] - 2.0 * cross + cn_ref[:]
     local_min = jnp.min(d2, axis=1, keepdims=True)
     local_arg = (
@@ -158,7 +192,13 @@ def _min_dist_argmin_pallas(
 def _min_dist_argmin_xla(
     X: jax.Array, centers: jax.Array, x_norm: jax.Array, c_norm: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:
-    d2 = x_norm[:, None] - 2.0 * (X @ centers.T) + c_norm[None, :]
+    cross = jnp.matmul(
+        X,
+        centers.T,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    d2 = x_norm[:, None] - 2.0 * cross + c_norm[None, :]
     return jnp.min(d2, axis=1), jnp.argmin(d2, axis=1).astype(jnp.int32)
 
 
@@ -181,8 +221,19 @@ def min_dist_argmin(
         c_norm = (centers.astype(jnp.float32) ** 2).sum(axis=1)
     use_pallas = interpret or pallas_enabled()
     if use_pallas:
-        d_pad = _round_up(X.shape[1], 128)
-        if _pick_tiles(d_pad, X.dtype.itemsize) is not None:
+        n, d = X.shape
+        k = centers.shape[0]
+        d_pad = _round_up(d, 128)
+        tiles = _pick_tiles(d_pad, X.dtype.itemsize)
+        # Routing (v5e A/B, HIGHEST precision, 2026-07-30): the fused kernel
+        # wins only when the (n, k) distance matrix dominates HBM traffic —
+        # low d, large k (d=32/k=16384: 27.4 ms vs XLA 34.5; d=64/k=8192:
+        # 15.3 vs 17.8).  When FLOPs dominate (d=3000/k=1000: 13.5 vs 10.0)
+        # or the batch pads up to one row tile (single-row predict), XLA's
+        # own fusion is the better program.  interpret mode bypasses the
+        # heuristic so tests always hit the kernel.
+        worthwhile = d_pad <= 256 and k >= 1024 and n >= tiles[0] if tiles else False
+        if tiles is not None and (interpret or worthwhile):
             return _min_dist_argmin_pallas(
                 X, centers, x_norm, c_norm, interpret=interpret
             )

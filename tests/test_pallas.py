@@ -2,8 +2,8 @@
 # The same kernel compiles with Mosaic on real TPU; the hardware-exactness
 # A/B record (v5e, argmin mismatch 0 vs the XLA path) is quoted in the
 # ops/pallas_tpu.py module header.  Set SRML_TPU_TESTS=1 to re-run this file
-# against real TPU devices, where min_dist_argmin takes the compiled Mosaic
-# path instead of the interpreter.
+# against real TPU devices, where the kernel tests run the compiled Mosaic
+# path (interpret=False) instead of the interpreter.
 import numpy as np
 import pytest
 
@@ -12,10 +12,15 @@ import jax.numpy as jnp
 
 from spark_rapids_ml_tpu.ops.pallas_tpu import (
     DISABLE_ENV,
+    _min_dist_argmin_pallas,
     _min_dist_argmin_xla,
     min_dist_argmin,
     pallas_enabled,
 )
+
+# On a real TPU run the compiled Mosaic kernel; on the CPU mesh interpret.
+ON_TPU = jax.devices()[0].platform == "tpu"
+KERNEL_INTERPRET = not ON_TPU
 
 
 @pytest.mark.parametrize(
@@ -31,10 +36,10 @@ def test_min_dist_argmin_matches_xla(n, d, k):
     rng = np.random.default_rng(n + d + k)
     X = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
     C = jnp.asarray(rng.standard_normal((k, d)).astype(np.float32))
-    md, am = min_dist_argmin(X, C, interpret=True)
-    md_ref, am_ref = _min_dist_argmin_xla(
-        X, C, (X**2).sum(axis=1), (C**2).sum(axis=1)
-    )
+    xn = (X**2).sum(axis=1)
+    cn = (C**2).sum(axis=1)
+    md, am = _min_dist_argmin_pallas(X, C, xn, cn, interpret=KERNEL_INTERPRET)
+    md_ref, am_ref = _min_dist_argmin_xla(X, C, xn, cn)
     assert md.shape == (n,) and am.shape == (n,)
     # padded center slots (norm=+inf) must never win
     assert int(np.asarray(am).max()) < k
@@ -50,8 +55,8 @@ def test_min_dist_argmin_precomputed_norms():
     C = jnp.asarray(rng.standard_normal((7, 40)).astype(np.float32))
     xn = (X**2).sum(axis=1)
     cn = (C**2).sum(axis=1)
-    md1, am1 = min_dist_argmin(X, C, xn, cn, interpret=True)
-    md2, am2 = min_dist_argmin(X, C, interpret=True)
+    md1, am1 = min_dist_argmin(X, C, xn, cn, interpret=KERNEL_INTERPRET)
+    md2, am2 = min_dist_argmin(X, C, interpret=KERNEL_INTERPRET)
     np.testing.assert_array_equal(np.asarray(am1), np.asarray(am2))
     np.testing.assert_allclose(np.asarray(md1), np.asarray(md2), rtol=1e-5)
 
@@ -59,6 +64,36 @@ def test_min_dist_argmin_precomputed_norms():
 def test_pallas_disabled_by_env(monkeypatch):
     monkeypatch.setenv(DISABLE_ENV, "1")
     assert not pallas_enabled()
+
+
+@pytest.mark.parametrize(
+    "n,d,k,expect_pallas",
+    [
+        (4096, 64, 4096, True),    # low-d, large-k: memory-bound, pallas wins
+        (4096, 64, 512, False),    # small k: distance matrix cheap
+        (4096, 512, 4096, False),  # wide d: FLOPs dominate, XLA wins
+        (256, 64, 4096, False),    # batch below one row tile
+    ],
+)
+def test_min_dist_argmin_routing(monkeypatch, n, d, k, expect_pallas):
+    # the heuristic itself, independent of backend: force pallas_enabled and
+    # record which implementation min_dist_argmin dispatches to
+    import spark_rapids_ml_tpu.ops.pallas_tpu as pt
+
+    calls = []
+    monkeypatch.setattr(pt, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(
+        pt,
+        "_min_dist_argmin_pallas",
+        lambda *a, **kw: calls.append("pallas"),
+    )
+    monkeypatch.setattr(
+        pt, "_min_dist_argmin_xla", lambda *a, **kw: calls.append("xla")
+    )
+    X = jnp.zeros((n, d), jnp.float32)
+    C = jnp.zeros((k, d), jnp.float32)
+    pt.min_dist_argmin(X, C)
+    assert calls == (["pallas"] if expect_pallas else ["xla"])
 
 
 def test_cpu_fallback_is_xla_path():
