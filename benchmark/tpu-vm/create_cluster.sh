@@ -1,0 +1,19 @@
+#!/bin/bash
+# Provision a Cloud TPU VM (or pod slice) for the benchmark suite.
+# Role of the reference's cluster-spec scripts (databricks/gpu_cluster_spec.sh,
+# dataproc/, aws-emr/): pin the accelerator shape the published numbers use.
+set -euo pipefail
+
+: "${PROJECT:?set PROJECT}"
+: "${ZONE:?set ZONE}"
+: "${TPU_NAME:=srml-bench}"
+: "${ACCEL_TYPE:=v5litepod-8}"
+: "${RUNTIME_VERSION:=v2-alpha-tpuv5-lite}"
+
+gcloud compute tpus tpu-vm create "${TPU_NAME}" \
+  --project="${PROJECT}" \
+  --zone="${ZONE}" \
+  --accelerator-type="${ACCEL_TYPE}" \
+  --version="${RUNTIME_VERSION}"
+
+echo "TPU VM ${TPU_NAME} (${ACCEL_TYPE}) ready in ${ZONE}."

@@ -1,0 +1,8 @@
+#!/bin/bash
+set -euo pipefail
+: "${PROJECT:?set PROJECT}"
+: "${ZONE:?set ZONE}"
+: "${TPU_NAME:=srml-bench}"
+
+gcloud compute tpus tpu-vm delete "${TPU_NAME}" \
+  --project="${PROJECT}" --zone="${ZONE}" --quiet

@@ -1,0 +1,66 @@
+#!/bin/bash
+# Benchmark arms matching the reference's published workloads
+# (databricks/run_benchmark.sh:45-133: 1M rows x 3000 cols float32; per-arm
+# algorithm params identical).  Run on the TPU VM (or from the controller via
+# `gcloud ... ssh --worker=all --command=...` for pod slices).
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+DATA=${DATA_DIR:-./data}
+REPORTS=${REPORT_DIR:-./reports}
+ROWS=${BENCH_ROWS:-1000000}
+COLS=${BENCH_COLS:-3000}
+mkdir -p "${REPORTS}"
+
+gen() {
+  python -m benchmark.gen_data blobs --num_rows "${ROWS}" --num_cols "${COLS}" \
+    --n_clusters 1000 --output_dir "${DATA}/blobs" --overwrite
+  python -m benchmark.gen_data low_rank_matrix --num_rows "${ROWS}" --num_cols "${COLS}" \
+    --effective_rank 10 --output_dir "${DATA}/low_rank" --overwrite
+  python -m benchmark.gen_data regression --num_rows "${ROWS}" --num_cols "${COLS}" \
+    --output_dir "${DATA}/regression" --overwrite
+  python -m benchmark.gen_data classification --num_rows "${ROWS}" --num_cols "${COLS}" \
+    --n_informative 90 --output_dir "${DATA}/classification" --overwrite
+}
+
+run() { # algo args...
+  local algo=$1; shift
+  python -m benchmark.benchmark_runner "${algo}" \
+    --report_path "${REPORTS}/${algo}.jsonl" "$@"
+}
+
+kmeans() {
+  run kmeans --train_path "${DATA}/blobs" --k 1000 --maxIter 30 --initMode random --tol 0.0
+}
+pca() {
+  run pca --train_path "${DATA}/low_rank" --k 3
+}
+linear_regression() {
+  run linear_regression --train_path "${DATA}/regression" --regParam 0.0 --elasticNetParam 0.0
+  run linear_regression --train_path "${DATA}/regression" --regParam 0.00001 --elasticNetParam 0.0 --maxIter 10
+  run linear_regression --train_path "${DATA}/regression" --regParam 0.00001 --elasticNetParam 0.5 --maxIter 10
+}
+logistic_regression() {
+  run logistic_regression --train_path "${DATA}/classification" --maxIter 200 --regParam 0.00001 --tol 0.00000001
+}
+random_forest_classifier() {
+  run random_forest_classifier --train_path "${DATA}/classification" \
+    --numTrees 50 --maxBins 128 --maxDepth 13
+}
+random_forest_regressor() {
+  run random_forest_regressor --train_path "${DATA}/regression" \
+    --numTrees 30 --maxBins 128 --maxDepth 6
+}
+knn() {
+  run knn --train_path "${DATA}/blobs" --k 200
+}
+umap() {
+  run umap --train_path "${DATA}/blobs"
+}
+
+all() {
+  kmeans; pca; linear_regression; logistic_regression
+  random_forest_classifier; random_forest_regressor; knn; umap
+}
+
+"${1:?usage: run_benchmark.sh gen|kmeans|pca|linear_regression|logistic_regression|random_forest_classifier|random_forest_regressor|knn|umap|all}"

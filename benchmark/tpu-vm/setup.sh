@@ -1,0 +1,26 @@
+#!/bin/bash
+# Install the framework on every TPU-VM worker (the reference's init-script
+# role: databricks/init-pip-cuda-11.8.sh etc.).
+set -euo pipefail
+
+: "${PROJECT:?set PROJECT}"
+: "${ZONE:?set ZONE}"
+: "${TPU_NAME:=srml-bench}"
+
+REPO_TARBALL=/tmp/srml_tpu.tar.gz
+tar czf "${REPO_TARBALL}" -C "$(dirname "$0")/../.." \
+  spark_rapids_ml_tpu benchmark pyproject.toml README.md
+
+gcloud compute tpus tpu-vm scp "${REPO_TARBALL}" "${TPU_NAME}:/tmp/" \
+  --project="${PROJECT}" --zone="${ZONE}" --worker=all
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+  --project="${PROJECT}" --zone="${ZONE}" --worker=all \
+  --command='
+    set -e
+    mkdir -p ~/srml && tar xzf /tmp/srml_tpu.tar.gz -C ~/srml
+    pip install -q "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+    pip install -q -e ~/srml
+    mkdir -p ~/srml/reports ~/srml/data
+  '
+echo "framework installed on all workers of ${TPU_NAME}"

@@ -522,10 +522,12 @@ class RandomForestClassificationModel(
         )
 
     def cpu(self):
-        raise NotImplementedError(
-            "RandomForest cpu() interop requires pyspark JVM tree construction; "
-            "use trees_to_dicts() for a portable export."
-        )
+        """Convert to pyspark.ml RandomForestClassificationModel via py4j
+        tree construction (parity with tree.py:507-553 + classification.py
+        cpu()); requires pyspark + an active SparkSession."""
+        from ..spark.interop import to_spark_random_forest_model
+
+        return to_spark_random_forest_model(self)
 
 
 class RandomForestRegressor(_RandomForestEstimator):
@@ -612,7 +614,9 @@ class RandomForestRegressionModel(_RandomForestModelBase):
         )
 
     def cpu(self):
-        raise NotImplementedError(
-            "RandomForest cpu() interop requires pyspark JVM tree construction; "
-            "use trees_to_dicts() for a portable export."
-        )
+        """Convert to pyspark.ml RandomForestRegressionModel via py4j tree
+        construction (parity with tree.py:507-553 + regression.py cpu());
+        requires pyspark + an active SparkSession."""
+        from ..spark.interop import to_spark_random_forest_model
+
+        return to_spark_random_forest_model(self)

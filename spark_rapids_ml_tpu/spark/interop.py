@@ -108,6 +108,107 @@ def to_spark_logistic_model(model: Any):
     return spark_model
 
 
+def _java_impurity_calculator(sc: Any, impurity: str, stats: Any, count: float):
+    """mllib ImpurityCalculator over a java double[] of per-class stats
+    (classification) or [w, wy, wy2] moments (regression)."""
+    arr = sc._gateway.new_array(sc._jvm.double, len(stats))
+    for i, v in enumerate(stats):
+        arr[i] = float(v)
+    pkg = sc._jvm.org.apache.spark.mllib.tree.impurity
+    if impurity == "gini":
+        return pkg.GiniCalculator(arr, int(count))
+    if impurity == "entropy":
+        return pkg.EntropyCalculator(arr, int(count))
+    if impurity == "variance":
+        return pkg.VarianceCalculator(arr, int(count))
+    raise ValueError(f"unsupported impurity {impurity}")
+
+
+def _build_java_tree(sc: Any, impurity: str, node: dict):
+    """Recursively build an org.apache.spark.ml.tree node from one
+    trees_to_dicts() dict (semantics of the reference's translate_trees,
+    utils.py:385-447: classifier leaves carry class-count stats and predict
+    the argmax; regressor leaves predict their value with placeholder
+    moments; internal-node prediction/impurity are unused by Spark
+    prediction and set to 0)."""
+    tree_pkg = sc._jvm.org.apache.spark.ml.tree
+    if "split_feature" in node:
+        left = _build_java_tree(sc, impurity, node["yes"])
+        right = _build_java_tree(sc, impurity, node["no"])
+        split = tree_pkg.ContinuousSplit(
+            int(node["split_feature"]), float(node["threshold"])
+        )
+        n_stats = 3 if impurity == "variance" else 2
+        calc = _java_impurity_calculator(
+            sc, impurity, [0.0] * n_stats, node["instance_count"]
+        )
+        return tree_pkg.InternalNode(
+            0.0, 0.0, float(node["gain"]), left, right, split, calc
+        )
+    leaf_values = node["leaf_value"]
+    if impurity == "variance":
+        prediction = float(leaf_values[0])
+        calc = _java_impurity_calculator(
+            sc, impurity, [0.0, 0.0, 0.0], node["instance_count"]
+        )
+    else:
+        prediction = float(int(max(range(len(leaf_values)), key=lambda i: leaf_values[i])))
+        calc = _java_impurity_calculator(
+            sc, impurity, leaf_values, node["instance_count"]
+        )
+    return tree_pkg.LeafNode(prediction, 0.0, calc)
+
+
+def to_spark_random_forest_model(model: Any):
+    """TPU RandomForest{Classification,Regression}Model -> the pyspark.ml
+    equivalent via py4j tree construction (parity with the reference's
+    _convert_to_java_trees, tree.py:507-553)."""
+    _require_pyspark()
+    spark = _active_session()
+    sc = spark.sparkContext
+    is_classification = bool(getattr(model, "_is_classification", False)) or hasattr(
+        model, "classes_"
+    )
+    impurity = "variance"
+    if is_classification:
+        impurity = str(model.getOrDefault("impurity")) if model.hasParam("impurity") else "gini"
+        if impurity not in ("gini", "entropy"):
+            impurity = "gini"
+    trees = [_build_java_tree(sc, impurity, t) for t in model.trees_to_dicts()]
+    n_features = int(model.n_cols)
+    if is_classification:
+        from pyspark.ml.classification import (
+            RandomForestClassificationModel as SparkRFCModel,
+        )
+
+        uid = _java_uid(sc, "rfc")
+        dt_cls = sc._jvm.org.apache.spark.ml.classification.DecisionTreeClassificationModel
+        n_classes = int(len(model.classes_))
+        java_trees = sc._gateway.new_array(dt_cls, len(trees))
+        for i, t in enumerate(trees):
+            java_trees[i] = dt_cls(uid, t, n_features, n_classes)
+        java_model = sc._jvm.org.apache.spark.ml.classification.RandomForestClassificationModel(
+            uid, java_trees, n_features, n_classes
+        )
+        spark_model = SparkRFCModel(java_model)
+    else:
+        from pyspark.ml.regression import (
+            RandomForestRegressionModel as SparkRFRModel,
+        )
+
+        uid = _java_uid(sc, "rfr")
+        dt_cls = sc._jvm.org.apache.spark.ml.regression.DecisionTreeRegressionModel
+        java_trees = sc._gateway.new_array(dt_cls, len(trees))
+        for i, t in enumerate(trees):
+            java_trees[i] = dt_cls(uid, t, n_features)
+        java_model = sc._jvm.org.apache.spark.ml.regression.RandomForestRegressionModel(
+            uid, java_trees, n_features
+        )
+        spark_model = SparkRFRModel(java_model)
+    model._copyValues(spark_model)
+    return spark_model
+
+
 def to_spark_linear_model(model: Any):
     """TPU LinearRegressionModel -> pyspark.ml.regression.LinearRegressionModel
     (parity with regression.py:650-668)."""

@@ -1,0 +1,106 @@
+# CPU-model interop (spark/interop.py).  pyspark is not installed on the TPU
+# test image, so the py4j construction is exercised against a recording mock
+# of the JVM gateway; the full pyspark path is covered by the compat suite on
+# a Spark cluster (reference analog: test_random_forest.py cpu() tests).
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.models.random_forest import (
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+from spark_rapids_ml_tpu.spark.interop import _build_java_tree
+
+
+class _Recorder:
+    """Mimics the py4j jvm attribute chain; every call returns a node record."""
+
+    def __init__(self, path=""):
+        self.path = path
+
+    def __getattr__(self, name):
+        return _Recorder(f"{self.path}.{name}" if self.path else name)
+
+    def __call__(self, *args):
+        return {"cls": self.path, "args": args}
+
+
+class _Gateway:
+    def new_array(self, cls, n):
+        return [None] * n
+
+
+def _mock_sc():
+    return SimpleNamespace(_jvm=_Recorder(), _gateway=_Gateway())
+
+
+def _fit_forest(classification):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((300, 4)).astype(np.float32)
+    if classification:
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+        est = RandomForestClassifier(numTrees=3, maxDepth=3, seed=7)
+    else:
+        y = (2 * X[:, 0] - X[:, 2]).astype(np.float32)
+        est = RandomForestRegressor(numTrees=3, maxDepth=3, seed=7)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    return est.fit(df)
+
+
+@pytest.mark.parametrize("impurity", ["gini", "variance"])
+def test_build_java_tree_structure(impurity):
+    model = _fit_forest(classification=(impurity == "gini"))
+    sc = _mock_sc()
+    trees = model.trees_to_dicts()
+    assert len(trees) == 3
+    node = _build_java_tree(sc, impurity, trees[0])
+    # root of a depth-3 fit on separable data must be an internal node
+    assert node["cls"].endswith("ml.tree.InternalNode")
+    pred, imp, gain, left, right, split, calc = node["args"]
+    assert split["cls"].endswith("ml.tree.ContinuousSplit")
+    feat, thr = split["args"]
+    assert 0 <= feat < 4 and np.isfinite(thr)
+    expected_calc = "GiniCalculator" if impurity == "gini" else "VarianceCalculator"
+    assert calc["cls"].endswith(expected_calc)
+
+    # walk to a leaf and check prediction semantics
+    def find_leaf(n):
+        if n["cls"].endswith("LeafNode"):
+            return n
+        return find_leaf(n["args"][3])  # left child
+
+    leaf = find_leaf(node)
+    leaf_pred = leaf["args"][0]
+    if impurity == "gini":
+        assert leaf_pred in (0.0, 1.0)  # class index, not probability
+    else:
+        assert np.isfinite(leaf_pred)
+
+
+def test_entropy_calculator_selected():
+    model = _fit_forest(classification=True)
+    node = _build_java_tree(_mock_sc(), "entropy", model.trees_to_dicts()[0])
+
+    def calcs(n, acc):
+        acc.append(n["args"][-1]["cls"] if n["cls"].endswith("InternalNode") else n["args"][2]["cls"])
+        if n["cls"].endswith("InternalNode"):
+            calcs(n["args"][3], acc)
+            calcs(n["args"][4], acc)
+        return acc
+
+    assert all(c.endswith("EntropyCalculator") for c in calcs(node, []))
+
+
+def test_cpu_requires_pyspark():
+    model = _fit_forest(classification=True)
+    with pytest.raises((ImportError, RuntimeError)):
+        model.cpu()
+
+
+def test_unknown_impurity_rejected():
+    model = _fit_forest(classification=True)
+    with pytest.raises(ValueError):
+        _build_java_tree(_mock_sc(), "bogus", model.trees_to_dicts()[0])
