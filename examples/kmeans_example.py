@@ -1,0 +1,37 @@
+"""KMeans on a TPU mesh (reference walkthrough: notebooks/kmeans.ipynb).
+
+Fit -> inspect centers/inertia -> transform -> save/load.
+"""
+import os
+import tempfile
+
+import numpy as np
+
+from spark_rapids_ml_tpu import KMeans
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    centers = rng.uniform(-10, 10, size=(8, 32)).astype(np.float32)
+    X = np.concatenate(
+        [c + rng.standard_normal((5_000, 32)).astype(np.float32) for c in centers]
+    )
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=8)
+
+    kmeans = KMeans(k=8, maxIter=20, tol=1e-4, seed=42).setFeaturesCol("features")
+    model = kmeans.fit(df)
+    print("cluster sizes:", np.bincount(model.transform(df).toPandas()["prediction"]))
+    print("inertia:", model.inertia_)
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "kmeans_model")
+        model.save(path)
+        reloaded = KMeansModel.load(path)
+        assert np.allclose(reloaded.cluster_centers_, model.cluster_centers_)
+    print("persistence round-trip OK")
+
+
+if __name__ == "__main__":
+    main()

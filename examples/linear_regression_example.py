@@ -1,0 +1,29 @@
+"""LinearRegression: OLS, ridge and elastic-net on a TPU mesh
+(reference walkthrough: notebooks/linear-regression.ipynb)."""
+import numpy as np
+
+from spark_rapids_ml_tpu import LinearRegression
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+
+
+def main() -> None:
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((50_000, 20)).astype(np.float32)
+    w = rng.standard_normal(20).astype(np.float32)
+    y = X @ w + 1.5 + 0.1 * rng.standard_normal(50_000).astype(np.float32)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=8)
+
+    for name, params in [
+        ("ols", dict(regParam=0.0)),
+        ("ridge", dict(regParam=0.01, elasticNetParam=0.0)),
+        ("elasticnet", dict(regParam=0.01, elasticNetParam=0.5, maxIter=100)),
+    ]:
+        model = LinearRegression(**params).fit(df)
+        pred_df = model.transform(df)
+        rmse = RegressionEvaluator(metricName="rmse").evaluate(pred_df)
+        print(f"{name}: intercept={model.intercept_:.3f} rmse={rmse:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""RandomForestClassifier with the TPU histogram tree builder
+(reference walkthrough: notebooks/random-forest-classification.ipynb)."""
+import numpy as np
+
+from spark_rapids_ml_tpu import RandomForestClassifier
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+
+
+def main() -> None:
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((20_000, 10)).astype(np.float32)
+    y = ((X[:, 0] * X[:, 1] > 0) ^ (X[:, 2] > 0.5)).astype(np.float32)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=8)
+
+    rf = RandomForestClassifier(numTrees=20, maxDepth=8, maxBins=64, seed=11)
+    model = rf.fit(df)
+    print("numTrees:", model.getNumTrees, "totalNumNodes:", model.totalNumNodes)
+
+    pred_df = model.transform(df)
+    out = pred_df.toPandas()
+    acc = MulticlassClassificationEvaluator(metricName="accuracy").evaluate(pred_df)
+    print(f"train accuracy: {acc:.4f}")
+    print("probability row 0:", np.round(out["probability"][0], 3))
+    assert acc > 0.85
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""cpu() interop: convert fitted TPU models into genuine pyspark.ml models
+(reference walkthrough: notebooks/spark-compat.ipynb).  Requires pyspark and
+an active SparkSession; without pyspark this prints the portable exports
+instead."""
+import numpy as np
+
+from spark_rapids_ml_tpu import KMeans, LinearRegression
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+
+def main() -> None:
+    rng = np.random.default_rng(8)
+    X = rng.standard_normal((5_000, 6)).astype(np.float32)
+    y = (X @ rng.standard_normal(6).astype(np.float32)).astype(np.float32)
+
+    km = KMeans(k=3, maxIter=10, seed=0).fit(
+        DataFrame.from_numpy(X, num_partitions=4)
+    )
+    lr = LinearRegression().fit(DataFrame.from_numpy(X, y=y, num_partitions=4))
+
+    try:
+        import pyspark  # noqa: F401
+
+        spark_km = km.cpu()  # pyspark.ml.clustering.KMeansModel
+        spark_lr = lr.cpu()  # pyspark.ml.regression.LinearRegressionModel
+        print("spark models:", type(spark_km).__name__, type(spark_lr).__name__)
+    except ImportError:
+        print("pyspark not installed; portable exports instead:")
+        print("kmeans centers shape:", np.asarray(km.cluster_centers_).shape)
+        print("linreg coef:", np.round(np.asarray(lr.coef_), 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""UMAP embedding (reference walkthrough: notebooks/umap.ipynb):
+sampled single-mesh fit, distributed transform."""
+import numpy as np
+
+from spark_rapids_ml_tpu import UMAP
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+
+def main() -> None:
+    rng = np.random.default_rng(7)
+    # three well-separated gaussian blobs in 30-d
+    centers = rng.uniform(-20, 20, size=(3, 30)).astype(np.float32)
+    X = np.concatenate(
+        [c + rng.standard_normal((700, 30)).astype(np.float32) for c in centers]
+    )
+    labels = np.repeat([0, 1, 2], 700)
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=4)
+
+    umap = UMAP(n_components=2, n_neighbors=15, n_epochs=150, random_state=42)
+    model = umap.fit(df)
+    emb = np.stack(model.transform(df).toPandas()["embedding"].to_numpy())
+    print("embedding shape:", emb.shape)
+
+    # blobs should stay separated: centroid distances >> intra-blob spread
+    cents = np.stack([emb[labels == i].mean(axis=0) for i in range(3)])
+    spread = max(float(emb[labels == i].std()) for i in range(3))
+    gaps = [np.linalg.norm(cents[i] - cents[j]) for i in range(3) for j in range(i)]
+    print(f"min centroid gap {min(gaps):.2f} vs max spread {spread:.2f}")
+
+
+if __name__ == "__main__":
+    main()
