@@ -44,6 +44,71 @@ class SparkBarrierControlPlane:
         self._ctx.barrier()
 
 
+TPU_RESOURCE_NAME = "tpu"
+
+
+def skip_stage_level_scheduling(spark_version: str, conf_get: Callable[[str], Any]) -> str:
+    """Decide whether to SKIP stage-level resource scheduling for the
+    training barrier stage.  Returns the reason string ('' = don't skip).
+
+    TPU adaptation of the reference's decision table
+    (core.py:754-810, GPU resource -> the executor-level custom resource
+    ``spark.executor.resource.tpu.amount`` a TPU-VM Spark cluster
+    advertises).  `conf_get` takes a conf key and returns its value or None,
+    so the logic is testable against a plain dict."""
+    if str(spark_version) < "3.4.0":
+        return "requires spark 3.4.0+"
+    master = conf_get("spark.master") or ""
+    if not (master.startswith("spark://") or master.startswith("local-cluster")):
+        return "requires standalone or local-cluster mode"
+    executor_cores = conf_get("spark.executor.cores")
+    executor_tpus = conf_get(f"spark.executor.resource.{TPU_RESOURCE_NAME}.amount")
+    if executor_cores is None or executor_tpus is None:
+        return (
+            "requires spark.executor.cores and "
+            f"spark.executor.resource.{TPU_RESOURCE_NAME}.amount"
+        )
+    if int(executor_cores) == 1:
+        return "requires spark.executor.cores > 1"
+    if int(executor_tpus) > 1:
+        # one Spark executor = one TPU-VM worker process; >1 means the user
+        # manages placement themselves
+        return f"executor {TPU_RESOURCE_NAME} amount > 1 is user-managed"
+    task_tpus = conf_get(f"spark.task.resource.{TPU_RESOURCE_NAME}.amount")
+    if task_tpus is None:
+        # ETL tasks don't grab the TPU; stage-level scheduling lets the
+        # training stage claim it exclusively
+        return ""
+    if float(task_tpus) == float(executor_tpus):
+        return "task already claims the whole executor resource"
+    return ""
+
+
+def try_stage_level_scheduling(rdd: Any, spark: Any, logger: Any = None) -> Any:
+    """Attach a training resource profile to the barrier RDD so each
+    training task claims the executor's TPU exclusively and more than half
+    its cores (guaranteeing one training task per executor — the
+    reference's placement trick, core.py:811-854)."""
+    sc = spark.sparkContext
+    reason = skip_stage_level_scheduling(spark.version, sc.getConf().get)
+    if reason:
+        if logger:
+            logger.info(f"stage-level scheduling skipped: {reason}")
+        return rdd
+    from pyspark.resource.profile import ResourceProfileBuilder
+    from pyspark.resource.requests import TaskResourceRequests
+
+    executor_cores = int(sc.getConf().get("spark.executor.cores"))
+    task_cores = executor_cores // 2 + 1
+    treqs = TaskResourceRequests().cpus(task_cores).resource(TPU_RESOURCE_NAME, 1.0)
+    profile = ResourceProfileBuilder().require(treqs).build
+    if logger:
+        logger.info(
+            f"training tasks require cores={task_cores}, {TPU_RESOURCE_NAME}=1.0"
+        )
+    return rdd.withResources(profile)
+
+
 def run_barrier_fit(
     sdf: Any,
     num_workers: int,
@@ -80,5 +145,6 @@ def run_barrier_fit(
         .rdd.barrier()
         .mapPartitions(lambda x: x)
     )
+    rdd = try_stage_level_scheduling(rdd, sdf.sparkSession)
     rows = rdd.collect()
     return [json.loads(r["model_attributes"]) for r in rows]

@@ -1,0 +1,72 @@
+# Stage-level scheduling decision table (spark/adapter.py), tested against a
+# dict-backed conf the way the reference tests it with a synthetic SparkConf
+# (test_common_estimator.py:526-580).  No pyspark needed: the decision
+# function takes (version, conf_get).
+import pytest
+
+from spark_rapids_ml_tpu.spark.adapter import (
+    TPU_RESOURCE_NAME,
+    skip_stage_level_scheduling,
+)
+
+GOOD_CONF = {
+    "spark.master": "spark://host:7077",
+    "spark.executor.cores": "8",
+    f"spark.executor.resource.{TPU_RESOURCE_NAME}.amount": "1",
+}
+
+
+def _get(conf):
+    return conf.get
+
+
+def test_enabled_on_good_conf():
+    assert skip_stage_level_scheduling("3.4.0", _get(GOOD_CONF)) == ""
+    assert skip_stage_level_scheduling("3.5.1", _get(GOOD_CONF)) == ""
+
+
+def test_old_spark_skips():
+    assert "3.4.0" in skip_stage_level_scheduling("3.3.2", _get(GOOD_CONF))
+
+
+@pytest.mark.parametrize("master", ["yarn", "k8s://x", "local[4]", ""])
+def test_non_standalone_skips(master):
+    conf = {**GOOD_CONF, "spark.master": master}
+    assert "standalone" in skip_stage_level_scheduling("3.4.0", _get(conf))
+
+
+def test_local_cluster_allowed():
+    conf = {**GOOD_CONF, "spark.master": "local-cluster[2,4,1024]"}
+    assert skip_stage_level_scheduling("3.4.0", _get(conf)) == ""
+
+
+@pytest.mark.parametrize(
+    "missing", ["spark.executor.cores", f"spark.executor.resource.{TPU_RESOURCE_NAME}.amount"]
+)
+def test_missing_resource_confs_skip(missing):
+    conf = {k: v for k, v in GOOD_CONF.items() if k != missing}
+    assert "requires" in skip_stage_level_scheduling("3.4.0", _get(conf))
+
+
+def test_single_core_executor_skips():
+    conf = {**GOOD_CONF, "spark.executor.cores": "1"}
+    assert "cores" in skip_stage_level_scheduling("3.4.0", _get(conf))
+
+
+def test_multi_tpu_executor_skips():
+    conf = {**GOOD_CONF, f"spark.executor.resource.{TPU_RESOURCE_NAME}.amount": "2"}
+    assert "user-managed" in skip_stage_level_scheduling("3.4.0", _get(conf))
+
+
+def test_task_amount_unset_enables():
+    assert skip_stage_level_scheduling("3.4.0", _get(GOOD_CONF)) == ""
+
+
+def test_task_claims_whole_resource_skips():
+    conf = {**GOOD_CONF, f"spark.task.resource.{TPU_RESOURCE_NAME}.amount": "1"}
+    assert "whole executor" in skip_stage_level_scheduling("3.4.0", _get(conf))
+
+
+def test_fractional_task_amount_enables():
+    conf = {**GOOD_CONF, f"spark.task.resource.{TPU_RESOURCE_NAME}.amount": "0.5"}
+    assert skip_stage_level_scheduling("3.4.0", _get(conf)) == ""
