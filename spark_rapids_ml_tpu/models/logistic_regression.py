@@ -462,9 +462,20 @@ class LogisticRegressionModel(
         num_classes = self._num_classes
 
         def _predict_all(feats: np.ndarray):
-            # one transfer + one batched matmul for all M models
+            # one transfer + one batched matmul for all M models; HIGHEST
+            # keeps scores bit-comparable with the single-model decision
+            # kernel (ops/logistic.py logistic_decision_kernel), which the
+            # single-pass CV scoring path is asserted against
             Xd = jax.device_put(np.asarray(feats, np_dtype))
-            scores = jnp.einsum("nd,mkd->mnk", Xd, coefs) + intercepts[:, None, :]
+            scores = (
+                jnp.einsum(
+                    "nd,mkd->mnk",
+                    Xd,
+                    coefs,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                + intercepts[:, None, :]
+            )
             probs = np.stack(
                 [
                     np.asarray(scores_to_probs(scores[m], num_classes), np.float64)

@@ -31,6 +31,8 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from .linalg import exact_matmul
+
 
 class LinregStats(NamedTuple):
     wsum: jax.Array     # scalar: total weight (== row count without weightCol)
@@ -48,8 +50,8 @@ def linreg_sufficient_stats(X: jax.Array, y: jax.Array, w: jax.Array) -> LinregS
     Xw = X * w[:, None]
     x_mean = Xw.sum(axis=0) / wsum
     y_mean = (y * w).sum() / wsum
-    G = Xw.T @ X
-    c = Xw.T @ y
+    G = exact_matmul(Xw.T, X)
+    c = exact_matmul(Xw.T, y)
     y2 = (y * y * w).sum()
     return LinregStats(wsum, x_mean, y_mean, G, c, y2)
 
@@ -159,7 +161,7 @@ def solve_elasticnet_cd(
 
 @jax.jit
 def linear_predict_kernel(X: jax.Array, coef: jax.Array, intercept: jax.Array) -> jax.Array:
-    return X @ coef + intercept
+    return exact_matmul(X, coef) + intercept
 
 
 @jax.jit
@@ -167,4 +169,4 @@ def multi_linear_predict_kernel(
     X: jax.Array, coefs: jax.Array, intercepts: jax.Array
 ) -> jax.Array:
     """(N, D) x (M, D) -> (M, N): one pass predicting for M combined models."""
-    return coefs @ X.T + intercepts[:, None]
+    return exact_matmul(coefs, X.T) + intercepts[:, None]

@@ -26,6 +26,7 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS, data_sharding
+from .linalg import exact_matmul
 
 
 @partial(jax.jit, static_argnames=("mesh", "k"))
@@ -50,9 +51,11 @@ def knn_block_kernel(
     def per_shard(items_loc, x_norm, ids_loc, valid_loc, q):
         d2 = (
             (q * q).sum(axis=1)[:, None]
-            - 2.0 * (q @ items_loc.T)
+            - 2.0 * exact_matmul(q, items_loc.T)
             + x_norm[None, :]
-        )  # (Q, n_loc)
+        )  # (Q, n_loc); exact f32 products — these distances are returned
+        # to the user and the expansion cancels catastrophically for near
+        # neighbors (bf16 MXU default failed sklearn parity on hardware)
         d2 = jnp.where(valid_loc[None, :], d2, jnp.inf)
         neg_top, idx = jax.lax.top_k(-d2, min(k, items_loc.shape[0]))
         top_ids = ids_loc[idx]  # (Q, k)

@@ -19,6 +19,24 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+# Solver matmuls run at HIGHEST precision: on TPU the default f32 matmul is a
+# single-pass bf16 MXU product (~2^-9 relative error per element), which is
+# fine for iterative *search* (the KMeans assignment loop keeps it) but not
+# for quantities we return or solve against — hardware runs showed OLS
+# coefficients off 3.5% vs sklearn and kNN distances failing parity until
+# gram/covariance/projection/distance matmuls were pinned.  cuML computes all
+# of these in exact f32 FMA; HIGHEST (bf16_6x) restores that at negligible
+# cost for one-pass contractions.
+SOLVER_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def exact_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a @ b with full-f32 MXU products (see SOLVER_PRECISION); bf16 inputs
+    accumulate and return f32 so cancellation-prone sums stay exact."""
+    out_dtype = jnp.promote_types(a.dtype, b.dtype)
+    pet = jnp.float32 if out_dtype == jnp.dtype(jnp.bfloat16) else None
+    return jnp.matmul(a, b, precision=SOLVER_PRECISION, preferred_element_type=pet)
+
 
 def sign_flip(components: jax.Array) -> jax.Array:
     """Deterministic eigenvector signs: flip each row so its largest-|.|
@@ -37,7 +55,7 @@ def weighted_moments(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, 
     """
     wsum = w.sum()
     mean = (X * w[:, None]).sum(axis=0) / wsum
-    scatter = (X * w[:, None]).T @ X
+    scatter = exact_matmul((X * w[:, None]).T, X)
     return wsum, mean, scatter
 
 
@@ -124,6 +142,6 @@ def pca_transform_kernel(X: jax.Array, components: jax.Array) -> jax.Array:
     center at transform time; the reference adds the transformed mean back to
     cuML's centered output to match, feature.py:419-431 — we simply never
     subtract it)."""
-    return X @ components.T
+    return exact_matmul(X, components.T)
 
 

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from .lbfgs import minimize_lbfgs
+from .linalg import exact_matmul
 
 
 def _unpack(theta: jax.Array, k: int, d: int, fit_intercept: bool):
@@ -108,7 +109,7 @@ def logistic_decision_kernel(X: jax.Array, W: jax.Array, b: jax.Array) -> jax.Ar
     Raw decision scores (N, k): k == 1 column for binary, k columns for
     multinomial (matches cuML decision_function semantics used by the
     reference transform, classification.py:1236-1262)."""
-    return X @ W.T + b
+    return exact_matmul(X, W.T) + b
 
 
 def scores_to_probs(scores: jnp.ndarray, num_classes: int) -> jnp.ndarray:
