@@ -22,6 +22,11 @@ import time
 
 import numpy as np
 
+# Persistent XLA compilation cache: the heavyweight compiles (QDWH eigh at
+# d=3000 is ~3 min) are paid once per machine instead of once per bench run.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/srml_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
 REF_ROWS = 1_000_000
 # reference GPU-cluster fit seconds on 1M x 3000 (running_times.png, 2x A10G)
 REF_GPU_SECONDS = {

@@ -97,25 +97,35 @@ def covariance_kernel(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array,
     return wsum, mean, (cov + cov.T) * 0.5
 
 
-# Above this column count the dense eigh leaves the jitted kernel for the
-# host: a (D, D) symmetric eigensolve has no MXU-friendly formulation, while
-# the native runtime (spark_rapids_ml_tpu.native.eigh_descending: the C++
-# Jacobi kernel up to d=256, blocked LAPACK beyond, both with calSVD sign
-# semantics) handles it in host DRAM — the same split the reference uses
-# when it runs raft eigDC on a single device after reducing partial
-# covariances on the driver (RapidsRowMatrix.scala:59-89).
+# On CPU backends, above this column count the dense eigh leaves the jitted
+# kernel for the host native runtime (spark_rapids_ml_tpu.native
+# .eigh_descending: the C++ Jacobi kernel up to d=256, blocked LAPACK
+# beyond, both with calSVD sign semantics) — the same split the reference
+# uses when it runs raft eigDC on a single device after reducing partial
+# covariances on the driver (RapidsRowMatrix.scala:59-89).  On TPU the
+# XLA eigh (QDWH, MXU-friendly) stays on device: measured 0.31 s for
+# d=3000 on v5e vs ~5-6 s for either host path PLUS the (D, D) covariance
+# device->host transfer, so the whole fit stays in one jitted kernel.
 HOST_EIGH_MIN_D = 128
+
+
+def _is_cpu_backend(X: jax.Array) -> bool:
+    try:
+        return list(X.devices())[0].platform == "cpu"
+    except Exception:
+        return jax.default_backend() == "cpu"
 
 
 def pca_fit(
     X: jax.Array, w: jax.Array, k: int, host_eigh: bool = None
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Hybrid PCA fit: covariance on the mesh, eigh on device (small D) or on
-    the host native runtime (large D).  Returns numpy arrays
+    """Hybrid PCA fit: covariance on the mesh, then eigh on device (always
+    on TPU; small D on CPU) or on the host native runtime (large D on CPU
+    backends).  Returns numpy arrays
     (mean, components, explained_variance, ratio, singular_values)."""
     d = X.shape[1]
     if host_eigh is None:
-        host_eigh = d >= HOST_EIGH_MIN_D
+        host_eigh = d >= HOST_EIGH_MIN_D and _is_cpu_backend(X)
     if not host_eigh:
         return tuple(np.asarray(o) for o in pca_fit_kernel(X, w, k))  # type: ignore[return-value]
     from .. import native
