@@ -1,0 +1,143 @@
+# Parameterized cross-shape sweeps vs sklearn (the reference's slow-sweep
+# layer, e.g. test_pca.py:289-344, test_kmeans.py:230) plus weighted-fit
+# semantics checks.  Kept small enough for CI; the full grids run under
+# --runslow.
+import numpy as np
+import pytest
+from sklearn.cluster import KMeans as SkKMeans
+from sklearn.decomposition import PCA as SkPCA
+from sklearn.linear_model import LinearRegression as SkLinReg
+from sklearn.linear_model import LogisticRegression as SkLogReg
+
+from spark_rapids_ml_tpu import (
+    KMeans,
+    LinearRegression,
+    LogisticRegression,
+    PCA,
+)
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+
+def _blobs(rng, n, d, k, spread=0.15):
+    centers = rng.uniform(-5, 5, size=(k, d)).astype(np.float32)
+    assign = rng.integers(0, k, size=n)
+    X = centers[assign] + spread * rng.standard_normal((n, d)).astype(np.float32)
+    return X
+
+
+@pytest.mark.parametrize("n,d,k", [(2000, 8, 4), (4000, 33, 7), (1500, 128, 3)])
+def test_kmeans_sweep_quality(n, d, k):
+    rng = np.random.default_rng(n + d)
+    X = _blobs(rng, n, d, k)
+    df = DataFrame.from_numpy(X, num_partitions=4)
+    model = KMeans(k=k, maxIter=30, tol=1e-6, seed=5).fit(df)
+    sk = SkKMeans(n_clusters=k, n_init=4, random_state=5).fit(X)
+    # within 5% of sklearn's inertia on well-separated blobs
+    assert model.inertia_ <= 1.05 * sk.inertia_
+
+
+@pytest.mark.parametrize("n,d,k", [(1000, 12, 2), (3000, 64, 5), (800, 200, 4)])
+def test_pca_sweep_matches_sklearn(n, d, k):
+    rng = np.random.default_rng(d)
+    # well-separated top-k variances so components are individually
+    # comparable (near-degenerate eigenvalues make per-component cosines
+    # meaningless for any implementation pair)
+    scales = np.full(d, 0.3, np.float32)
+    scales[: k + 2] = np.geomspace(10.0, 2.0, k + 2)
+    X = rng.standard_normal((n, d)).astype(np.float32) * scales
+    df = DataFrame.from_numpy(X, num_partitions=4)
+    model = PCA(k=k).fit(df)
+    sk = SkPCA(n_components=k).fit(X.astype(np.float64))
+    np.testing.assert_allclose(
+        np.asarray(model.explained_variance_ratio_),
+        sk.explained_variance_ratio_,
+        atol=1e-3,
+    )
+    # components match up to sign (both sign-flip deterministically but
+    # differently); compare absolute cosine alignment
+    for j in range(k):
+        cos = abs(
+            float(np.dot(np.asarray(model.components_)[j], sk.components_[j]))
+            / (
+                np.linalg.norm(np.asarray(model.components_)[j])
+                * np.linalg.norm(sk.components_[j])
+            )
+        )
+        assert cos > 0.99, (j, cos)
+
+
+@pytest.mark.parametrize("n,d", [(2000, 5), (5000, 40), (1200, 150)])
+def test_linreg_sweep_matches_sklearn(n, d):
+    rng = np.random.default_rng(d)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32)
+    y = X @ w + 0.7 + 0.05 * rng.standard_normal(n).astype(np.float32)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=4)
+    model = LinearRegression(regParam=0.0).fit(df)
+    sk = SkLinReg().fit(X, y)
+    np.testing.assert_allclose(np.asarray(model.coef_), sk.coef_, atol=2e-3)
+    np.testing.assert_allclose(model.intercept_, sk.intercept_, atol=2e-3)
+
+
+@pytest.mark.parametrize("n,d,classes", [(3000, 10, 2), (4000, 24, 4)])
+def test_logreg_sweep_matches_sklearn(n, d, classes):
+    rng = np.random.default_rng(d + classes)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    W = rng.standard_normal((d, classes)).astype(np.float32)
+    y = (X @ W).argmax(axis=1).astype(np.float32)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=4)
+    model = LogisticRegression(regParam=1e-3, maxIter=300, tol=1e-10).fit(df)
+    sk = SkLogReg(C=1.0 / (1e-3 * n), max_iter=2000).fit(X, y)
+    ours = model.transform(df).toPandas()["prediction"].to_numpy()
+    theirs = sk.predict(X)
+    agreement = float((ours == theirs).mean())
+    assert agreement > 0.98, agreement
+
+
+def test_kmeans_weightcol_unsupported_parity():
+    # reference parity: spark-rapids-ml KMeans rejects weightCol
+    # (clustering.py setWeightCol raises)
+    with pytest.raises(ValueError, match="weightCol"):
+        KMeans(k=3).setWeightCol("weight")
+
+
+def test_weightcol_unsupported_parity_all_estimators():
+    # reference parity: weightCol maps to None (= unsupported, raises) for
+    # every estimator family (params.py:97, regression.py:186,
+    # classification.py:658, tree.py:84 in the reference)
+    with pytest.raises(ValueError):
+        LinearRegression(weightCol="w")
+    with pytest.raises(ValueError):
+        LogisticRegression(weightCol="w")
+
+
+@pytest.mark.parametrize("algo", ["kmeans", "pca", "linreg"])
+def test_float64_sweep(algo):
+    rng = np.random.default_rng(17)
+    X = _blobs(rng, 1000, 10, 3)
+    y = (X @ rng.standard_normal(10).astype(np.float32)).astype(np.float32)
+    if algo == "kmeans":
+        df = DataFrame.from_numpy(X, num_partitions=2)
+        m32 = KMeans(k=3, seed=1, maxIter=15).fit(df)
+        m64 = KMeans(k=3, seed=1, maxIter=15, float32_inputs=False).fit(df)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(m32.cluster_centers_), axis=0),
+            np.sort(np.asarray(m64.cluster_centers_), axis=0),
+            atol=1e-2,
+        )
+    elif algo == "pca":
+        df = DataFrame.from_numpy(X, num_partitions=2)
+        m32 = PCA(k=2).fit(df)
+        m64 = PCA(k=2, float32_inputs=False).fit(df)
+        np.testing.assert_allclose(
+            np.abs(np.asarray(m32.components_)),
+            np.abs(np.asarray(m64.components_)),
+            atol=1e-2,
+        )
+    else:
+        df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+        m32 = LinearRegression().fit(df)
+        m64 = LinearRegression(float32_inputs=False).fit(df)
+        np.testing.assert_allclose(
+            np.asarray(m32.coef_), np.asarray(m64.coef_), atol=1e-3
+        )
