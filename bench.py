@@ -35,6 +35,9 @@ REF_GPU_SECONDS = {
     "linreg": 32.0,   # ridge configuration (fastest GPU arm)
     "logreg": 69.0,
     "knn": 82.0,      # no published kNN bar; reuse the kmeans-scale bar as a floor
+    "rf_clf": 59.0,
+    "rf_reg": 52.0,
+    "umap": 82.0,     # no published UMAP bar; kmeans-scale floor like knn
 }
 
 
@@ -166,6 +169,60 @@ def main() -> None:
         elapsed = _timed(fit)
         rows = n_query  # throughput counts completed query rows
         label = f"knn_query_throughput_n{X_host.shape[0]}_d{cols}_k{k}"
+
+    elif algo in ("rf_clf", "rf_reg"):
+        # reference arms: classifier 50 trees/bins=128/depth=13,
+        # regressor 30 trees/bins=128/depth=6 (run_benchmark.sh:101-122);
+        # rows scaled like the other arms, per-arm tree params preserved
+        from spark_rapids_ml_tpu.dataframe import DataFrame
+
+        rows = int(os.environ.get("SRML_BENCH_ROWS", 100_000 if on_accel else 5_000))
+        cols = int(os.environ.get("SRML_BENCH_COLS", 3000 if on_accel else 32))
+        X_host = rng.standard_normal((rows, cols)).astype(np.float32)
+        if algo == "rf_clf":
+            from spark_rapids_ml_tpu import RandomForestClassifier
+
+            y = (
+                X_host[:, :10] @ rng.standard_normal(10).astype(np.float32) > 0
+            ).astype(np.float32)
+            # reference arm params on accel; scaled down for CPU smoke runs
+            est = (
+                RandomForestClassifier(numTrees=50, maxBins=128, maxDepth=13, seed=1)
+                if on_accel
+                else RandomForestClassifier(numTrees=8, maxBins=32, maxDepth=6, seed=1)
+            )
+        else:
+            from spark_rapids_ml_tpu import RandomForestRegressor
+
+            y = (X_host[:, :10] @ rng.standard_normal(10).astype(np.float32)).astype(
+                np.float32
+            )
+            est = RandomForestRegressor(numTrees=30, maxBins=128, maxDepth=6, seed=1)
+        df = DataFrame.from_numpy(X_host, y, num_partitions=8)
+
+        def fit():
+            model = est.fit(df)
+            return float(model.getNumTrees)
+
+        elapsed = _timed(fit)
+        label = f"{algo}_fit_throughput_d{cols}"
+
+    elif algo == "umap":
+        from spark_rapids_ml_tpu import UMAP
+        from spark_rapids_ml_tpu.dataframe import DataFrame
+
+        rows = int(os.environ.get("SRML_BENCH_ROWS", 50_000 if on_accel else 2_000))
+        cols = int(os.environ.get("SRML_BENCH_COLS", 128 if on_accel else 32))
+        X_host = rng.standard_normal((rows, cols)).astype(np.float32)
+        df = DataFrame.from_numpy(X_host, num_partitions=8)
+        est = UMAP(n_components=2, n_neighbors=15, n_epochs=200, random_state=1)
+
+        def fit():
+            model = est.fit(df)
+            return float(np.asarray(model.embedding_).ravel()[0])
+
+        elapsed = _timed(fit)
+        label = f"umap_fit_throughput_n{rows}_d{cols}"
 
     else:
         raise SystemExit(f"unknown SRML_BENCH_ALGO={algo}")

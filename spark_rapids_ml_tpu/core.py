@@ -40,7 +40,25 @@ from .dataframe import DataFrame, as_dataframe
 from .params import Param, Params, _TpuParams
 from .parallel.mesh import get_mesh, shard_rows, data_sharding
 from .parallel.partition import PartitionDescriptor
+from .dataframe import FEATURE_BLOCK_ATTR
 from .utils import get_logger, stack_feature_cells
+
+
+def _partition_feature_block(part: pd.DataFrame, input_col: str):
+    """Zero-copy contiguous feature block stashed by DataFrame.from_numpy,
+    or None.  Guarded on row count plus first/last cell equality so
+    partitions derived by filtering/slicing/reordering (pandas attrs
+    propagation is version-dependent) never read a stale block."""
+    holder = part.attrs.get(FEATURE_BLOCK_ATTR)
+    block = holder.blocks.get(input_col) if holder is not None else None
+    if block is None or block.shape[0] != len(part) or len(part) == 0:
+        return None
+    col = part[input_col]
+    if np.array_equal(col.iloc[0], block[0]) and np.array_equal(
+        col.iloc[-1], block[-1]
+    ):
+        return block
+    return None
 
 _SinglePdDataFrameBatchType = Tuple[pd.DataFrame, Optional[pd.DataFrame]]
 
@@ -96,6 +114,9 @@ class _TpuCaller(_TpuParams):
         self, part: pd.DataFrame, input_col: Optional[str], input_cols: Optional[List[str]], dtype: np.dtype
     ) -> np.ndarray:
         if input_col is not None:
+            block = _partition_feature_block(part, input_col)
+            if block is not None:
+                return np.asarray(block, dtype=dtype)
             cells = part[input_col].tolist()
             if len(cells) == 0:
                 return np.zeros((0, 0), dtype=dtype)
@@ -382,7 +403,14 @@ class _TpuModel(_TpuParams):
             if len(part) == 0:
                 out_parts.append(None)  # filled once output columns are known
                 continue
-            if input_col is not None:
+            block = (
+                _partition_feature_block(part, input_col)
+                if input_col is not None
+                else None
+            )
+            if block is not None:
+                feats = np.asarray(block, dtype=dtype)
+            elif input_col is not None:
                 feats = stack_feature_cells(part[input_col].tolist(), dtype)
             else:
                 feats = np.asarray(part[input_cols].to_numpy(), dtype=dtype)

@@ -23,6 +23,38 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Unio
 import numpy as np
 import pandas as pd
 
+# pandas .attrs key under which a partition may carry a _FeatureBlock (a
+# {col: contiguous 2-D array} holder) for zero-copy ingest — set by
+# DataFrame.from_numpy; absent on partitions produced by generic
+# transformations.  Consumers must validate the block still matches the
+# partition (see core._partition_feature_block).
+FEATURE_BLOCK_ATTR = "srml_feature_block"
+
+
+class _FeatureBlock:
+    """Identity-equality, identity-deepcopy wrapper.  pandas compares .attrs
+    values with == when propagating them (pd.concat raises on raw ndarrays)
+    and deep-copies .attrs in __finalize__ on every derived frame/column —
+    without these overrides each column access would copy the whole block
+    (measured 0.38 s per getitem on a 600 MB block)."""
+
+    __slots__ = ("blocks",)
+
+    def __init__(self, blocks: Dict[str, np.ndarray]):
+        self.blocks = blocks
+
+    def __eq__(self, other: Any) -> bool:
+        return self is other
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __deepcopy__(self, memo: Any) -> "_FeatureBlock":
+        return self
+
+    def __copy__(self) -> "_FeatureBlock":
+        return self
+
 
 class Row:
     """Lightweight attribute/row access wrapper (pyspark.sql.Row stand-in)."""
@@ -89,18 +121,33 @@ class DataFrame:
         weightCol: str = "weight",
     ) -> "DataFrame":
         X = np.asarray(X)
-        data: Dict[str, Any] = {}
         if feature_layout in ("array", "vector"):
+            # Build partitions directly so each carries a contiguous 2-D
+            # feature block in .attrs: estimator ingest then skips the
+            # 1-object-per-row np.stack (which costs ~50 s at 400k x 3000)
+            # and reads the block zero-copy.  The object column stays — any
+            # generic consumer still sees the Spark array<float> layout.
             col = featuresCol if isinstance(featuresCol, str) else featuresCol[0]
-            data[col] = list(X)
-        elif feature_layout == "multi_cols":
+            n = X.shape[0]
+            bounds = np.linspace(0, n, max(1, num_partitions) + 1, dtype=int)
+            parts = []
+            for lo, hi in zip(bounds[:-1], bounds[1:]):
+                block = np.ascontiguousarray(X[lo:hi])
+                pdf = pd.DataFrame({col: list(block)})
+                if y is not None:
+                    pdf[labelCol] = np.asarray(y)[lo:hi]
+                if weight is not None:
+                    pdf[weightCol] = np.asarray(weight)[lo:hi]
+                pdf.attrs[FEATURE_BLOCK_ATTR] = _FeatureBlock({col: block})
+                parts.append(pdf)
+            return cls(parts)
+        if feature_layout == "multi_cols":
             names = (
                 featuresCol
                 if isinstance(featuresCol, list)
                 else [f"{featuresCol}_{i}" for i in range(X.shape[1])]
             )
-            for i, name in enumerate(names):
-                data[name] = X[:, i]
+            data: Dict[str, Any] = {name: X[:, i] for i, name in enumerate(names)}
         else:
             raise ValueError(f"Unknown feature_layout: {feature_layout}")
         if y is not None:
