@@ -15,6 +15,11 @@
 #   - "vector":     alias of "array" (Spark VectorUDT becomes arrays here)
 #   - "multi_cols": D scalar columns
 #
+# Like Spark DataFrames, instances are IMMUTABLE by convention: mutating the
+# numpy data a DataFrame was built from (in place) after construction is
+# undefined behavior — the runtime caches both host feature blocks and their
+# device-resident shardings across fits.
+#
 
 from __future__ import annotations
 
