@@ -197,7 +197,11 @@ def main() -> None:
             y = (X_host[:, :10] @ rng.standard_normal(10).astype(np.float32)).astype(
                 np.float32
             )
-            est = RandomForestRegressor(numTrees=30, maxBins=128, maxDepth=6, seed=1)
+            est = (
+                RandomForestRegressor(numTrees=30, maxBins=128, maxDepth=6, seed=1)
+                if on_accel
+                else RandomForestRegressor(numTrees=8, maxBins=32, maxDepth=5, seed=1)
+            )
         df = DataFrame.from_numpy(X_host, y, num_partitions=8)
 
         def fit():

@@ -281,6 +281,11 @@ class DataFrame:
         return self
 
     def unpersist(self) -> "DataFrame":
+        # releases the runtime's device-resident fit-input cache (the
+        # persisted-on-accelerator state a Spark unpersist would drop)
+        from .core import clear_fit_cache
+
+        clear_fit_cache()
         return self
 
     def __repr__(self) -> str:
