@@ -157,7 +157,10 @@ def main() -> None:
         k = int(os.environ.get("SRML_BENCH_K", 200))
         from spark_rapids_ml_tpu.ops.knn import knn_search
 
-        n_query = min(rows, 50_000)
+        # brute-force kNN is FLOP-bound: 2*n_items*d FLOP per query row
+        # (2.4 GFLOP at the 400k x 3000 default), so the per-chip query
+        # budget is what keeps the arm's wall-clock sane
+        n_query = int(os.environ.get("SRML_BENCH_QUERIES", min(rows, 8192)))
         X_host = rng.standard_normal((rows, cols)).astype(np.float32)
         Q_host = rng.standard_normal((n_query, cols)).astype(np.float32)
         ids = np.arange(rows, dtype=np.int64)

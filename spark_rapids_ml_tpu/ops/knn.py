@@ -26,7 +26,6 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS, data_sharding
-from .linalg import exact_matmul
 
 
 @partial(jax.jit, static_argnames=("mesh", "k"))
@@ -48,21 +47,63 @@ def knn_block_kernel(
     it is computed once at prepare time instead of once per query block (a
     full HBM sweep over the item shard per block otherwise)."""
 
+    # Per-device item-CHUNKED evaluation: the (Q, chunk) distance tile is the
+    # only big intermediate — a lax.scan over item chunks with a running
+    # (Q, k) top-k merge keeps HBM use flat no matter how many items live on
+    # the shard (a single (Q, n_loc) tile would be 13 GB at Q=8192,
+    # n_loc=400k).  All merging stays on device; the only cross-shard
+    # traffic is the final (n_dev, Q, k) candidate gather.
     def per_shard(items_loc, x_norm, ids_loc, valid_loc, q):
-        d2 = (
-            (q * q).sum(axis=1)[:, None]
-            - 2.0 * exact_matmul(q, items_loc.T)
-            + x_norm[None, :]
-        )  # (Q, n_loc); exact f32 products — these distances are returned
-        # to the user and the expansion cancels catastrophically for near
-        # neighbors (bf16 MXU default failed sklearn parity on hardware)
-        d2 = jnp.where(valid_loc[None, :], d2, jnp.inf)
-        neg_top, idx = jax.lax.top_k(-d2, min(k, items_loc.shape[0]))
-        top_ids = ids_loc[idx]  # (Q, k)
+        n_loc, d = items_loc.shape
+        Q = q.shape[0]
+        # distance-tile budget ~512 MB f32; chunks sized to it (static)
+        chunk = max(512, min(n_loc, (128 << 20) // max(Q, 1)))
+        kk = min(k, chunk)
+        n_chunks = -(-n_loc // chunk)
+        pad = n_chunks * chunk - n_loc
+        items_p = jnp.pad(items_loc, ((0, pad), (0, 0)))
+        norm_p = jnp.pad(x_norm, (0, pad))
+        ids_p = jnp.pad(ids_loc, (0, pad))
+        valid_p = jnp.pad(valid_loc, (0, pad))  # False padding
+        q_norm = (q * q).sum(axis=1)
+
+        def body(carry, xs):
+            best_d, best_ids = carry
+            it, nb, idb, vb = xs
+            # HIGH = 3-pass bf16 products (~2^-19 relative): the norm
+            # expansion cancels catastrophically for near neighbors, so the
+            # single-pass bf16 default (~2^-8) failed sklearn parity on
+            # hardware — but full HIGHEST (6 passes) doubles the cost of
+            # this FLOP-dominated kernel for accuracy already far below the
+            # f32 tolerance of the returned distances.
+            cross = jnp.matmul(
+                q,
+                it.T,
+                precision=jax.lax.Precision.HIGH,
+                preferred_element_type=jnp.float32,
+            )
+            d2 = q_norm[:, None] - 2.0 * cross + nb[None, :]
+            d2 = jnp.where(vb[None, :], d2, jnp.inf)
+            neg_top, idx = jax.lax.top_k(-d2, kk)
+            cand_d = jnp.concatenate([best_d, -neg_top], axis=1)
+            cand_ids = jnp.concatenate([best_ids, idb[idx]], axis=1)
+            neg_best, bidx = jax.lax.top_k(-cand_d, k)
+            return (-neg_best, jnp.take_along_axis(cand_ids, bidx, axis=1)), None
+
+        init = (
+            jnp.full((Q, k), jnp.inf, q_norm.dtype),
+            jnp.zeros((Q, k), ids_loc.dtype),
+        )
+        xs = (
+            items_p.reshape(n_chunks, chunk, d),
+            norm_p.reshape(n_chunks, chunk),
+            ids_p.reshape(n_chunks, chunk),
+            valid_p.reshape(n_chunks, chunk),
+        )
+        (best_d, best_ids), _ = jax.lax.scan(body, init, xs)
         # (n_dev, Q, k) candidates — the only cross-shard traffic
-        all_d = jax.lax.all_gather(-neg_top, DATA_AXIS)
-        all_ids = jax.lax.all_gather(top_ids, DATA_AXIS)
-        n_dev = all_d.shape[0]
+        all_d = jax.lax.all_gather(best_d, DATA_AXIS)
+        all_ids = jax.lax.all_gather(best_ids, DATA_AXIS)
         cand_d = jnp.moveaxis(all_d, 0, 1).reshape(q.shape[0], -1)
         cand_ids = jnp.moveaxis(all_ids, 0, 1).reshape(q.shape[0], -1)
         neg_final, fidx = jax.lax.top_k(-cand_d, min(k, cand_d.shape[1]))
@@ -133,8 +174,11 @@ def prepare_items(
 # Item sets larger than this many bytes (per replica) are processed
 # out-of-core: item blocks stream through HBM one at a time and per-block
 # top-k candidate lists merge on the host via the native runtime
-# (native.topk_merge).  Overridable with SRML_KNN_HBM_BUDGET (bytes).
-_DEFAULT_HBM_BUDGET = 4 << 30
+# (native.topk_merge).  The in-core kernel chunk-scans items on device, so
+# this bound is about item RESIDENCY only (distance tiles stay chunk-sized);
+# 8 GB leaves half of a v5e's 16 GB HBM for tiles and outputs.
+# Overridable with SRML_KNN_HBM_BUDGET (bytes).
+_DEFAULT_HBM_BUDGET = 8 << 30
 
 
 def knn_search(

@@ -127,7 +127,9 @@ def pca_fit(
     if host_eigh is None:
         host_eigh = d >= HOST_EIGH_MIN_D and _is_cpu_backend(X)
     if not host_eigh:
-        return tuple(np.asarray(o) for o in pca_fit_kernel(X, w, k))  # type: ignore[return-value]
+        # one batched device_get: five sequential np.asarray fetches each pay
+        # the device-link round-trip latency
+        return tuple(jax.device_get(pca_fit_kernel(X, w, k)))  # type: ignore[return-value]
     from .. import native
 
     wsum_d, mean_d, cov_d = covariance_kernel(X, w)
