@@ -134,6 +134,15 @@ class _TpuCaller(_TpuParams):
         assert input_cols is not None
         return np.asarray(part[input_cols].to_numpy(), dtype=dtype)
 
+    def _fit_label_col(self) -> Optional[str]:
+        """Column to extract as ``FitInputs.y``, or None.  Supervised
+        estimators always consume their labelCol; optionally-supervised
+        estimators (UMAP, reference umap.py:939-947) override this to opt in
+        only when the user set one."""
+        if isinstance(self, _TpuEstimatorSupervised) and self.hasParam("labelCol"):
+            return self.getOrDefault("labelCol")
+        return None
+
     def _pre_process_data(
         self, df: DataFrame
     ) -> Tuple[List[np.ndarray], Optional[List[np.ndarray]], Optional[List[np.ndarray]], np.dtype]:
@@ -142,11 +151,7 @@ class _TpuCaller(_TpuParams):
         input_col, input_cols = self._get_input_columns()
         dtype = self._use_dtype(df, input_col, input_cols)
         feats, labels, weights = [], None, None
-        label_col = (
-            self.getOrDefault("labelCol")
-            if isinstance(self, _TpuEstimatorSupervised) and self.hasParam("labelCol")
-            else None
-        )
+        label_col = self._fit_label_col()
         weight_col = (
             self.getOrDefault("weightCol")
             if self.hasParam("weightCol") and self.isSet("weightCol")
@@ -192,16 +197,14 @@ class _TpuCaller(_TpuParams):
             f.shape[0] == 0 or f is _partition_feature_block(p, input_col)
             for f, p in zip(feats, df.partitions)
         )
-        cache_key = (
-            tuple(id(f) for f in nonempty),
-            str(dtype),
-            id(mesh),
-            bool(labels is not None),
-            bool(weights is not None),
-        )
+        # Only the FEATURE arrays are cached: labels/weights are re-extracted
+        # per fit (they are O(N) host arrays whose identity is NOT stable —
+        # to_numpy() returns fresh objects, and labelCol/weightCol can change
+        # between fits over the same cached features).
+        cache_key = (tuple(id(f) for f in nonempty), str(dtype), id(mesh))
         cached = _FIT_INPUT_CACHE.get("slot")
         if cached is not None and cached[0] == cache_key:
-            Xs, ws, ys, n_rows, n_cols, _host_refs = cached[1]
+            Xs, n_rows, n_cols, _host_refs = cached[1]
         else:
             # free the previous slot's device arrays BEFORE allocating the
             # new dataset so peak HBM is one dataset, not two
@@ -210,28 +213,28 @@ class _TpuCaller(_TpuParams):
 
             X = _concat_and_free(list(nonempty), order="C")
             n_rows, n_cols = X.shape
-            y_np = np.concatenate(labels) if labels is not None else None
-            w_np = (
-                np.concatenate(weights)
-                if weights is not None
-                else np.ones(n_rows, dtype=dtype)
-            )
             with profiling.phase("srml.device_put"):
                 Xs, _ = shard_rows(X, mesh)
-            n_pad = Xs.shape[0]
-            mask = np.zeros(n_pad, dtype=dtype)
-            mask[:n_rows] = w_np
-            ws = jax.device_put(mask, data_sharding(mesh))
-            ys = None
-            if y_np is not None:
-                y_pad = np.zeros(n_pad, dtype=dtype)
-                y_pad[:n_rows] = y_np
-                ys = jax.device_put(y_pad, data_sharding(mesh))
             if cacheable:
                 _FIT_INPUT_CACHE["slot"] = (
                     cache_key,
-                    (Xs, ws, ys, n_rows, n_cols, list(nonempty)),
+                    (Xs, n_rows, n_cols, list(nonempty)),
                 )
+        n_pad = Xs.shape[0]
+        y_np = np.concatenate(labels) if labels is not None else None
+        w_np = (
+            np.concatenate(weights)
+            if weights is not None
+            else np.ones(n_rows, dtype=dtype)
+        )
+        mask = np.zeros(n_pad, dtype=dtype)
+        mask[:n_rows] = w_np
+        ws = jax.device_put(mask, data_sharding(mesh))
+        ys = None
+        if y_np is not None:
+            y_pad = np.zeros(n_pad, dtype=dtype)
+            y_pad[:n_rows] = y_np
+            ys = jax.device_put(y_pad, data_sharding(mesh))
         pdesc = PartitionDescriptor.build(partition_rows, n_cols)
         return FitInputs(
             X=Xs,

@@ -7,11 +7,14 @@
 # on (optionally sampled) data with the model carrying embedding_ + raw
 # training data for transform (umap.py:831-910), and distributed transform
 # that projects each batch against the broadcast model (umap.py:1147-1224).
+# Supervised fit (labelCol set -> categorical simplicial-set intersection,
+# the reference's y= branch at umap.py:939-947) is supported.
 # Differences by design: the kNN graph is built by the mesh-distributed
 # exact kNN kernel instead of single-GPU cuML, so fit itself scales across
 # the mesh; "spectral" init is approximated by a scaled PCA projection;
-# transform uses the weighted-neighbor-mean initialization without SGD
-# refinement epochs.
+# transform initializes at the weighted neighbor mean then runs the
+# n_epochs//3 (or 100/30) SGD refinement epochs against the frozen training
+# embedding, as cuml/umap-learn transform does.
 #
 
 from __future__ import annotations
@@ -144,18 +147,26 @@ class UMAP(_UMAPParams, _TpuEstimator):
         self._initialize_tpu_params()
         self._set_params(**kwargs)
 
+    def _fit_label_col(self):
+        # optionally supervised (reference umap.py:722-724, 939-947):
+        # labels are consumed only when the user set labelCol explicitly
+        return self.getOrDefault("labelCol") if self.isSet("labelCol") else None
+
     def _get_tpu_fit_func(self, dataset: DataFrame, extra_params=None):
         logger = get_logger(type(self))
         sample_fraction = self.getSampleFraction()
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
-            X = np.asarray(inputs.X)[np.asarray(inputs.weight) > 0]
+            valid = np.asarray(inputs.weight) > 0
+            X = np.asarray(inputs.X)[valid]
+            y = np.asarray(inputs.y)[valid] if inputs.y is not None else None
             seed = params.get("random_state")
             seed = int(seed) & 0x7FFFFFFF if seed is not None else 42
             if sample_fraction < 1.0:
                 rng = np.random.default_rng(seed)
                 keep = rng.random(X.shape[0]) < sample_fraction
                 X = X[keep]
+                y = y[keep] if y is not None else None
             n = X.shape[0]
             if n == 0:
                 raise RuntimeError(
@@ -201,6 +212,7 @@ class UMAP(_UMAPParams, _TpuEstimator):
                 repulsion_strength=float(params["repulsion_strength"]),
                 negative_sample_rate=int(params["negative_sample_rate"]),
                 seed=seed,
+                y=y,
             )
             return {
                 "embedding_": embedding.astype(np.float32),
@@ -243,22 +255,45 @@ class UMAPModel(_UMAPParams, _TpuModel):
 
     def _get_tpu_transform_func(self, dataset: DataFrame):
         out_col = self.getOrDefault("outputCol")
-        k = int(min(self._tpu_params.get("n_neighbors", 15), self.raw_data_.shape[0]))
-        local_connectivity = float(self._tpu_params.get("local_connectivity", 1.0))
+        p = self._tpu_params
+        k = int(min(p.get("n_neighbors", 15), self.raw_data_.shape[0]))
+        local_connectivity = float(p.get("local_connectivity", 1.0))
+        a, b = p.get("a"), p.get("b")
+        if a is None or b is None:
+            a, b = find_ab_params(
+                float(p.get("spread", 1.0)), float(p.get("min_dist", 0.1))
+            )
+        seed = p.get("random_state")
         mesh = get_mesh(self.num_workers)
         from ..ops.knn import knn_search_prepared, prepare_items
 
-        # shard the training set to device ONCE; reused by every partition
+        # shard the training set + upload the embedding to device ONCE;
+        # reused by every partition
         prepared = prepare_items(
             self.raw_data_,
             np.arange(self.raw_data_.shape[0], dtype=np.int64),
             mesh,
         )
+        import jax.numpy as jnp
+
+        emb_f32 = self.embedding_.astype(np.float32)
+        emb_dev = jnp.asarray(emb_f32)
 
         def _transform(features: np.ndarray) -> Dict[str, Any]:
             dists, ids = knn_search_prepared(prepared, features, k, mesh)
             emb = umap_transform_embedding(
-                ids, dists, self.embedding_, local_connectivity
+                ids,
+                dists,
+                emb_f32,
+                local_connectivity,
+                train_embedding_dev=emb_dev,
+                a=a,
+                b=b,
+                n_epochs=p.get("n_epochs"),
+                learning_rate=float(p.get("learning_rate", 1.0)),
+                repulsion_strength=float(p.get("repulsion_strength", 1.0)),
+                negative_sample_rate=int(p.get("negative_sample_rate", 5)),
+                seed=int(seed) & 0x7FFFFFFF if seed is not None else 42,
             )
             return {out_col: emb.astype(np.float64)}
 

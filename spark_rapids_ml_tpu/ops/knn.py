@@ -56,20 +56,28 @@ def knn_block_kernel(
     def per_shard(items_loc, x_norm, ids_loc, valid_loc, q):
         n_loc, d = items_loc.shape
         Q = q.shape[0]
-        # distance-tile budget ~512 MB f32; chunks sized to it (static)
-        chunk = max(512, min(n_loc, (128 << 20) // max(Q, 1)))
+        # distance-tile budget ~512 MB f32; chunks sized to it (static,
+        # never wider than the shard itself — the scan slices in-bounds)
+        chunk = min(n_loc, max(512, (128 << 20) // max(Q, 1)))
         kk = min(k, chunk)
         n_chunks = -(-n_loc // chunk)
-        pad = n_chunks * chunk - n_loc
-        items_p = jnp.pad(items_loc, ((0, pad), (0, 0)))
-        norm_p = jnp.pad(x_norm, (0, pad))
-        ids_p = jnp.pad(ids_loc, (0, pad))
-        valid_p = jnp.pad(valid_loc, (0, pad))  # False padding
         q_norm = (q * q).sum(axis=1)
 
-        def body(carry, xs):
+        # The scan reads chunks straight out of the resident shard with
+        # dynamic_slice (NO padded copy of the shard: a jnp.pad here would
+        # materialize a second full-size item array, which at the 8 GB
+        # residency budget would blow HBM).  The last chunk is clamped
+        # in-bounds, so rows it shares with the previous chunk are masked
+        # via `fresh` to keep every item considered exactly once.
+        def body(carry, i):
             best_d, best_ids = carry
-            it, nb, idb, vb = xs
+            start = jnp.minimum(i * chunk, n_loc - chunk)
+            it = jax.lax.dynamic_slice_in_dim(items_loc, start, chunk)
+            nb = jax.lax.dynamic_slice_in_dim(x_norm, start, chunk)
+            idb = jax.lax.dynamic_slice_in_dim(ids_loc, start, chunk)
+            vb = jax.lax.dynamic_slice_in_dim(valid_loc, start, chunk)
+            fresh = (start + jnp.arange(chunk)) >= i * chunk
+            vb = vb & fresh
             # HIGH = 3-pass bf16 products (~2^-19 relative): the norm
             # expansion cancels catastrophically for near neighbors, so the
             # single-pass bf16 default (~2^-8) failed sklearn parity on
@@ -94,13 +102,9 @@ def knn_block_kernel(
             jnp.full((Q, k), jnp.inf, q_norm.dtype),
             jnp.zeros((Q, k), ids_loc.dtype),
         )
-        xs = (
-            items_p.reshape(n_chunks, chunk, d),
-            norm_p.reshape(n_chunks, chunk),
-            ids_p.reshape(n_chunks, chunk),
-            valid_p.reshape(n_chunks, chunk),
+        (best_d, best_ids), _ = jax.lax.scan(
+            body, init, jnp.arange(n_chunks, dtype=jnp.int32)
         )
-        (best_d, best_ids), _ = jax.lax.scan(body, init, xs)
         # (n_dev, Q, k) candidates — the only cross-shard traffic
         all_d = jax.lax.all_gather(best_d, DATA_AXIS)
         all_ids = jax.lax.all_gather(best_ids, DATA_AXIS)
@@ -293,7 +297,13 @@ def knn_search_prepared(
             prepared.items, prepared.norm, prepared.pos, prepared.valid,
             jnp.asarray(qb), mesh, k,
         )
-        out_d.append(np.asarray(d[:n_q]))
-        # map device positions -> user ids on the host (int64-safe)
-        out_i.append(prepared.ids[np.asarray(pos[:n_q])])
+        d_host = np.asarray(d[:n_q])
+        # map device positions -> user ids on the host (int64-safe); slots
+        # the kernel could not fill (k > valid items) carry inf distance by
+        # construction — mark them with the -1 sentinel the out-of-core
+        # merge and callers rely on
+        ids_host = prepared.ids[np.asarray(pos[:n_q])]
+        ids_host[np.isinf(d_host)] = -1
+        out_d.append(d_host)
+        out_i.append(ids_host)
     return np.concatenate(out_d), np.concatenate(out_i)

@@ -115,6 +115,32 @@ def fuzzy_simplicial_set(
     return set_op_mix_ratio * (w + wT - w * wT) + (1.0 - set_op_mix_ratio) * (w * wT)
 
 
+@jax.jit
+def categorical_simplicial_set_intersection(
+    W: jax.Array,        # (n, k) membership strengths
+    knn_ids: jax.Array,  # (n, k) int32
+    labels: jax.Array,   # (n,) categorical labels; < 0 means unknown
+    far_dist: float = 5.0,
+    unknown_dist: float = 1.0,
+) -> jax.Array:
+    """Supervised UMAP: intersect the data-driven fuzzy set with the label
+    partition (umap-learn ``categorical_simplicial_set_intersection``; the
+    path cuML takes when the reference passes y= at umap.py:939-945).
+    Edges between differently-labeled points are downweighted by
+    exp(-far_dist); edges touching an unknown label by exp(-unknown_dist).
+    Local connectivity is then reset by renormalizing each row to max 1
+    (a dense approximation of umap-learn's reset_local_connectivity)."""
+    yi = labels[:, None]
+    yj = labels[knn_ids]
+    unknown = (yi < 0) | (yj < 0)
+    differ = yi != yj
+    scale = jnp.where(
+        unknown, jnp.exp(-unknown_dist), jnp.where(differ, jnp.exp(-far_dist), 1.0)
+    )
+    W2 = W * scale
+    return W2 / jnp.maximum(W2.max(axis=1, keepdims=True), 1e-12)
+
+
 @partial(jax.jit, static_argnames=("n_epochs", "negative_sample_rate"), donate_argnums=(0,))
 def optimize_layout(
     embedding: jax.Array,   # (n, n_components) initial
@@ -187,8 +213,12 @@ def umap_fit_embedding(
     repulsion_strength: float,
     negative_sample_rate: int,
     seed: int,
+    y: Optional[np.ndarray] = None,
 ) -> np.ndarray:
-    """Host orchestration of the fit pipeline (graph + init + layout)."""
+    """Host orchestration of the fit pipeline (graph + init + layout).
+    When ``y`` is given, runs the supervised path: the fuzzy set is
+    intersected with the label partition before layout (the reference's
+    y= branch, umap.py:939-945)."""
     n = X.shape[0]
     rho, sigma = smooth_knn_calibration(
         jnp.asarray(knn_dists), local_connectivity=local_connectivity
@@ -200,6 +230,14 @@ def umap_fit_embedding(
         sigma,
         set_op_mix_ratio,
     )
+    if y is not None:
+        codes = np.full(n, -1, dtype=np.int32)
+        finite = np.isfinite(np.asarray(y, dtype=np.float64))
+        _, inv = np.unique(np.asarray(y)[finite], return_inverse=True)
+        codes[finite] = inv.astype(np.int32)
+        W = categorical_simplicial_set_intersection(
+            W, jnp.asarray(knn_ids.astype(np.int32)), jnp.asarray(codes)
+        )
     if n_epochs is None:
         n_epochs = 500 if n <= 10_000 else 200
     W = np.asarray(W)
@@ -245,23 +283,127 @@ def umap_fit_embedding(
     return np.asarray(out)
 
 
+@partial(jax.jit, static_argnames=("n_epochs", "negative_sample_rate"), donate_argnums=(0,))
+def optimize_transform_layout(
+    emb_q: jax.Array,      # (nq, c) query embedding (updated)
+    ref_emb: jax.Array,    # (nr, c) training embedding (FIXED)
+    heads: jax.Array,      # (E,) int32 query indices
+    tails: jax.Array,      # (E,) int32 reference indices
+    weights: jax.Array,    # (E,) membership strengths in [0, 1]
+    a: float,
+    b: float,
+    n_epochs: int,
+    learning_rate: float,
+    repulsion_strength: float,
+    negative_sample_rate: int,
+    seed: int,
+) -> jax.Array:
+    """Refinement epochs of cuml/umap-learn transform: the query points run
+    the same attract/repel SGD as fit, but only against the frozen training
+    embedding, and only the query side moves."""
+    nr = ref_emb.shape[0]
+    E = heads.shape[0]
+    key0 = jax.random.PRNGKey(seed)
+
+    def epoch(e, emb):
+        key = jax.random.fold_in(key0, e)
+        k1, k2 = jax.random.split(key)
+        alpha = learning_rate * (1.0 - e / n_epochs)
+        fire = jax.random.uniform(k1, (E,)) < weights
+        h = emb[heads]
+        t = ref_emb[tails]
+        diff = h - t
+        d2 = (diff * diff).sum(axis=1)
+        att = (-2.0 * a * b * d2 ** (b - 1.0)) / (1.0 + a * d2**b)
+        att = jnp.where(d2 > 0, att, 0.0) * fire
+        g_att = jnp.clip(att[:, None] * diff, -4.0, 4.0)
+        upd = jnp.zeros_like(emb)
+        upd = upd.at[heads].add(g_att * alpha)
+
+        S = negative_sample_rate
+        neg = jax.random.randint(k2, (E, S), 0, nr)
+        diff_n = h[:, None, :] - ref_emb[neg]
+        d2n = (diff_n * diff_n).sum(axis=2)
+        rep = (2.0 * repulsion_strength * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
+        rep = rep * fire[:, None]
+        g_rep = jnp.clip(rep[:, :, None] * diff_n, -4.0, 4.0)
+        upd = upd.at[heads].add(g_rep.sum(axis=1) * alpha)
+        return emb + upd
+
+    return jax.lax.fori_loop(0, n_epochs, epoch, emb_q)
+
+
 def umap_transform_embedding(
     query_knn_ids: np.ndarray,
     query_knn_dists: np.ndarray,
     train_embedding: np.ndarray,
     local_connectivity: float,
+    a: Optional[float] = None,
+    b: Optional[float] = None,
+    n_epochs: Optional[int] = None,
+    learning_rate: float = 1.0,
+    repulsion_strength: float = 1.0,
+    negative_sample_rate: int = 5,
+    seed: int = 42,
+    train_embedding_dev: Optional[jax.Array] = None,
 ) -> np.ndarray:
-    """Embed new points as the membership-weighted mean of their training
-    neighbors' embeddings (the initialization step of cuml/umap-learn
-    transform; refinement epochs are omitted — documented approximation)."""
+    """Embed new points: membership-weighted mean of training neighbors'
+    embeddings, then (when a/b are given) the SGD refinement epochs that
+    cuml/umap-learn transform runs — n_epochs//3, or 100/30 by data size,
+    against the frozen training embedding.
+
+    The query count is padded to a power-of-two bucket (>=64) so the jitted
+    calibration/refinement kernels compile a bounded number of shapes across
+    partitions of varying size; pass ``train_embedding_dev`` (uploaded once
+    by the caller) to avoid re-transferring the training embedding per
+    partition."""
+    nq, k = query_knn_ids.shape
+    if nq == 0:
+        return np.zeros((0, train_embedding.shape[1]), np.float32)
+    bucket = 64
+    while bucket < nq:
+        bucket *= 2
+    pad = bucket - nq
+    ids_p = np.pad(query_knn_ids, ((0, pad), (0, 0)))
+    dists_p = np.pad(query_knn_dists, ((0, pad), (0, 0)))
     rho, sigma = smooth_knn_calibration(
-        jnp.asarray(query_knn_dists), local_connectivity=local_connectivity
+        jnp.asarray(dists_p), local_connectivity=local_connectivity
     )
-    w = np.asarray(
+    # np.array (not asarray): jax->numpy views are read-only and the
+    # padding rows are zeroed in place below
+    w = np.array(
         jnp.exp(
-            -jnp.maximum(jnp.asarray(query_knn_dists) - rho[:, None], 0.0)
-            / sigma[:, None]
+            -jnp.maximum(jnp.asarray(dists_p) - rho[:, None], 0.0) / sigma[:, None]
         )
     )
-    w = w / np.maximum(w.sum(axis=1, keepdims=True), 1e-12)
-    return np.einsum("nk,nkc->nc", w, train_embedding[query_knn_ids])
+    wn = w / np.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+    init = np.einsum("nk,nkc->nc", wn, train_embedding[ids_p]).astype(np.float32)
+    if a is None or b is None:
+        return init[:nq]
+    if n_epochs is None:
+        n_epochs = 100 if train_embedding.shape[0] <= 10_000 else 30
+    else:
+        n_epochs = max(int(n_epochs) // 3, 1)
+    heads = np.repeat(np.arange(bucket, dtype=np.int32), k)
+    tails = ids_p.astype(np.int32).reshape(-1)
+    wmax = w[:nq].max() if nq else 1.0
+    # padding rows get weight 0: their edges never fire
+    w[nq:] = 0.0
+    weights = (w / max(wmax, 1e-12)).astype(np.float32).reshape(-1)
+    if train_embedding_dev is None:
+        train_embedding_dev = jnp.asarray(train_embedding.astype(np.float32))
+    out = optimize_transform_layout(
+        jnp.asarray(init),
+        train_embedding_dev,
+        jnp.asarray(heads),
+        jnp.asarray(tails),
+        jnp.asarray(weights),
+        float(a),
+        float(b),
+        int(n_epochs),
+        float(learning_rate),
+        float(repulsion_strength),
+        int(negative_sample_rate),
+        int(seed),
+    )
+    return np.asarray(out[:nq])

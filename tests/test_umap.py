@@ -130,6 +130,43 @@ def test_umap_precomputed_knn():
         UMAP(n_neighbors=k, precomputed_knn=(ids[:10], dists[:10])).fit(df)
 
 
+def test_umap_supervised():
+    # labelCol set -> supervised fit (reference umap.py:722-724, 939-947):
+    # the label intersection must tighten class clusters vs unsupervised
+    X, labels = _blob_data(n=240, d=8, k=3, seed=7)
+    # make the blobs overlap so labels carry real extra information
+    X += np.random.default_rng(1).normal(scale=4.0, size=X.shape)
+    df = DataFrame.from_numpy(X, y=labels.astype(np.float64), num_partitions=2)
+
+    def sep_score(emb):
+        cents = np.stack([emb[labels == c].mean(axis=0) for c in range(3)])
+        intra = np.mean(
+            [np.linalg.norm(emb[labels == c] - cents[c], axis=1).mean() for c in range(3)]
+        )
+        inter = np.mean(
+            [np.linalg.norm(cents[i] - cents[j]) for i in range(3) for j in range(i + 1, 3)]
+        )
+        return inter / max(intra, 1e-9)
+
+    sup = UMAP(n_neighbors=10, random_state=0, n_epochs=150).setLabelCol("label").fit(df)
+    unsup = UMAP(n_neighbors=10, random_state=0, n_epochs=150).fit(df)
+    assert sup.embedding.shape == (240, 2)
+    assert sep_score(sup.embedding) > sep_score(unsup.embedding), (
+        sep_score(sup.embedding),
+        sep_score(unsup.embedding),
+    )
+
+
+def test_umap_supervised_ignored_when_label_unset():
+    # a label column present in the df but labelCol unset -> unsupervised
+    X, labels = _blob_data(n=80, d=6)
+    df = DataFrame.from_numpy(X, y=labels.astype(np.float64), num_partitions=2)
+    m1 = UMAP(n_neighbors=8, random_state=3, n_epochs=60).fit(df)
+    df2 = DataFrame.from_numpy(X, num_partitions=2)
+    m2 = UMAP(n_neighbors=8, random_state=3, n_epochs=60).fit(df2)
+    np.testing.assert_allclose(m1.embedding_, m2.embedding_, atol=1e-5)
+
+
 def test_umap_empty_sample_raises():
     X, _ = _blob_data(n=20)
     df = DataFrame.from_numpy(X, num_partitions=1)
