@@ -125,6 +125,8 @@ class DataFrame:
         weight: Optional[np.ndarray] = None,
         weightCol: str = "weight",
     ) -> "DataFrame":
+        if hasattr(X, "toarray") and hasattr(X, "tocsr"):  # scipy sparse
+            X = X.toarray()
         X = np.asarray(X)
         if feature_layout in ("array", "vector"):
             # Build partitions directly so each carries a contiguous 2-D

@@ -249,7 +249,9 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
             assert inputs.y is not None
-            stats = linreg_sufficient_stats(inputs.X, inputs.y, inputs.weight)
+            stats = linreg_sufficient_stats(
+                inputs.X, inputs.y, inputs.weight, mesh=inputs.mesh
+            )
             if extra_params:
                 results = []
                 for override in extra_params:

@@ -108,7 +108,9 @@ class PCA(_PCAParams, _TpuEstimator):
             k = min(int(k), inputs.n_cols)
             # whiten is honored at transform time (see PCAModel); wide inputs
             # route the eigh through the native host runtime (ops.linalg.pca_fit)
-            mean, components, var, ratio, sv = pca_fit(inputs.X, inputs.weight, k)
+            mean, components, var, ratio, sv = pca_fit(
+                inputs.X, inputs.weight, k, mesh=inputs.mesh
+            )
             return {
                 "mean_": np.asarray(mean, dtype=np.float64),
                 "components_": np.asarray(components, dtype=np.float64),

@@ -86,7 +86,12 @@ def smooth_knn_calibration(
     hi0 = jnp.full(n, jnp.inf, knn_dists.dtype)
     sigma0 = jnp.ones(n, knn_dists.dtype)
     _, _, sigma = jax.lax.fori_loop(0, n_iters, body, (lo0, hi0, sigma0))
-    mean_d = jnp.mean(jnp.where(nonzero, knn_dists, 0.0))
+    # floor from the mean NONZERO distance (sum/count, not mean over all
+    # slots): all-zero padding rows added by callers' power-of-two query
+    # bucketing must not dilute the floor, else a query's membership weights
+    # would depend on how many rows its partition happened to hold
+    nz_count = jnp.maximum(nonzero.sum(), 1)
+    mean_d = jnp.where(nonzero, knn_dists, 0.0).sum() / nz_count
     sigma = jnp.maximum(sigma, 1e-3 * mean_d)
     return rho, sigma
 

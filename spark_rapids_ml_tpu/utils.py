@@ -82,7 +82,31 @@ def _concat_and_free(array_list: List[np.ndarray], order: str = "F") -> np.ndarr
 
 
 def stack_feature_cells(cells: Any, dtype: np.dtype) -> np.ndarray:
-    """Column of array-like cells (Spark Vector / array<float> layout) -> 2-D array."""
+    """Column of array-like cells -> 2-D array.
+
+    Accepts the Spark array<float> layout (ndarray/list cells), pyspark
+    ``DenseVector``/``SparseVector`` cells (the reference ingests both,
+    e.g. Vectors.sparse doctests at classification.py:418,435), and scipy
+    sparse row matrices.  Sparse inputs are densified: the MXU wants dense
+    tiles, and every solver here is a dense formulation."""
+    n = len(cells)
+    if n == 0:
+        return np.zeros((0, 0), dtype=dtype)
+    first = cells[0]
+    if hasattr(first, "toArray"):  # pyspark Vector cells
+        size = len(first)
+        out = np.zeros((n, size), dtype=dtype)
+        for i, c in enumerate(cells):
+            idx = getattr(c, "indices", None)
+            if idx is not None:  # SparseVector: fill nonzeros only
+                out[i, np.asarray(idx, dtype=np.int64)] = c.values
+            else:
+                out[i] = c.toArray()
+        return out
+    if hasattr(first, "toarray") and hasattr(first, "tocsr"):  # scipy sparse rows
+        import scipy.sparse as sp
+
+        return np.asarray(sp.vstack(list(cells)).toarray(), dtype=dtype)
     try:
         out = np.stack(cells)
     except ValueError as e:

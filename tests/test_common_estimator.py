@@ -211,3 +211,67 @@ def test_fit_with_params_list():
     assert len(models) == 2
     assert models[0].getOrDefault("alpha") == 1.5
     assert models[1].getOrDefault("alpha") == 2.5
+
+
+def test_sparse_feature_cells():
+    # pyspark SparseVector/DenseVector cells and scipy CSR rows densify at
+    # ingest (the reference accepts Vectors.sparse inputs,
+    # classification.py:418,435)
+    import scipy.sparse as sp
+
+    from spark_rapids_ml_tpu.utils import stack_feature_cells
+
+    # duck-typed stand-ins for pyspark.ml.linalg vectors (pyspark itself is
+    # not installed in the test image; ingest keys on toArray/indices/values)
+    class FakeSparseVector:
+        def __init__(self, size, indices, values):
+            self.size, self.indices = size, np.asarray(indices)
+            self.values = np.asarray(values, dtype=np.float64)
+
+        def __len__(self):
+            return self.size
+
+        def toArray(self):
+            out = np.zeros(self.size)
+            out[self.indices] = self.values
+            return out
+
+    class FakeDenseVector:
+        def __init__(self, values):
+            self.values = np.asarray(values, dtype=np.float64)
+
+        def __len__(self):
+            return len(self.values)
+
+        def toArray(self):
+            return self.values
+
+    dense = np.array([[1.0, 0.0, 2.0], [0.0, 3.0, 0.0]])
+    cells_ps = [FakeSparseVector(3, [0, 2], [1.0, 2.0]), FakeDenseVector([0.0, 3.0, 0.0])]
+    np.testing.assert_allclose(stack_feature_cells(cells_ps, np.float32), dense)
+    csr = sp.csr_matrix(dense)
+    cells_sp = [csr[i] for i in range(2)]
+    np.testing.assert_allclose(stack_feature_cells(cells_sp, np.float32), dense)
+
+    # end-to-end: fit from a DataFrame whose feature cells are SparseVectors
+    rng = np.random.default_rng(0)
+    Xd = rng.normal(size=(40, 5))
+    Xd[rng.random(Xd.shape) < 0.6] = 0.0
+    cells = [
+        FakeSparseVector(5, np.nonzero(r)[0], r[np.nonzero(r)[0]]) for r in Xd
+    ]
+    pdf = pd.DataFrame({"features": cells})
+    df = DataFrame([pdf])
+    model = TpuDummy().fit(df)
+    np.testing.assert_allclose(model.mean, Xd.mean(axis=0), atol=1e-5)
+
+
+def test_from_numpy_scipy_sparse():
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(1)
+    Xd = rng.normal(size=(30, 4))
+    Xd[rng.random(Xd.shape) < 0.7] = 0.0
+    df = DataFrame.from_numpy(sp.csr_matrix(Xd), num_partitions=2)
+    model = TpuDummy().fit(df)
+    np.testing.assert_allclose(model.mean, Xd.mean(axis=0), atol=1e-5)
