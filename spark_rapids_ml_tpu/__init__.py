@@ -25,6 +25,8 @@ __all__ = [
     "UMAP",
     "UMAPModel",
     "CrossValidator",
+    "Pipeline",
+    "PipelineModel",
 ]
 
 
@@ -49,6 +51,8 @@ def __getattr__(name):  # lazy re-exports keep `import spark_rapids_ml_tpu` ligh
         "UMAP": ".models.umap",
         "UMAPModel": ".models.umap",
         "CrossValidator": ".tuning",
+        "Pipeline": ".pipeline",
+        "PipelineModel": ".pipeline",
     }
     if name in _locations:
         try:

@@ -108,6 +108,128 @@ def _impurity_from_stats(stats, kind: str):
     return imp, w, p
 
 
+def _split_ok(bg, p_w, p_imp, min_samples_leaf, min_impurity_decrease):
+    """Shared split gate.  The float-noise guard scales with the parent's
+    weighted impurity so tiny label magnitudes still split (an absolute
+    floor would not); pure parents (p_imp == 0) are gated explicitly because
+    any positive gain there is float32 noise."""
+    noise_floor = 1e-6 * p_imp * p_w + 1e-30
+    return (
+        jnp.isfinite(bg)
+        & (p_imp > 0)
+        & (bg > jnp.maximum(min_impurity_decrease * p_w, noise_floor))
+        & (p_w >= 2 * min_samples_leaf)
+    )
+
+
+def _best_split_from_hist(hist, kind, min_samples_leaf):
+    """hist (nb, Dc, B, S) -> (gain (nb, Dc, B), p_w, p_imp, p_val) with the
+    Spark/cuml weighted-impurity-decrease gain semantics; the empty-right
+    last bin and min_samples_leaf gating applied."""
+    left = jnp.cumsum(hist, axis=2)
+    total = left[:, :, -1:, :]
+    right = total - left
+    l_imp, l_w, _ = _impurity_from_stats(left, kind)
+    r_imp, r_w, _ = _impurity_from_stats(right, kind)
+    node_stats = total[:, 0, 0, :]  # identical across features
+    p_imp, p_w, p_val = _impurity_from_stats(node_stats, kind)
+    gain = p_imp[:, None, None] * p_w[:, None, None] - (l_imp * l_w + r_imp * r_w)
+    ok = (l_w >= min_samples_leaf) & (r_w >= min_samples_leaf)
+    gain = jnp.where(ok, gain, -jnp.inf)
+    gain = gain.at[:, :, -1].set(-jnp.inf)  # last bin = empty right side
+    return gain, p_w, p_imp, p_val
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins", "feat_batch", "kind", "max_features"),
+)
+def level_split_kernel_wide(
+    Xb: jax.Array,
+    stats: jax.Array,
+    rel_node: jax.Array,
+    key: jax.Array,
+    n_nodes: int,
+    n_bins: int,
+    feat_batch: int,
+    kind: str,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+):
+    """Deep-level growth: ONE segment_sum pass over the rows per feature
+    (ids = node * n_bins + bin, n_nodes * n_bins segments), chunked over
+    FEATURES to bound the histogram buffer.  The node-chunked kernel below
+    rescans all rows once per node chunk — at 2^13 nodes that is 32+ full
+    passes; this pass-per-level formulation is what makes depth-13 forests
+    tractable (TPU scatter throughput is the histogram ceiling either way).
+
+    Same return contract as level_split_kernel."""
+    N, D = Xb.shape
+    S = stats.shape[1]
+    B = n_bins
+    active = rel_node < n_nodes
+    masked_stats = jnp.where(active[:, None], stats, 0.0)
+    base_ids = jnp.where(active, rel_node, 0) * B
+    n_chunks = -(-D // feat_batch)
+
+    if max_features < D:
+        # per-node exact-size random feature subset: threshold at the
+        # max_features-th largest of per-(node, feature) uniform scores
+        scores = jax.random.uniform(key, (n_nodes, D))
+        kth = jax.lax.top_k(scores, max_features)[0][:, -1]
+        fmask_full = scores >= kth[:, None]  # (n_nodes, D)
+
+    def one_chunk(c):
+        # clamped start keeps the slice in-bounds when feat_batch does not
+        # divide D; overlapped features are merely evaluated twice (same
+        # gain, same index), which cannot change the argmax result
+        start = jnp.minimum(c * feat_batch, D - feat_batch)
+        cols = jax.lax.dynamic_slice_in_dim(Xb, start, feat_batch, axis=1)
+
+        def per_feature(bcol):
+            ids = base_ids + bcol
+            return jax.ops.segment_sum(
+                masked_stats, ids, num_segments=n_nodes * B
+            )
+
+        hist = jax.vmap(per_feature, in_axes=1)(cols)  # (fc, n_nodes*B, S)
+        hist = jnp.moveaxis(hist.reshape(feat_batch, n_nodes, B, S), 0, 1)
+        gain, p_w, p_imp, p_val = _best_split_from_hist(
+            hist, kind, min_samples_leaf
+        )
+        if max_features < D:
+            fmask = jax.lax.dynamic_slice_in_dim(fmask_full, start, feat_batch, axis=1)
+            gain = jnp.where(fmask[:, :, None], gain, -jnp.inf)
+        flat = gain.reshape(n_nodes, -1)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (start + best // B).astype(jnp.int32)
+        bb = (best % B).astype(jnp.int32)
+        return bf, bb, best_gain, p_w, p_imp, p_val
+
+    def combine(carry, c):
+        bf, bb, bg, p_w, p_imp, p_val = one_chunk(c)
+        cbf, cbb, cbg = carry
+        better = bg > cbg
+        return (
+            (jnp.where(better, bf, cbf), jnp.where(better, bb, cbb), jnp.maximum(bg, cbg)),
+            (p_w, p_imp, p_val),
+        )
+
+    init = (
+        jnp.zeros(n_nodes, jnp.int32),
+        jnp.zeros(n_nodes, jnp.int32),
+        jnp.full(n_nodes, -jnp.inf),
+    )
+    (bf, bb, bg), aux = jax.lax.scan(
+        combine, init, jnp.arange(n_chunks, dtype=jnp.int32)
+    )
+    p_w, p_imp, p_val = (a[0] for a in aux)  # identical across chunks
+    split_ok = _split_ok(bg, p_w, p_imp, min_samples_leaf, min_impurity_decrease)
+    return bf, bb, split_ok, p_w, p_imp, p_val
+
+
 @partial(
     jax.jit,
     static_argnames=("n_nodes", "n_bins", "node_batch", "kind", "max_features"),
@@ -138,18 +260,9 @@ def level_split_kernel(
     def one_chunk(c):
         lo = c * node_batch
         hist = _chunk_histogram(Xb, stats, rel_node, lo, node_batch, n_bins)
-        left = jnp.cumsum(hist, axis=2)          # (nb, D, B, S)
-        total = left[:, :, -1:, :]
-        right = total - left
-        l_imp, l_w, _ = _impurity_from_stats(left, kind)
-        r_imp, r_w, _ = _impurity_from_stats(right, kind)
-        node_stats = total[:, 0, 0, :]           # identical across features
-        p_imp, p_w, p_val = _impurity_from_stats(node_stats, kind)
-        # weighted impurity decrease (Spark/cuml gain semantics)
-        gain = p_imp[:, None, None] * p_w[:, None, None] - (l_imp * l_w + r_imp * r_w)
-        ok = (l_w >= min_samples_leaf) & (r_w >= min_samples_leaf)
-        gain = jnp.where(ok, gain, -jnp.inf)
-        gain = gain.at[:, :, -1].set(-jnp.inf)   # last bin = empty right side
+        gain, p_w, p_imp, p_val = _best_split_from_hist(
+            hist, kind, min_samples_leaf
+        )
         if max_features < D:
             # per-node random feature subset (featureSubsetStrategy)
             scores = jax.random.uniform(
@@ -177,17 +290,7 @@ def level_split_kernel(
     p_w = p_w.reshape(-1)[:n_nodes]
     p_imp = p_imp.reshape(-1)[:n_nodes]
     p_val = p_val.reshape(n_chunks * node_batch, -1)[:n_nodes]
-    # float-noise guard scales with the parent's weighted impurity so tiny
-    # label magnitudes still split (an absolute floor would not); pure
-    # parents (p_imp == 0) are gated explicitly because any positive gain
-    # there is float32 noise
-    noise_floor = 1e-6 * p_imp * p_w + 1e-30
-    split_ok = (
-        jnp.isfinite(bg)
-        & (p_imp > 0)
-        & (bg > jnp.maximum(min_impurity_decrease * p_w, noise_floor))
-        & (p_w >= 2 * min_samples_leaf)
-    )
+    split_ok = _split_ok(bg, p_w, p_imp, min_samples_leaf, min_impurity_decrease)
     return bf, bb, split_ok, p_w, p_imp, p_val
 
 
@@ -255,6 +358,15 @@ def grow_tree(
     levels; each level kernel is compiled once per shape and cached)."""
     N, D = Xb.shape
     V = 1 if kind == "regression" else stats.shape[1]
+    S = stats.shape[1]
+    # Cap the node chunk so one (nb, D, B, S) histogram stays ~128 MB: the
+    # split-search stack (cumsum/right/gains) holds ~6 copies, and an
+    # unbounded nb at wide D (256 x 3000 x 128 -> 786 MB x 6) OOM-crashed
+    # the TPU worker at depth 13.  Power-of-two nb keeps the per-level
+    # kernel shapes reusable across levels and trees.
+    nb_cap = max(8, (128 << 20) // max(D * n_bins * S * 4, 1))
+    nb_cap = 1 << (nb_cap.bit_length() - 1)  # round DOWN to a power of two
+    node_batch = min(node_batch, nb_cap)
     M = 2 ** (max_depth + 1) - 1
     feature = np.full(M, -1, np.int32)
     threshold = np.zeros(M, np.float32)
@@ -268,17 +380,32 @@ def grow_tree(
     for level in range(max_depth + 1):
         n_nodes = 2**level
         key, kl = jax.random.split(key)
-        nb = min(node_batch, n_nodes)
-        bf, bb, ok, cnt, imp, val = level_split_kernel(
-            Xb, stats, rel, kl,
-            n_nodes=n_nodes, n_bins=n_bins, node_batch=nb, kind=kind,
-            max_features=max_features, min_samples_leaf=min_samples_leaf,
-            min_impurity_decrease=min_impurity_decrease,
-        )
+        if n_nodes > node_batch:
+            # deep level: one histogram pass over the rows, feature-chunked
+            # (node-chunking would rescan all rows once per chunk)
+            fc = max(1, (256 << 20) // (n_nodes * n_bins * S * 4))
+            fc = min(D, 1 << (fc.bit_length() - 1))
+            bf, bb, ok, cnt, imp, val = level_split_kernel_wide(
+                Xb, stats, rel, kl,
+                n_nodes=n_nodes, n_bins=n_bins, feat_batch=fc, kind=kind,
+                max_features=max_features, min_samples_leaf=min_samples_leaf,
+                min_impurity_decrease=min_impurity_decrease,
+            )
+        else:
+            bf, bb, ok, cnt, imp, val = level_split_kernel(
+                Xb, stats, rel, kl,
+                n_nodes=n_nodes, n_bins=n_bins, node_batch=n_nodes, kind=kind,
+                max_features=max_features, min_samples_leaf=min_samples_leaf,
+                min_impurity_decrease=min_impurity_decrease,
+            )
         if level == max_depth:
             ok = jnp.zeros_like(ok)
-        bf_h, bb_h, ok_h = np.asarray(bf), np.asarray(bb), np.asarray(ok)
-        cnt_h, imp_h, val_h = np.asarray(cnt), np.asarray(imp), np.asarray(val)
+        # ONE batched device_get per level: six sequential np.asarray calls
+        # each pay a host-link round trip, which dominates steady-state
+        # grow time in the host level loop
+        bf_h, bb_h, ok_h, cnt_h, imp_h, val_h = jax.device_get(
+            (bf, bb, ok, cnt, imp, val)
+        )
         base = 2**level - 1  # absolute index of first node in this level
         sl = slice(base, base + n_nodes)
         n_samples[sl] = cnt_h

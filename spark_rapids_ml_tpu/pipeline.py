@@ -1,0 +1,126 @@
+#
+# Pipeline / PipelineModel: chained stages over the framework DataFrame.
+#
+# The reference has no pipeline code of its own — its estimators plug into
+# pyspark.ml.Pipeline (SURVEY.md L1: estimators "sit above user code,
+# pyspark.ml.Pipeline, CrossValidator"). A standalone framework needs the
+# equivalent composition surface, so this module provides a
+# pyspark.ml.Pipeline-compatible API: fit() walks the stages, fitting
+# estimators (then transforming with the fitted model to feed the next
+# stage) and passing transformers through; PipelineModel.transform()
+# applies every fitted stage in order.  Persistence mirrors Spark ML's
+# layout: a pipeline directory with per-stage subdirectories.
+#
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, List, Optional
+
+from .core import _TpuEstimator, load as _load_any
+from .dataframe import DataFrame, as_dataframe
+
+_PIPELINE_META = "metadata.json"
+
+
+def _is_estimator(stage: Any) -> bool:
+    return isinstance(stage, _TpuEstimator) or (
+        hasattr(stage, "fit") and not hasattr(stage, "transform")
+    )
+
+
+class Pipeline:
+    """pyspark.ml.Pipeline-compatible chain of estimators/transformers."""
+
+    def __init__(self, stages: Optional[List[Any]] = None) -> None:
+        self._stages: List[Any] = list(stages or [])
+
+    def setStages(self, stages: List[Any]) -> "Pipeline":
+        self._stages = list(stages)
+        return self
+
+    def getStages(self) -> List[Any]:
+        return list(self._stages)
+
+    def fit(self, dataset: Any) -> "PipelineModel":
+        df = as_dataframe(dataset)
+        fitted: List[Any] = []
+        # find the last estimator: stages after it never need their
+        # transform output during fit (Spark ML semantics)
+        last_est = -1
+        for i, stage in enumerate(self._stages):
+            if _is_estimator(stage):
+                last_est = i
+        for i, stage in enumerate(self._stages):
+            if _is_estimator(stage):
+                model = stage.fit(df)
+                fitted.append(model)
+                if i < last_est:
+                    df = as_dataframe(model.transform(df))
+            else:
+                fitted.append(stage)
+                if i < last_est:
+                    df = as_dataframe(stage.transform(df))
+        return PipelineModel(fitted)
+
+    def copy(self, extra: Optional[dict] = None) -> "Pipeline":
+        return Pipeline([
+            s.copy(extra) if hasattr(s, "copy") else s for s in self._stages
+        ])
+
+    def save(self, path: str) -> None:
+        _save_stages(path, "Pipeline", self._stages)
+
+    @classmethod
+    def load(cls, path: str) -> "Pipeline":
+        return cls(_load_stages(path))
+
+
+class PipelineModel:
+    """Fitted pipeline: applies every stage's transform in order.
+
+    Deliberately NOT a _TpuModel subclass — it composes fitted models
+    rather than being one (no params, no fit attrs of its own)."""
+
+    def __init__(self, stages: List[Any]) -> None:
+        self.stages: List[Any] = list(stages)
+
+    def transform(self, dataset: Any) -> DataFrame:
+        df = as_dataframe(dataset)
+        for stage in self.stages:
+            df = as_dataframe(stage.transform(df))
+        return df
+
+    def copy(self, extra: Optional[dict] = None) -> "PipelineModel":
+        return PipelineModel([
+            s.copy(extra) if hasattr(s, "copy") else s for s in self.stages
+        ])
+
+    def save(self, path: str) -> None:
+        _save_stages(path, "PipelineModel", self.stages)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        return cls(_load_stages(path))
+
+
+def _save_stages(path: str, kind: str, stages: List[Any]) -> None:
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "class": f"{Pipeline.__module__}.{kind}",
+        "n_stages": len(stages),
+    }
+    with open(os.path.join(path, _PIPELINE_META), "w") as f:
+        json.dump(meta, f, indent=2)
+    for i, stage in enumerate(stages):
+        stage.save(os.path.join(path, f"stage_{i:03d}"))
+
+
+def _load_stages(path: str) -> List[Any]:
+    with open(os.path.join(path, _PIPELINE_META)) as f:
+        meta = json.load(f)
+    return [
+        _load_any(os.path.join(path, f"stage_{i:03d}"))
+        for i in range(meta["n_stages"])
+    ]

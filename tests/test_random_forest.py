@@ -207,3 +207,70 @@ def test_fit_multiple():
     models = [m for _, m in est.fitMultiple(df, pmaps)]
     assert models[0].getNumTrees == 5
     assert models[1].getNumTrees == 10
+
+
+def test_wide_level_kernel_matches_node_chunked():
+    # the deep-level one-pass kernel (level_split_kernel_wide) must grow the
+    # same tree as the node-chunked kernel; force it by shrinking node_batch
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.forest import (
+        bin_features,
+        compute_bin_edges,
+        grow_tree,
+    )
+
+    rng = np.random.default_rng(5)
+    N, D, B = 2000, 12, 32
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 3] > 0).astype(np.float32)
+    edges = compute_bin_edges(X, B)
+    Xb = bin_features(jnp.asarray(X), jnp.asarray(edges))
+    stats = jnp.asarray(
+        np.stack([1.0 - y, y], axis=1).astype(np.float32)
+    )
+    kw = dict(
+        max_depth=6, n_bins=B, kind="gini", max_features=D,
+        min_samples_leaf=1.0, min_impurity_decrease=0.0, seed=3,
+    )
+    t_chunked = grow_tree(Xb, stats, edges, node_batch=256, **kw)
+    t_wide = grow_tree(Xb, stats, edges, node_batch=1, **kw)  # all levels >1 wide
+    np.testing.assert_array_equal(
+        np.asarray(t_chunked.feature), np.asarray(t_wide.feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(t_chunked.threshold), np.asarray(t_wide.threshold)
+    )
+    np.testing.assert_allclose(
+        np.asarray(t_chunked.leaf_value), np.asarray(t_wide.leaf_value), atol=1e-6
+    )
+
+
+def test_wide_level_kernel_feature_subset_and_chunking():
+    # wide path with max_features < D and feat_batch smaller than D (uneven)
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.forest import (
+        bin_features,
+        compute_bin_edges,
+        level_split_kernel_wide,
+    )
+
+    rng = np.random.default_rng(7)
+    N, D, B, n_nodes = 500, 10, 16, 4
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    edges = compute_bin_edges(X, B)
+    Xb = bin_features(jnp.asarray(X), jnp.asarray(edges))
+    yb = rng.integers(0, 2, N)
+    stats = jnp.asarray(np.stack([1.0 - yb, yb], axis=1).astype(np.float32))
+    rel = jnp.asarray(rng.integers(0, n_nodes, N).astype(np.int32))
+    out = level_split_kernel_wide(
+        Xb, stats, rel, jax.random.PRNGKey(0),
+        n_nodes=n_nodes, n_bins=B, feat_batch=3, kind="gini",
+        max_features=4, min_samples_leaf=1.0, min_impurity_decrease=0.0,
+    )
+    bf, bb, ok, cnt, imp, val = [np.asarray(o) for o in out]
+    assert bf.shape == (n_nodes,) and np.all((bf >= 0) & (bf < D))
+    assert np.all((bb >= 0) & (bb < B))
+    np.testing.assert_allclose(cnt.sum(), N)
