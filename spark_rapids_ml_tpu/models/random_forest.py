@@ -51,6 +51,7 @@ from ..ops.forest import (
     bin_features,
     compute_bin_edges,
     forest_predict_kernel,
+    grow_forest,
     grow_tree,
 )
 from ..utils import get_logger
@@ -277,41 +278,76 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
             seed = params.get("random_state")
             seed = int(seed) & 0x7FFFFFFF if seed is not None else 42
             bootstrap = bool(params.get("bootstrap", True))
-            trees: List[TreeArrays] = []
+            grow_kwargs = dict(
+                max_depth=max_depth,
+                n_bins=n_bins,
+                kind=kind,
+                max_features=max_features,
+                min_samples_leaf=float(params.get("min_samples_leaf", 1)),
+                min_impurity_decrease=float(
+                    params.get("min_impurity_decrease", 0.0)
+                ),
+            )
             key = jax.random.PRNGKey(seed)
-            for t in range(n_trees):
-                key, kt = jax.random.split(key)
+            # Lock-step forest growth (one host level-loop for ALL trees)
+            # unless the batched path's device buffers would be too large:
+            # the (combined, D) feature-subset scores at the deepest level,
+            # or the (T, N, S) per-tree stats tensor itself (a per-tree fit
+            # only ever holds one (N, S) stats array) — those cases fall
+            # back to per-tree growth.
+            subset_bytes = (
+                n_trees * (2**max_depth) * inputs.n_cols * 4
+                if max_features < inputs.n_cols
+                else 0
+            )
+            stats_bytes = n_trees * inputs.X.shape[0] * stats.shape[1] * 4
+            if subset_bytes <= (512 << 20) and stats_bytes <= (2 << 30):
                 if bootstrap:
-                    bw = jax.random.poisson(kt, 1.0, (inputs.X.shape[0],)).astype(
-                        inputs.X.dtype
-                    )
-                    w_t = inputs.weight * bw
+                    key, kt = jax.random.split(key)
+                    bw = jax.random.poisson(
+                        kt, 1.0, (n_trees, inputs.X.shape[0])
+                    ).astype(inputs.X.dtype)
+                    w_t = inputs.weight[None, :] * bw
                 else:
-                    w_t = inputs.weight
-                tree_stats = stats * w_t[:, None]
-                trees.append(
-                    grow_tree(
-                        Xb,
-                        tree_stats,
-                        edges,
-                        max_depth=max_depth,
-                        n_bins=n_bins,
-                        kind=kind,
-                        max_features=max_features,
-                        min_samples_leaf=float(params.get("min_samples_leaf", 1)),
-                        min_impurity_decrease=float(
-                            params.get("min_impurity_decrease", 0.0)
-                        ),
-                        seed=(seed + 7919 * t) & 0x7FFFFFFF,
+                    w_t = jnp.broadcast_to(
+                        inputs.weight[None, :], (n_trees, inputs.X.shape[0])
                     )
+                stats_t = stats[None, :, :] * w_t[:, :, None]
+                features, thresholds, leaf_values, node_counts, impurities = (
+                    grow_forest(Xb, stats_t, edges, seed=seed, **grow_kwargs)
                 )
+            else:
+                trees: List[TreeArrays] = []
+                for t in range(n_trees):
+                    key, kt = jax.random.split(key)
+                    if bootstrap:
+                        bw = jax.random.poisson(
+                            kt, 1.0, (inputs.X.shape[0],)
+                        ).astype(inputs.X.dtype)
+                        w_t = inputs.weight * bw
+                    else:
+                        w_t = inputs.weight
+                    trees.append(
+                        grow_tree(
+                            Xb,
+                            stats * w_t[:, None],
+                            edges,
+                            seed=(seed + 7919 * t) & 0x7FFFFFFF,
+                            **grow_kwargs,
+                        )
+                    )
+                features = np.stack([np.asarray(t.feature) for t in trees])
+                thresholds = np.stack([np.asarray(t.threshold) for t in trees])
+                leaf_values = np.stack([np.asarray(t.leaf_value) for t in trees])
+                node_counts = np.stack([np.asarray(t.n_samples) for t in trees])
+                impurities = np.stack([np.asarray(t.impurity) for t in trees])
             logger.info("grew %d trees (depth<=%d, bins=%d)", n_trees, max_depth, n_bins)
             attrs = {
-                "features_": np.stack([np.asarray(t.feature) for t in trees]),
-                "thresholds_": np.stack([np.asarray(t.threshold) for t in trees]),
-                "leaf_values_": np.stack([np.asarray(t.leaf_value) for t in trees]),
-                "node_counts_": np.stack([np.asarray(t.n_samples) for t in trees]),
-                "impurities_": np.stack([np.asarray(t.impurity) for t in trees]),
+                "features_": features,
+                "thresholds_": thresholds,
+                "leaf_values_": leaf_values,
+                "node_counts_": node_counts,
+                "impurities_": impurities,
                 "max_depth": max_depth,
                 "n_cols": inputs.n_cols,
                 "dtype": str(inputs.dtype),

@@ -72,40 +72,60 @@ def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
 
 def _chunk_histogram(Xb, stats, rel_node, lo, node_batch, n_bins):
     """Per-(node, feature, bin) stat sums for nodes [lo, lo+node_batch):
-    (node_batch, D, n_bins, S).  Rows outside the chunk are masked; only one
-    chunk's histogram is ever live."""
+    (S, node_batch, D, n_bins) — S-LEADING, scalar scatters per stat (see
+    _impurity_s0: a trailing S axis lane-pads every scatter buffer 40-60x).
+    Rows outside the chunk are masked; only one chunk's histogram is live."""
     S = stats.shape[1]
     in_chunk = (rel_node >= lo) & (rel_node < lo + node_batch)
     local = jnp.where(in_chunk, rel_node - lo, node_batch)
     seg = local * n_bins  # (N,)
-    masked_stats = jnp.where(in_chunk[:, None], stats, 0.0)
+    stats_s = jnp.where(in_chunk[None, :], stats.T, 0.0)  # (S, N)
 
     def per_feature(bins_col):
         ids = jnp.where(in_chunk, seg + bins_col, node_batch * n_bins)
-        return jax.ops.segment_sum(
-            masked_stats, ids, num_segments=node_batch * n_bins + 1
-        )[:-1].reshape(node_batch, n_bins, S)
+        return jnp.stack(
+            [
+                jax.ops.segment_sum(
+                    stats_s[s], ids, num_segments=node_batch * n_bins + 1
+                )[:-1]
+                for s in range(S)
+            ]
+        )  # (S, nb*B)
 
-    return jax.vmap(per_feature, in_axes=1, out_axes=1)(Xb)  # (nb, D, B, S)
+    out = jax.vmap(per_feature, in_axes=1, out_axes=0)(Xb)  # (D, S, nb*B)
+    D = Xb.shape[1]
+    out = jnp.moveaxis(out, 0, 1).reshape(S, D, node_batch, n_bins)
+    return jnp.transpose(out, (0, 2, 1, 3))  # (S, nb, D, B)
 
 
-def _impurity_from_stats(stats, kind: str):
-    """stats (..., S) -> (impurity, count, value).
-    regression: S=[w, wy, wy2] -> variance; classification: S=class counts
-    -> gini or entropy; value = mean or class distribution."""
+def _impurity_s0(stats, kind: str):
+    """S-LEADING variant: stats (S, ...) -> (impurity, count).
+
+    Histogram buffers keep the stat axis FIRST because TPU tiles pad the
+    last dimension to 128 lanes — an (…, S=2..3) trailing axis inflates
+    every scatter buffer and intermediate 40-60x (observed as a 43 GB
+    allocation for a 1 GB logical histogram)."""
     if kind == "regression":
-        w = stats[..., 0]
-        mean = stats[..., 1] / jnp.maximum(w, 1e-12)
-        var = stats[..., 2] / jnp.maximum(w, 1e-12) - mean**2
-        return jnp.maximum(var, 0.0), w, mean[..., None]
-    counts = stats
-    w = counts.sum(axis=-1)
-    p = counts / jnp.maximum(w, 1e-12)[..., None]
+        w = stats[0]
+        mean = stats[1] / jnp.maximum(w, 1e-12)
+        var = stats[2] / jnp.maximum(w, 1e-12) - mean**2
+        return jnp.maximum(var, 0.0), w
+    w = stats.sum(axis=0)
+    p = stats / jnp.maximum(w, 1e-12)[None]
     if kind == "entropy":
-        imp = -(p * jnp.log2(jnp.maximum(p, 1e-12))).sum(axis=-1)
+        imp = -(p * jnp.log2(jnp.maximum(p, 1e-12))).sum(axis=0)
     else:  # gini
-        imp = 1.0 - (p * p).sum(axis=-1)
-    return imp, w, p
+        imp = 1.0 - (p * p).sum(axis=0)
+    return imp, w
+
+
+def _node_value_s0(node_stats, kind: str):
+    """node_stats (S, nb) -> value (nb, V); tiny, so the S-axis transpose
+    here is free."""
+    if kind == "regression":
+        return (node_stats[1] / jnp.maximum(node_stats[0], 1e-12))[:, None]
+    w = node_stats.sum(axis=0)
+    return (node_stats / jnp.maximum(w, 1e-12)[None]).T
 
 
 def _split_ok(bg, p_w, p_imp, min_samples_leaf, min_impurity_decrease):
@@ -123,21 +143,115 @@ def _split_ok(bg, p_w, p_imp, min_samples_leaf, min_impurity_decrease):
 
 
 def _best_split_from_hist(hist, kind, min_samples_leaf):
-    """hist (nb, Dc, B, S) -> (gain (nb, Dc, B), p_w, p_imp, p_val) with the
+    """hist (S, nb, Dc, B) S-LEADING (see _impurity_s0) ->
+    (gain (nb, Dc, B), p_w (nb,), p_imp (nb,), p_val (nb, V)) with the
     Spark/cuml weighted-impurity-decrease gain semantics; the empty-right
     last bin and min_samples_leaf gating applied."""
-    left = jnp.cumsum(hist, axis=2)
-    total = left[:, :, -1:, :]
+    left = jnp.cumsum(hist, axis=-1)
+    total = left[..., -1:]
     right = total - left
-    l_imp, l_w, _ = _impurity_from_stats(left, kind)
-    r_imp, r_w, _ = _impurity_from_stats(right, kind)
-    node_stats = total[:, 0, 0, :]  # identical across features
-    p_imp, p_w, p_val = _impurity_from_stats(node_stats, kind)
+    l_imp, l_w = _impurity_s0(left, kind)
+    r_imp, r_w = _impurity_s0(right, kind)
+    node_stats = total[:, :, 0, 0]  # (S, nb); identical across features
+    p_imp, p_w = _impurity_s0(node_stats, kind)
+    p_val = _node_value_s0(node_stats, kind)
     gain = p_imp[:, None, None] * p_w[:, None, None] - (l_imp * l_w + r_imp * r_w)
     ok = (l_w >= min_samples_leaf) & (r_w >= min_samples_leaf)
     gain = jnp.where(ok, gain, -jnp.inf)
     gain = gain.at[:, :, -1].set(-jnp.inf)  # last bin = empty right side
     return gain, p_w, p_imp, p_val
+
+
+def _wide_split_search(
+    Xb,
+    stats_s,     # (S, tile*N) masked scalar stat rows (S-leading)
+    base_ids,    # (tile*N,) combined-node*B base segment ids
+    tile,        # how many times each bin column repeats (trees in lock-step)
+    combined,    # total (tree, node) slots at this level
+    key,
+    n_bins,
+    feat_batch,
+    kind,
+    max_features,
+    min_samples_leaf,
+    min_impurity_decrease,
+):
+    """Shared body of the wide (pass-per-level) split search: ONE segment_sum
+    pass over the rows per feature (ids = combined_node * n_bins + bin),
+    chunked over FEATURES to bound the histogram buffer.  Used by
+    level_split_kernel_wide (tile=1) and forest_level_kernel (tile=T).
+
+    Returns flat (bf, bb, split_ok, p_w, p_imp, p_val) over the combined
+    node axis."""
+    D = Xb.shape[1]
+    S = stats_s.shape[0]
+    B = n_bins
+    n_chunks = -(-D // feat_batch)
+
+    if max_features < D:
+        # per-node exact-size random feature subset: threshold at the
+        # max_features-th largest of per-(node, feature) uniform scores
+        scores = jax.random.uniform(key, (combined, D))
+        kth = jax.lax.top_k(scores, max_features)[0][:, -1]
+        fmask_full = scores >= kth[:, None]  # (combined, D)
+
+    def one_chunk(c):
+        # clamped start keeps the slice in-bounds when feat_batch does not
+        # divide D; overlapped features are merely evaluated twice (same
+        # gain, same index), which cannot change the argmax result
+        start = jnp.minimum(c * feat_batch, D - feat_batch)
+        cols = jax.lax.dynamic_slice_in_dim(Xb, start, feat_batch, axis=1)
+
+        # scan (not vmap) over the chunk's features: vmap would broadcast
+        # the (S, rows) stat operand per feature
+        def step(carry, bcol):
+            ids = base_ids + (jnp.tile(bcol, tile) if tile > 1 else bcol)
+            h = jnp.stack(
+                [
+                    jax.ops.segment_sum(stats_s[s], ids, num_segments=combined * B)
+                    for s in range(S)
+                ]
+            )
+            return carry, h
+
+        _, hist = jax.lax.scan(step, 0, cols.T)  # (fc, S, combined*B)
+        hist = jnp.transpose(
+            jnp.moveaxis(hist, 0, 1).reshape(S, feat_batch, combined, B),
+            (0, 2, 1, 3),
+        )  # (S, combined, fc, B)
+        gain, p_w, p_imp, p_val = _best_split_from_hist(
+            hist, kind, min_samples_leaf
+        )
+        if max_features < D:
+            fmask = jax.lax.dynamic_slice_in_dim(fmask_full, start, feat_batch, axis=1)
+            gain = jnp.where(fmask[:, :, None], gain, -jnp.inf)
+        flat = gain.reshape(combined, -1)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (start + best // B).astype(jnp.int32)
+        bb = (best % B).astype(jnp.int32)
+        return bf, bb, best_gain, p_w, p_imp, p_val
+
+    def combine(carry, c):
+        bf, bb, bg, p_w, p_imp, p_val = one_chunk(c)
+        cbf, cbb, cbg = carry
+        better = bg > cbg
+        return (
+            (jnp.where(better, bf, cbf), jnp.where(better, bb, cbb), jnp.maximum(bg, cbg)),
+            (p_w, p_imp, p_val),
+        )
+
+    init = (
+        jnp.zeros(combined, jnp.int32),
+        jnp.zeros(combined, jnp.int32),
+        jnp.full(combined, -jnp.inf),
+    )
+    (bf, bb, bg), aux = jax.lax.scan(
+        combine, init, jnp.arange(n_chunks, dtype=jnp.int32)
+    )
+    p_w, p_imp, p_val = (a[0] for a in aux)  # identical across chunks
+    split_ok = _split_ok(bg, p_w, p_imp, min_samples_leaf, min_impurity_decrease)
+    return bf, bb, split_ok, p_w, p_imp, p_val
 
 
 @partial(
@@ -157,77 +271,18 @@ def level_split_kernel_wide(
     min_samples_leaf: float,
     min_impurity_decrease: float,
 ):
-    """Deep-level growth: ONE segment_sum pass over the rows per feature
-    (ids = node * n_bins + bin, n_nodes * n_bins segments), chunked over
-    FEATURES to bound the histogram buffer.  The node-chunked kernel below
-    rescans all rows once per node chunk — at 2^13 nodes that is 32+ full
-    passes; this pass-per-level formulation is what makes depth-13 forests
-    tractable (TPU scatter throughput is the histogram ceiling either way).
+    """Deep-level growth for one tree: the pass-per-level formulation that
+    makes depth-13 forests tractable (the node-chunked kernel below rescans
+    all rows once per node chunk — 32+ full passes at 2^13 nodes).
 
     Same return contract as level_split_kernel."""
-    N, D = Xb.shape
-    S = stats.shape[1]
-    B = n_bins
     active = rel_node < n_nodes
-    masked_stats = jnp.where(active[:, None], stats, 0.0)
-    base_ids = jnp.where(active, rel_node, 0) * B
-    n_chunks = -(-D // feat_batch)
-
-    if max_features < D:
-        # per-node exact-size random feature subset: threshold at the
-        # max_features-th largest of per-(node, feature) uniform scores
-        scores = jax.random.uniform(key, (n_nodes, D))
-        kth = jax.lax.top_k(scores, max_features)[0][:, -1]
-        fmask_full = scores >= kth[:, None]  # (n_nodes, D)
-
-    def one_chunk(c):
-        # clamped start keeps the slice in-bounds when feat_batch does not
-        # divide D; overlapped features are merely evaluated twice (same
-        # gain, same index), which cannot change the argmax result
-        start = jnp.minimum(c * feat_batch, D - feat_batch)
-        cols = jax.lax.dynamic_slice_in_dim(Xb, start, feat_batch, axis=1)
-
-        def per_feature(bcol):
-            ids = base_ids + bcol
-            return jax.ops.segment_sum(
-                masked_stats, ids, num_segments=n_nodes * B
-            )
-
-        hist = jax.vmap(per_feature, in_axes=1)(cols)  # (fc, n_nodes*B, S)
-        hist = jnp.moveaxis(hist.reshape(feat_batch, n_nodes, B, S), 0, 1)
-        gain, p_w, p_imp, p_val = _best_split_from_hist(
-            hist, kind, min_samples_leaf
-        )
-        if max_features < D:
-            fmask = jax.lax.dynamic_slice_in_dim(fmask_full, start, feat_batch, axis=1)
-            gain = jnp.where(fmask[:, :, None], gain, -jnp.inf)
-        flat = gain.reshape(n_nodes, -1)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bf = (start + best // B).astype(jnp.int32)
-        bb = (best % B).astype(jnp.int32)
-        return bf, bb, best_gain, p_w, p_imp, p_val
-
-    def combine(carry, c):
-        bf, bb, bg, p_w, p_imp, p_val = one_chunk(c)
-        cbf, cbb, cbg = carry
-        better = bg > cbg
-        return (
-            (jnp.where(better, bf, cbf), jnp.where(better, bb, cbb), jnp.maximum(bg, cbg)),
-            (p_w, p_imp, p_val),
-        )
-
-    init = (
-        jnp.zeros(n_nodes, jnp.int32),
-        jnp.zeros(n_nodes, jnp.int32),
-        jnp.full(n_nodes, -jnp.inf),
+    stats_s = jnp.where(active[None, :], stats.T, 0.0)  # (S, N)
+    base_ids = jnp.where(active, rel_node, 0) * n_bins
+    return _wide_split_search(
+        Xb, stats_s, base_ids, 1, n_nodes, key, n_bins, feat_batch, kind,
+        max_features, min_samples_leaf, min_impurity_decrease,
     )
-    (bf, bb, bg), aux = jax.lax.scan(
-        combine, init, jnp.arange(n_chunks, dtype=jnp.int32)
-    )
-    p_w, p_imp, p_val = (a[0] for a in aux)  # identical across chunks
-    split_ok = _split_ok(bg, p_w, p_imp, min_samples_leaf, min_impurity_decrease)
-    return bf, bb, split_ok, p_w, p_imp, p_val
 
 
 @partial(
@@ -339,6 +394,124 @@ def forest_predict_kernel(
 
     per_tree = jax.vmap(one_tree)(feature, threshold, leaf_value)  # (T, N, V)
     return per_tree.mean(axis=0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins", "feat_batch", "kind", "max_features"),
+)
+def forest_level_kernel(
+    Xb: jax.Array,        # (N, D) shared bins
+    stats: jax.Array,     # (T, N, S) per-tree stats (bootstrap-weighted)
+    rel_node: jax.Array,  # (T, N) int32, sentinel >= n_nodes when inactive
+    key: jax.Array,
+    n_nodes: int,
+    n_bins: int,
+    feat_batch: int,
+    kind: str,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+):
+    """One growth level for ALL trees at once: the (tree, node) pair is a
+    single combined node axis of size T*n_nodes, so the whole forest's
+    histograms are one segment_sum pass per feature and the host loop runs
+    max_depth iterations per FIT instead of per TREE (host round trips and
+    kernel dispatches dominated shallow-forest growth).
+
+    Returns the level_split_kernel tuple with a leading (T,) axis."""
+    T, N = rel_node.shape
+    S = stats.shape[2]
+    combined = T * n_nodes
+    active = rel_node < n_nodes
+    tree_base = (jnp.arange(T, dtype=jnp.int32) * n_nodes)[:, None]
+    rel_c = jnp.where(active, rel_node + tree_base, combined).reshape(-1)
+    # (S, T*N) scalar stat rows (S-leading: see _impurity_s0)
+    stats_s = jnp.where(
+        active.reshape(-1)[None, :], stats.reshape(T * N, S).T, 0.0
+    )
+    base_ids = jnp.where(rel_c < combined, rel_c, 0) * n_bins
+    out = _wide_split_search(
+        Xb, stats_s, base_ids, T, combined, key, n_bins, feat_batch, kind,
+        max_features, min_samples_leaf, min_impurity_decrease,
+    )
+    rs = lambda x: x.reshape(T, n_nodes, *x.shape[1:])
+    return tuple(rs(o) for o in out)
+
+@jax.jit
+def forest_route_kernel(Xb, rel_node, abs_node, best_feature, best_bin, split_ok):
+    """route_rows_kernel over the tree axis (shared Xb)."""
+    return jax.vmap(
+        lambda r, a, bf, bb, ok: route_rows_kernel(Xb, r, a, bf, bb, ok),
+    )(rel_node, abs_node, best_feature, best_bin, split_ok)
+
+
+def grow_forest(
+    Xb: jax.Array,
+    stats_t: jax.Array,   # (T, N, S) per-tree (bootstrap-weighted) stats
+    edges: np.ndarray,
+    max_depth: int,
+    n_bins: int,
+    kind: str,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+    seed: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Grow ALL trees level-by-level in lock-step (host loop = max_depth+1
+    jitted forest-level kernels).  Returns stacked host arrays
+    (features (T, M), thresholds, leaf_values (T, M, V), n_samples,
+    impurities) in the same dense-tree layout as grow_tree.
+
+    Falls back to per-tree grow_tree when the per-node feature-subset score
+    buffer would be too large (max_features < D with a very wide D)."""
+    T, N, S = stats_t.shape
+    D = Xb.shape[1]
+    V = 1 if kind == "regression" else S
+    M = 2 ** (max_depth + 1) - 1
+    feature = np.full((T, M), -1, np.int32)
+    threshold = np.zeros((T, M), np.float32)
+    leaf_value = np.zeros((T, M, V), np.float32)
+    n_samples = np.zeros((T, M), np.float32)
+    impurity = np.zeros((T, M), np.float32)
+
+    rel = jnp.zeros((T, N), jnp.int32)
+    abs_node = jnp.zeros((T, N), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    for level in range(max_depth + 1):
+        n_nodes = 2**level
+        combined = T * n_nodes
+        key, kl = jax.random.split(key)
+        fc = max(1, (256 << 20) // (combined * n_bins * S * 4))
+        fc = min(D, 1 << (fc.bit_length() - 1))
+        bf, bb, ok, cnt, imp, val = forest_level_kernel(
+            Xb, stats_t, rel, kl,
+            n_nodes=n_nodes, n_bins=n_bins, feat_batch=fc, kind=kind,
+            max_features=max_features, min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease,
+        )
+        if level == max_depth:
+            ok = jnp.zeros_like(ok)
+        bf_h, bb_h, ok_h, cnt_h, imp_h, val_h = jax.device_get(
+            (bf, bb, ok, cnt, imp, val)
+        )
+        base = 2**level - 1
+        sl = slice(base, base + n_nodes)
+        n_samples[:, sl] = cnt_h
+        impurity[:, sl] = imp_h
+        leaf_value[:, sl] = val_h
+        feature[:, sl] = np.where(ok_h, bf_h, -1)
+        threshold[:, sl] = np.where(
+            ok_h,
+            edges[
+                np.minimum(bf_h, D - 1), np.minimum(bb_h, edges.shape[1] - 1)
+            ],
+            0.0,
+        )
+        if not ok_h.any() or level == max_depth:
+            break
+        rel, abs_node = forest_route_kernel(Xb, rel, abs_node, bf, bb, ok)
+    return feature, threshold, leaf_value, n_samples, impurity
 
 
 def grow_tree(
