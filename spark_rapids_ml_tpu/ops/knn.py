@@ -308,8 +308,17 @@ def knn_search_prepared(
     block = 64
     while block < min(query_block, q.shape[0]):
         block *= 2
+    # overlap compute with host transfers via a BOUNDED in-flight window
+    # (jax execution is async): block b+window computes while block b's
+    # (Q, k) results cross the host link.  The bound matters — dispatching
+    # everything up front would keep every padded query block resident on
+    # device at once and OOM large searches.
+    window = 2
+    starts = list(range(0, q.shape[0], block))
+    pending: list = []
     out_d, out_i = [], []
-    for start in range(0, q.shape[0], block):
+
+    def _dispatch(start):
         qb = q[start : start + block]
         n_q = qb.shape[0]
         if n_q < block:
@@ -320,6 +329,10 @@ def knn_search_prepared(
             prepared.items, prepared.norm, prepared.pos, prepared.valid,
             jnp.asarray(qb), mesh, k,
         )
+        pending.append((d, pos, n_q))
+
+    def _collect():
+        d, pos, n_q = pending.pop(0)
         d_host = np.asarray(d[:n_q])
         # map device positions -> user ids on the host (int64-safe); slots
         # the kernel could not fill (k > valid items) carry inf distance by
@@ -329,4 +342,11 @@ def knn_search_prepared(
         ids_host[np.isinf(d_host)] = -1
         out_d.append(d_host)
         out_i.append(ids_host)
+
+    for start in starts:
+        _dispatch(start)
+        if len(pending) > window:
+            _collect()
+    while pending:
+        _collect()
     return np.concatenate(out_d), np.concatenate(out_i)

@@ -146,6 +146,90 @@ def categorical_simplicial_set_intersection(
     return W2 / jnp.maximum(W2.max(axis=1, keepdims=True), 1e-12)
 
 
+@partial(jax.jit, static_argnames=("n", "c", "n_iter"))
+def _laplacian_eigenmap_kernel(
+    ii: jax.Array,   # (E,) int32 undirected edge endpoints (deduped)
+    jj: jax.Array,   # (E,)
+    ww: jax.Array,   # (E,) symmetric weights
+    key: jax.Array,
+    n: int,
+    c: int,
+    n_iter: int = 50,
+) -> jax.Array:
+    """Top non-trivial eigenvectors of the normalized adjacency
+    A_hat = D^-1/2 W D^-1/2 by deflated subspace iteration (equivalently the
+    bottom eigenvectors of the normalized Laplacian — the spectral embedding
+    umap-learn/cuml use for init).  SpMV is two scatter-adds over the edge
+    list; the trivial eigenvector D^1/2*1 is projected out each iteration."""
+    deg = jnp.zeros(n).at[ii].add(ww).at[jj].add(ww)
+    dinv = 1.0 / jnp.sqrt(jnp.maximum(deg, 1e-12))
+    wn = ww * dinv[ii] * dinv[jj]
+    # trivial top eigenvector of A_hat (unit-normalized)
+    v0 = jnp.sqrt(jnp.maximum(deg, 0.0))
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    def spmv(x):  # (n, c)
+        y = jnp.zeros_like(x)
+        y = y.at[ii].add(wn[:, None] * x[jj])
+        y = y.at[jj].add(wn[:, None] * x[ii])
+        return y
+
+    def orthonormalize(y):
+        y = y - v0[:, None] * (v0 @ y)[None, :]
+        g = y.T @ y + 1e-12 * jnp.eye(c)
+        r = jnp.linalg.cholesky(g)
+        return jax.lax.linalg.triangular_solve(
+            r, y, left_side=False, lower=True, transpose_a=True
+        )
+
+    x0 = orthonormalize(jax.random.normal(key, (n, c)))
+
+    def body(_, x):
+        # shift by +1 so the most-positive eigenvalues of A_hat dominate
+        # (A_hat spectrum lies in [-1, 1])
+        return orthonormalize(spmv(x) + x)
+
+    return jax.lax.fori_loop(0, n_iter, body, x0)
+
+
+def spectral_init(
+    knn_ids: np.ndarray, W: np.ndarray, n_components: int, seed: int
+) -> np.ndarray:
+    """Spectral embedding of the fuzzy graph: dedupe the directed (n, k)
+    adjacency into an undirected edge list on the host, then run the jitted
+    deflated subspace iteration.  Returns (n, c) scaled to the same 10-box
+    umap-learn uses."""
+    n, k = knn_ids.shape
+    heads = np.repeat(np.arange(n, dtype=np.int64), k)
+    tails = knn_ids.astype(np.int64).reshape(-1)
+    w = np.asarray(W, dtype=np.float32).reshape(-1)
+    keep = (w > 0) & (heads != tails)
+    heads, tails, w = heads[keep], tails[keep], w[keep]
+    lo = np.minimum(heads, tails)
+    hi = np.maximum(heads, tails)
+    key_ = lo * n + hi
+    _, first = np.unique(key_, return_index=True)
+    ii = lo[first].astype(np.int32)
+    jj = hi[first].astype(np.int32)
+    ww = w[first]
+    emb = np.asarray(
+        _laplacian_eigenmap_kernel(
+            jnp.asarray(ii),
+            jnp.asarray(jj),
+            jnp.asarray(ww),
+            jax.random.PRNGKey(seed),
+            n=n,
+            c=int(n_components),
+        )
+    )
+    scale = np.abs(emb).max() or 1.0
+    emb = (emb / scale * 10.0).astype(np.float32)
+    emb += np.random.default_rng(seed).normal(scale=1e-4, size=emb.shape).astype(
+        np.float32
+    )
+    return emb
+
+
 @partial(jax.jit, static_argnames=("n_epochs", "negative_sample_rate"), donate_argnums=(0,))
 def optimize_layout(
     embedding: jax.Array,   # (n, n_components) initial
@@ -246,6 +330,7 @@ def umap_fit_embedding(
     if n_epochs is None:
         n_epochs = 500 if n <= 10_000 else 200
     W = np.asarray(W)
+    W_graph = W  # un-pruned graph feeds the spectral init
     wmax = W.max() if W.size else 1.0
     # prune edges too weak to ever fire under the resolved epoch schedule
     W = np.where(W / max(wmax, 1e-12) < 1.0 / max(n_epochs, 1), 0.0, W)
@@ -259,18 +344,9 @@ def umap_fit_embedding(
             .astype(np.float32)
         )
     else:
-        # "spectral" approximated by a scaled PCA projection (a recognized
-        # cheap stand-in for the Laplacian eigenmap init)
-        Xc = X - X.mean(axis=0)
-        _, _, Vt = np.linalg.svd(
-            Xc[: min(n, 10_000)], full_matrices=False
-        )
-        emb = (Xc @ Vt[:n_components].T).astype(np.float32)
-        scale = np.abs(emb).max() or 1.0
-        emb = emb / scale * 10.0
-        emb += (
-            np.random.default_rng(seed).normal(scale=1e-4, size=emb.shape)
-        ).astype(np.float32)
+        # "spectral": normalized-Laplacian eigenmap of the (un-pruned)
+        # fuzzy graph, as umap-learn/cuml
+        emb = spectral_init(knn_ids, W_graph, n_components, seed)
 
     out = optimize_layout(
         jnp.asarray(emb),

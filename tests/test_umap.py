@@ -172,3 +172,53 @@ def test_umap_empty_sample_raises():
     df = DataFrame.from_numpy(X, num_partitions=1)
     with pytest.raises(RuntimeError, match="0 rows"):
         UMAP(n_neighbors=3, sample_fraction=1e-9, random_state=0).fit(df)
+
+
+def test_spectral_init_is_graph_smooth():
+    # the spectral init must be a low-frequency embedding of the fuzzy graph
+    # (kNN-graph eigengaps are too small for a fixed-iteration method to pin
+    # exact eigenvectors, so graph-smoothness + cluster separation are the
+    # meaningful checks)
+    from spark_rapids_ml_tpu.ops.umap import spectral_init
+
+    rng = np.random.default_rng(0)
+    n, k = 120, 8
+    X = np.concatenate(
+        [rng.normal(size=(60, 4)), rng.normal(size=(60, 4)) + 6.0]
+    )
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    d, ids = SkNN(n_neighbors=k).fit(X).kneighbors(X)
+    W = np.exp(-(d**2))
+    emb = spectral_init(ids, W, 2, seed=1)
+    assert emb.shape == (n, 2) and np.all(np.isfinite(emb))
+
+    # dense ground truth
+    A = np.zeros((n, n))
+    for i in range(n):
+        for j_, w in zip(ids[i], W[i]):
+            if i != j_:
+                A[i, j_] = max(A[i, j_], w)
+                A[j_, i] = max(A[j_, i], w)
+    deg = A.sum(1)
+    dinv = 1.0 / np.sqrt(np.maximum(deg, 1e-12))
+    Ah = dinv[:, None] * A * dinv[None, :]
+    # kNN-graph spectral gaps are tiny, so a fixed-iteration subspace method
+    # cannot pin the exact top eigenvectors; the property the init needs is
+    # graph-SMOOTHNESS: its normalized-Laplacian Rayleigh quotient must be
+    # far below a random vector's (~1.0)
+    L = np.eye(n) - Ah
+
+    def rayleigh(v):
+        v = v - v.mean()
+        return float(v @ L @ v) / max(float(v @ v), 1e-12)
+
+    r_emb = np.mean([rayleigh(emb[:, c]) for c in range(2)])
+    rng2 = np.random.default_rng(3)
+    r_rand = np.mean([rayleigh(rng2.normal(size=n)) for _ in range(5)])
+    assert r_emb < 0.3 * r_rand, (r_emb, r_rand)
+    # and the two-block structure must separate along the embedding
+    labels = np.array([0] * 60 + [1] * 60)
+    c0, c1 = emb[labels == 0].mean(0), emb[labels == 1].mean(0)
+    intra = np.mean([np.linalg.norm(emb[labels == c] - m, axis=1).mean() for c, m in ((0, c0), (1, c1))])
+    assert np.linalg.norm(c0 - c1) > 1.5 * intra
