@@ -1,0 +1,30 @@
+"""Chain PCA -> LogisticRegression with Pipeline (pyspark.ml.Pipeline
+semantics), then persist and reload the fitted PipelineModel."""
+import tempfile
+
+import numpy as np
+
+from spark_rapids_ml_tpu import LogisticRegression, PCA, Pipeline
+from spark_rapids_ml_tpu.core import load
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+rng = np.random.default_rng(0)
+y = rng.integers(0, 2, 400).astype(np.float64)
+X = rng.normal(size=(400, 16)) + 2.5 * y[:, None]
+df = DataFrame.from_numpy(X, y=y, num_partitions=4)
+
+pipe = Pipeline([
+    PCA(k=6).setInputCol("features").setOutputCol("pca_features"),
+    LogisticRegression(maxIter=100).setFeaturesCol("pca_features").setLabelCol("label"),
+])
+model = pipe.fit(df)
+out = model.transform(df).toPandas()
+acc = (out["prediction"].to_numpy() == y).mean()
+print(f"pipeline train accuracy: {acc:.3f}")
+
+with tempfile.TemporaryDirectory() as td:
+    model.save(f"{td}/pm")
+    reloaded = load(f"{td}/pm")
+    out2 = reloaded.transform(df).toPandas()
+    assert (out2["prediction"].to_numpy() == out["prediction"].to_numpy()).all()
+print("save/load round trip OK")
