@@ -106,3 +106,35 @@ def test_int64_ids_survive():
         queries.astype(np.float32)
     )
     np.testing.assert_array_equal(got, ids[exp_idx])
+
+
+def test_knn_merge_branches_multi_chunk(monkeypatch):
+    # shrink the tile budget so shards scan MANY chunks, and run both merge
+    # strategies (COLLECT and RUNNING) — each must stay exact vs sklearn
+    import spark_rapids_ml_tpu.ops.knn as knn_mod
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(4100, 16)).astype(np.float32)
+    Q = rng.normal(size=(137, 16)).astype(np.float32)
+    ids = np.arange(4100, dtype=np.int64)
+    ds, isk = SkNN(n_neighbors=7).fit(X).kneighbors(Q)
+    mesh = get_mesh(8)
+
+    # tiny tile budget -> chunk=512 -> multiple chunks per shard
+    monkeypatch.setattr(knn_mod, "_TILE_BUDGET", 1)
+    d1, i1 = knn_mod.knn_search_prepared(
+        knn_mod.prepare_items(X, ids, mesh), Q, 7, mesh
+    )
+    np.testing.assert_allclose(np.sort(d1, axis=1), ds, atol=2e-3)
+    assert (np.sort(i1, axis=1) == np.sort(isk, axis=1)).all()
+
+    # force the RUNNING merge branch as well
+    monkeypatch.setattr(knn_mod, "_COLLECT_MERGE_BUDGET", 0)
+    d2, i2 = knn_mod.knn_search_prepared(
+        knn_mod.prepare_items(X, ids, mesh), Q, 7, mesh
+    )
+    np.testing.assert_allclose(np.sort(d2, axis=1), ds, atol=2e-3)
+    assert (np.sort(i2, axis=1) == np.sort(isk, axis=1)).all()
