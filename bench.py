@@ -57,6 +57,26 @@ def _timed(fn):
     return time.perf_counter() - t0
 
 
+def _device_padded_gen(mesh, rows, gen_fn, seed=42):
+    """Generate an (n_pad, D) dataset ON DEVICE, row-sharded over the mesh,
+    with a weight vector masking the pad rows.  Keeps multi-GB benchmark
+    inputs off the host link (uploads can take minutes when the link is
+    congested and are not part of the measured fit)."""
+    import jax
+    import numpy as np
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, data_sharding
+
+    n_dev = mesh.shape[DATA_AXIS]
+    n_pad = rows + (-rows) % n_dev
+    Xs = jax.jit(lambda s: gen_fn(jax.random.PRNGKey(s), n_pad),
+                 out_shardings=data_sharding(mesh))(seed)
+    w = jax.device_put(
+        np.r_[np.ones(rows, np.float32), np.zeros(n_pad - rows, np.float32)],
+        data_sharding(mesh),
+    )
+    return Xs, w
+
+
 def main() -> None:
     import jax
 
@@ -84,15 +104,21 @@ def main() -> None:
         k = int(os.environ.get("SRML_BENCH_K", 1000 if on_accel else 64))
         from spark_rapids_ml_tpu.ops.kmeans import lloyd_iterations, random_init
 
-        # unit-scale centers with unit noise: clusters overlap, so Lloyd
+        # Unit-scale centers with unit noise: clusters overlap, so Lloyd
         # genuinely uses all maxIter iterations (wider separation converges
         # exactly in ~6 iterations and would overstate throughput vs the
-        # reference's 30-iteration arm)
-        centers_true = rng.standard_normal((k, cols), dtype=np.float32)
-        assign = rng.integers(0, k, size=rows)
-        X_host = centers_true[assign] + rng.standard_normal((rows, cols), dtype=np.float32)
-        Xs, _ = shard_rows(X_host, mesh)
-        w = jax.device_put(np.ones(Xs.shape[0], dtype=np.float32), data_sharding(mesh))
+        # reference's 30-iteration arm).
+        import jax.numpy as jnp
+
+        def _gen(key, n_pad):
+            kc, ka, kn = jax.random.split(key, 3)
+            centers_true = jax.random.normal(kc, (k, cols), jnp.float32)
+            assign = jax.random.randint(ka, (n_pad,), 0, k)
+            return centers_true[assign] + jax.random.normal(
+                kn, (n_pad, cols), jnp.float32
+            )
+
+        Xs, w = _device_padded_gen(mesh, rows, _gen)
         _sync(Xs.sum())
         chunk = min(32768, Xs.shape[0])
 
@@ -110,13 +136,16 @@ def main() -> None:
         k = int(os.environ.get("SRML_BENCH_K", 3))
         from spark_rapids_ml_tpu.ops.linalg import pca_fit
 
-        X_host = (
-            rng.standard_normal((rows, 32), dtype=np.float32)
-            @ rng.standard_normal((32, cols), dtype=np.float32)
-            + 0.1 * rng.standard_normal((rows, cols), dtype=np.float32)
-        )
-        Xs, _ = shard_rows(X_host, mesh)
-        w = jax.device_put(np.ones(Xs.shape[0], dtype=np.float32), data_sharding(mesh))
+        # low-rank + noise generated on device (no 4.8 GB upload)
+        import jax.numpy as jnp
+
+        def _gen(key, n_pad):
+            ka, kb, kn = jax.random.split(key, 3)
+            A = jax.random.normal(ka, (n_pad, 32), jnp.float32)
+            B = jax.random.normal(kb, (32, cols), jnp.float32)
+            return A @ B + 0.1 * jax.random.normal(kn, (n_pad, cols), jnp.float32)
+
+        Xs, w = _device_padded_gen(mesh, rows, _gen)
         _sync(Xs.sum())
 
         def fit():
