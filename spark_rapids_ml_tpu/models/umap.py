@@ -11,7 +11,7 @@
 # the reference's y= branch at umap.py:939-947) is supported.
 # Differences by design: the kNN graph is built by the mesh-distributed
 # exact kNN kernel instead of single-GPU cuML, so fit itself scales across
-# the mesh; "spectral" init is approximated by a scaled PCA projection;
+# the mesh; "spectral" init is the Laplacian eigenmap of the fuzzy graph;
 # transform initializes at the weighted neighbor mean then runs the
 # n_epochs//3 (or 100/30) SGD refinement epochs against the frozen training
 # embedding, as cuml/umap-learn transform does.

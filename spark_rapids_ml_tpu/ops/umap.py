@@ -13,9 +13,8 @@
 #     with probability proportional to its weight (the epochs_per_sample
 #     schedule expressed as a bernoulli mask), attraction + negative-sample
 #     repulsion gradients accumulate via segment_sum scatter-adds
-#   - init: "random", or "spectral" approximated by the PCA projection of
-#     the input (documented approximation; cuml/umap-learn use a Laplacian
-#     eigenmap here)
+#   - init: "random", or "spectral" = normalized-Laplacian eigenmap of the
+#     fuzzy graph via deflated subspace iteration (as cuml/umap-learn)
 #
 
 from __future__ import annotations
