@@ -194,7 +194,7 @@ class PreparedItems:
     partition).  User ids stay on the host in full int64 precision; the
     device only sees int32 positions."""
 
-    __slots__ = ("items", "norm", "pos", "valid", "ids")
+    __slots__ = ("items", "norm", "pos", "valid", "ids", "n_items")
 
     def __init__(
         self,
@@ -203,12 +203,14 @@ class PreparedItems:
         pos: jax.Array,
         valid: jax.Array,
         ids: np.ndarray,
+        n_items: int,
     ):
         self.items = items
         self.norm = norm
         self.pos = pos
         self.valid = valid
         self.ids = ids  # (N_pad,) int64 host array, -1 in padding slots
+        self.n_items = n_items  # count of VALID (unpadded) items
 
 
 def prepare_items(
@@ -236,6 +238,7 @@ def prepare_items(
         jax.device_put(np.arange(n_pad, dtype=np.int32), sharding),
         jax.device_put(valid, sharding),
         ids_pad,
+        n_items,
     )
 
 
@@ -337,7 +340,10 @@ def knn_search_prepared(
     dtype=np.float32,
 ) -> Tuple[np.ndarray, np.ndarray]:
     q = np.asarray(queries, dtype=dtype)
-    k_eff = min(k, prepared.ids.shape[0])
+    # one output contract for ALL paths (empty-query, in-core, out-of-core):
+    # min(k, n_valid_items) columns, never (inf, -1)-padded to k — a -1 id
+    # used to index item arrays would silently wrap to the last row
+    k_eff = min(k, prepared.n_items)
     if q.shape[0] == 0:
         return (
             np.zeros((0, k_eff), dtype=dtype),
@@ -393,4 +399,4 @@ def knn_search_prepared(
             _collect()
     while pending:
         _collect()
-    return np.concatenate(out_d), np.concatenate(out_i)
+    return np.concatenate(out_d)[:, :k_eff], np.concatenate(out_i)[:, :k_eff]

@@ -78,9 +78,24 @@ def _local_moments(
     Returns (wsum, xwsum, scatter) — plus (ywsum, Xty, y2) when `y_loc` is
     given (the linear-regression sufficient statistics)."""
     n_loc, d = X_loc.shape
+    with_y = y_loc is not None
+    if n_loc == 0:
+        # empty shard (possible under uneven mesh layouts / direct callers):
+        # zero moments, no scan — min(chunk, 0) would divide by zero below
+        zeros = [
+            jnp.zeros((), X_loc.dtype),
+            jnp.zeros((d,), X_loc.dtype),
+            jnp.zeros((d, d), X_loc.dtype),
+        ]
+        if with_y:
+            zeros += [
+                jnp.zeros((), X_loc.dtype),
+                jnp.zeros((d,), X_loc.dtype),
+                jnp.zeros((), X_loc.dtype),
+            ]
+        return tuple(zeros)
     chunk = min(chunk, n_loc)
     n_chunks = -(-n_loc // chunk)
-    with_y = y_loc is not None
 
     def body(carry, i):
         start = jnp.minimum(i * chunk, n_loc - chunk)
@@ -188,6 +203,13 @@ def covariance_kernel(
     return wsum, mean, (cov + cov.T) * 0.5
 
 
+# Max acceptable relative eigenpair residual from the subspace path; a
+# converged f32 eigenpair sits around 1e-6-1e-5, an unconverged one (slow
+# spectral decay) orders of magnitude higher.  Above this, pca_fit reruns
+# through the exact dense eigh.
+SUBSPACE_RESIDUAL_TOL = 1e-3
+
+
 @partial(jax.jit, static_argnames=("k", "oversample", "n_iter", "mesh", "chunk"))
 def pca_fit_subspace_kernel(
     X: jax.Array,
@@ -197,7 +219,7 @@ def pca_fit_subspace_kernel(
     n_iter: int = 24,
     mesh=None,
     chunk: int = 32768,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Distributed PCA via covariance + blocked subspace iteration — the
     small-k fast path.
 
@@ -209,7 +231,12 @@ def pca_fit_subspace_kernel(
     Gram+Cholesky passes — MXU-only, no Householder unrolling); the final
     small (k+p, k+p) Rayleigh-Ritz eigh compiles fast.
 
-    Same return contract as pca_fit_kernel.
+    Returns the pca_fit_kernel tuple plus a trailing convergence residual:
+    max_j ||cov v_j - lambda_j v_j|| / max(lambda_1, tiny).  Subspace
+    iteration converges at rate (lambda_{k+p}/lambda_k)^n_iter, so on
+    slowly-decaying or near-isotropic spectra the fixed iteration count can
+    leave eigenpairs inaccurate; callers (pca_fit) check the residual and
+    fall back to the exact eigh path when it exceeds tolerance.
     """
     d = X.shape[1]
     p = min(d - k, oversample)
@@ -237,16 +264,24 @@ def pca_fit_subspace_kernel(
 
     Q = jax.lax.fori_loop(0, n_iter, body, chol_qr2(Q0))
     # Rayleigh-Ritz on the converged subspace
-    B = exact_matmul(Q.T, exact_matmul(cov, Q))
+    CQ = exact_matmul(cov, Q)
+    B = exact_matmul(Q.T, CQ)
     B = (B + B.T) * 0.5
     evals_s, evecs_s = jnp.linalg.eigh(B)  # ascending, (k+p, k+p): tiny
     evals = evals_s[::-1][:k]
-    V = exact_matmul(Q, evecs_s[:, ::-1][:, :k])
+    evecs_top = evecs_s[:, ::-1][:, :k]
+    V = exact_matmul(Q, evecs_top)
+    # eigenpair residual relative to the spectral-norm estimate lambda_1,
+    # reusing CQ: cov @ V == (cov @ Q) @ evecs_top, so no second (D, D)
+    # contraction is paid
+    R = exact_matmul(CQ, evecs_top) - V * evals[None, :]
+    scale = jnp.maximum(jnp.abs(evals[0]), jnp.finfo(evals.dtype).tiny)
+    residual = jnp.sqrt((R * R).sum(axis=0)).max() / scale
     components = sign_flip(V.T)
     total_var = jnp.maximum(total_var, jnp.finfo(evals.dtype).tiny)
     ratio = evals / total_var
     singular_values = jnp.sqrt(jnp.maximum(evals, 0.0) * (wsum - 1.0))
-    return mean, components, evals, ratio, singular_values
+    return mean, components, evals, ratio, singular_values, residual
 
 
 # On CPU backends, above this column count the dense eigh leaves the jitted
@@ -296,9 +331,17 @@ def pca_fit(
         # Small-k wide-D fits on accelerators use subspace iteration: the
         # QDWH eigh's COMPILE time at large D (~8 min at D=3000 on v5e) is
         # the whole cost of the dense path, while runtime is sub-second for
-        # both.  Large k or modest D keep the dense eigh.
+        # both.  Large k or modest D keep the dense eigh.  The kernel's
+        # eigenpair residual guards accuracy: convergence depends on the
+        # eigengap ratio (lambda_{k+p}/lambda_k)^n_iter, so near-isotropic
+        # spectra can defeat the fixed iteration count — those fits pay the
+        # exact-eigh compile instead of returning silently-wrong components.
         if not _is_cpu_backend(X) and k <= 32 and d >= 768:
-            return tuple(jax.device_get(pca_fit_subspace_kernel(X, w, k, mesh=mesh)))  # type: ignore[return-value]
+            *out, residual = jax.device_get(
+                pca_fit_subspace_kernel(X, w, k, mesh=mesh)
+            )
+            if float(residual) <= SUBSPACE_RESIDUAL_TOL:
+                return tuple(out)  # type: ignore[return-value]
         # one batched device_get: five sequential np.asarray fetches each pay
         # the device-link round-trip latency
         return tuple(jax.device_get(pca_fit_kernel(X, w, k, mesh=mesh)))  # type: ignore[return-value]

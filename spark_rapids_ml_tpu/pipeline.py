@@ -25,9 +25,33 @@ _PIPELINE_META = "metadata.json"
 
 
 def _is_estimator(stage: Any) -> bool:
-    return isinstance(stage, _TpuEstimator) or (
-        hasattr(stage, "fit") and not hasattr(stage, "transform")
-    )
+    # Spark's Pipeline keys on isinstance(Estimator)/isinstance(Transformer),
+    # not on duck typing — our own types classify exactly.  A third-party
+    # stage exposing BOTH fit and transform (sklearn style) is genuinely
+    # ambiguous: treating it as a transformer silently skips training, while
+    # treating it as an estimator silently refits an already-fitted object.
+    # Either silent choice corrupts someone's pipeline, so ambiguous stages
+    # fail loudly unless the user declares the role via `srml_stage_role`.
+    if isinstance(stage, _TpuEstimator):
+        return True
+    has_fit, has_transform = hasattr(stage, "fit"), hasattr(stage, "transform")
+    if has_fit and has_transform:
+        role = getattr(stage, "srml_stage_role", None)
+        if role in ("estimator", "transformer"):
+            return role == "estimator"
+        if role is not None:
+            raise TypeError(
+                f"Pipeline stage {type(stage).__name__!r} has unrecognized "
+                f"srml_stage_role {role!r}; expected 'estimator' or "
+                "'transformer'."
+            )
+        raise TypeError(
+            f"Ambiguous pipeline stage {type(stage).__name__!r}: it defines "
+            "both fit and transform but is not a framework estimator. Set "
+            "stage.srml_stage_role = 'estimator' (fit it here) or "
+            "'transformer' (apply as-is) to disambiguate."
+        )
+    return has_fit
 
 
 class Pipeline:

@@ -131,6 +131,7 @@ def test_pca_subspace_kernel_matches_eigh():
     import numpy as np
 
     from spark_rapids_ml_tpu.ops.linalg import (
+        SUBSPACE_RESIDUAL_TOL,
         pca_fit_kernel,
         pca_fit_subspace_kernel,
     )
@@ -148,10 +149,33 @@ def test_pca_subspace_kernel_matches_eigh():
     w = jax.device_put(np.ones(Xs.shape[0], np.float32), data_sharding(mesh))
     k = 3
     m1, c1, v1, r1, s1 = [np.asarray(o) for o in pca_fit_kernel(Xs, w, k)]
-    m2, c2, v2, r2, s2 = [np.asarray(o) for o in pca_fit_subspace_kernel(Xs, w, k)]
+    m2, c2, v2, r2, s2, resid = [
+        np.asarray(o) for o in pca_fit_subspace_kernel(Xs, w, k)
+    ]
+    assert float(resid) < SUBSPACE_RESIDUAL_TOL  # converged on this spectrum
     np.testing.assert_allclose(m1, m2, atol=1e-4)
     np.testing.assert_allclose(v1, v2, rtol=1e-3)
     np.testing.assert_allclose(r1, r2, rtol=1e-3)
     np.testing.assert_allclose(s1, s2, rtol=1e-3)
     # components up to sign already fixed by sign_flip -> direct compare
     np.testing.assert_allclose(c1, c2, atol=5e-3)
+
+
+def test_pca_subspace_residual_flags_nonconvergence():
+    # near-isotropic spectrum + crippled iteration count: the kernel must
+    # REPORT non-convergence via its residual output (pca_fit falls back to
+    # the exact eigh path on accelerators when it does)
+    import jax
+    import numpy as np
+
+    from spark_rapids_ml_tpu.ops.linalg import (
+        SUBSPACE_RESIDUAL_TOL,
+        pca_fit_subspace_kernel,
+    )
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((2048, 96)).astype(np.float32)  # iid: flat spectrum
+    w = jax.device_put(np.ones(2048, np.float32))
+    out = pca_fit_subspace_kernel(jax.device_put(X), w, 3, n_iter=1)
+    resid = float(np.asarray(out[-1]))
+    assert resid > SUBSPACE_RESIDUAL_TOL

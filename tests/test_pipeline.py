@@ -66,3 +66,49 @@ def test_pipeline_persistence(tmp_path):
     np.testing.assert_array_equal(
         o1["prediction"].to_numpy(), o2["prediction"].to_numpy()
     )
+
+
+def test_pipeline_ambiguous_stage_fails_loudly_and_role_disambiguates():
+    # a third-party stage exposing BOTH fit and transform (sklearn style) is
+    # ambiguous: fitting it may clobber a pre-trained object, passing it
+    # through may skip training.  Either silent choice is wrong for someone,
+    # so the pipeline must raise — and honor an explicit srml_stage_role.
+    import pytest
+
+    _, _, df = _cls_df(n=40)
+
+    class SklearnStyle:
+        def __init__(self):
+            self.fitted = False
+            self.fit_calls = 0
+
+        def fit(self, dataset):
+            self.fitted = True
+            self.fit_calls += 1
+            return self
+
+        def transform(self, dataset):
+            assert self.fitted, "transform before fit"
+            return dataset
+
+    with pytest.raises(TypeError, match="Ambiguous pipeline stage"):
+        Pipeline([SklearnStyle(), KMeans(k=2, maxIter=5, seed=1)]).fit(df)
+
+    bad = SklearnStyle()
+    bad.srml_stage_role = "Transformer"  # wrong case: must be named, not hidden
+    with pytest.raises(TypeError, match="unrecognized srml_stage_role"):
+        Pipeline([bad, KMeans(k=2, maxIter=5, seed=1)]).fit(df)
+
+    # declared estimator: gets fit, then feeds the next stage
+    est_stage = SklearnStyle()
+    est_stage.srml_stage_role = "estimator"
+    pm = Pipeline([est_stage, KMeans(k=2, maxIter=5, seed=1)]).fit(df)
+    assert est_stage.fitted
+    assert "prediction" in pm.transform(df).toPandas().columns
+
+    # declared transformer: applied as-is, never refit
+    tr_stage = SklearnStyle()
+    tr_stage.fitted = True  # pre-trained elsewhere
+    tr_stage.srml_stage_role = "transformer"
+    Pipeline([tr_stage, KMeans(k=2, maxIter=5, seed=1)]).fit(df)
+    assert tr_stage.fit_calls == 0
