@@ -43,6 +43,12 @@ from .parallel.partition import PartitionDescriptor
 from .utils import get_logger, stack_feature_cells
 
 
+def _is_pyspark_dataframe(dataset: Any) -> bool:
+    """True for live pyspark DataFrames, detected by module name so pyspark
+    is never imported here (it is absent on plain TPU-VM installs)."""
+    return (type(dataset).__module__ or "").startswith("pyspark.sql")
+
+
 # single-slot device-input cache; see _TpuCaller._build_fit_inputs
 _FIT_INPUT_CACHE: Dict[str, Any] = {}
 
@@ -255,7 +261,31 @@ class _TpuCaller(_TpuParams):
     ) -> Union[Dict[str, Any], List[Dict[str, Any]]]:
         """Dispatch one (or a batch of) fits on the device mesh (reference
         _call_cuml_fit_func core.py:488-640, single data load for all param
-        maps as in _fit_internal core.py:723-752)."""
+        maps as in _fit_internal core.py:723-752).
+
+        A live pyspark DataFrame routes through the Spark barrier stage so
+        training happens INSIDE the executors over a pod-wide jax.distributed
+        mesh — the dataset is never collected to the driver.  Set
+        SRML_SPARK_COLLECT=1 to force the old driver-local collect path
+        (single TPU-VM notebooks where the driver owns the chips)."""
+        if _is_pyspark_dataframe(dataset) and os.environ.get(
+            "SRML_SPARK_COLLECT", "0"
+        ) != "1":
+            from .spark.adapter import barrier_fit_estimator
+
+            # driver-side input-column check BEFORE launching the barrier
+            # stage (pyspark DataFrames expose .columns, which is all
+            # _validate_parameters reads) — a missing column must fail here,
+            # not as an opaque executor traceback
+            self._validate_parameters(dataset)
+            extra = (
+                [self._paramMap_to_tpu_overrides(pm) for pm in paramMaps]
+                if paramMaps is not None
+                else None
+            )
+            results = barrier_fit_estimator(self, dataset, extra_params=extra)
+            self._last_fit_phase_times = {}
+            return results if paramMaps is not None else results[0]
         from . import profiling
 
         profiling.reset_phase_times()

@@ -79,21 +79,21 @@ def _local_moments(
     given (the linear-regression sufficient statistics)."""
     n_loc, d = X_loc.shape
     with_y = y_loc is not None
+    init = [
+        jnp.zeros((), X_loc.dtype),
+        jnp.zeros((d,), X_loc.dtype),
+        jnp.zeros((d, d), X_loc.dtype),
+    ]
+    if with_y:
+        init += [
+            jnp.zeros((), X_loc.dtype),
+            jnp.zeros((d,), X_loc.dtype),
+            jnp.zeros((), X_loc.dtype),
+        ]
     if n_loc == 0:
         # empty shard (possible under uneven mesh layouts / direct callers):
         # zero moments, no scan — min(chunk, 0) would divide by zero below
-        zeros = [
-            jnp.zeros((), X_loc.dtype),
-            jnp.zeros((d,), X_loc.dtype),
-            jnp.zeros((d, d), X_loc.dtype),
-        ]
-        if with_y:
-            zeros += [
-                jnp.zeros((), X_loc.dtype),
-                jnp.zeros((d,), X_loc.dtype),
-                jnp.zeros((), X_loc.dtype),
-            ]
-        return tuple(zeros)
+        return tuple(init)
     chunk = min(chunk, n_loc)
     n_chunks = -(-n_loc // chunk)
 
@@ -118,17 +118,6 @@ def _local_moments(
             ]
         return tuple(out), None
 
-    init = [
-        jnp.zeros((), X_loc.dtype),
-        jnp.zeros((d,), X_loc.dtype),
-        jnp.zeros((d, d), X_loc.dtype),
-    ]
-    if with_y:
-        init += [
-            jnp.zeros((), X_loc.dtype),
-            jnp.zeros((d,), X_loc.dtype),
-            jnp.zeros((), X_loc.dtype),
-        ]
     out, _ = jax.lax.scan(
         body, tuple(init), jnp.arange(n_chunks, dtype=jnp.int32)
     )

@@ -4,15 +4,16 @@
 # Behavioral analog of the reference's PartitionDescriptor
 # (/root/reference/python/src/spark_rapids_ml/utils.py:133-196), which
 # allGathers per-rank partition sizes over the Spark barrier control plane.
-# In the TPU build the "ranks" are mesh shards; sizes are known locally in
-# single-controller mode and allGathered over the runner's control plane in
-# multi-controller mode (see runtime/spark adapter).
+# Single-controller fits build it locally (one rank owns every partition);
+# multi-controller fits use `gather`, which exchanges sizes over the
+# runner's control plane exactly like the reference.
 #
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
-from typing import List
+from typing import Any, List
 
 
 @dataclass
@@ -24,10 +25,67 @@ class PartitionDescriptor:
     n: int
     rank: int
     parts_rank_size: List[tuple] = field(default_factory=list)
+    # per-rank extra payloads gathered alongside the sizes (rank order);
+    # empty when built single-controller
+    extras: List[dict] = field(default_factory=list)
 
     @classmethod
     def build(cls, partition_rows: List[int], total_cols: int, rank: int = 0) -> "PartitionDescriptor":
+        """Single-controller constructor: partitions map 1:1 to mesh shards,
+        so each is tagged with its own index (no control plane needed)."""
         parts = [(r, size) for r, size in enumerate(partition_rows)]
         return cls(
             m=sum(partition_rows), n=total_cols, rank=rank, parts_rank_size=parts
         )
+
+    @classmethod
+    def gather(
+        cls,
+        partition_rows: List[int],
+        n_cols: int,
+        rank: int,
+        nranks: int,
+        control_plane: Any,
+        extra: dict = None,
+    ) -> "PartitionDescriptor":
+        """Multi-controller constructor: allGather every rank's partition
+        sizes (and column count) over the control plane, mirroring the
+        reference's PartitionDescriptor.build allGather (utils.py:178-196).
+
+        A rank with no data reports n_cols=0; the global column count is the
+        consensus of data-bearing ranks (disagreement raises).  `extra` is an
+        optional JSON-safe dict gathered alongside and exposed per rank via
+        `.extras` (the reference piggybacks extra metadata on the same
+        allGather, e.g. knn.py:526-537)."""
+        msg = json.dumps(
+            {
+                "rank": rank,
+                "rows": partition_rows,
+                "n_cols": n_cols,
+                "extra": extra or {},
+            }
+        )
+        gathered = sorted(
+            (json.loads(m) for m in control_plane.allGather(msg)),
+            key=lambda g: g["rank"],
+        )
+        if [g["rank"] for g in gathered] != list(range(nranks)):
+            raise RuntimeError(
+                f"partition allGather returned ranks "
+                f"{[g['rank'] for g in gathered]}, expected 0..{nranks - 1}"
+            )
+        widths = {g["n_cols"] for g in gathered if g["n_cols"] > 0}
+        if len(widths) > 1:
+            raise ValueError(f"ranks disagree on feature width: {sorted(widths)}")
+        parts = [(g["rank"], size) for g in gathered for size in g["rows"]]
+        return cls(
+            m=sum(s for _, s in parts),
+            n=widths.pop() if widths else 0,
+            rank=rank,
+            parts_rank_size=parts,
+            extras=[g.get("extra", {}) for g in gathered],
+        )
+
+    def rank_rows(self, rank: int) -> int:
+        """Total rows held by `rank` across its partitions."""
+        return sum(s for r, s in self.parts_rank_size if r == rank)

@@ -1,0 +1,304 @@
+#
+# Launcher-agnostic multi-controller fit execution.
+#
+# This is the executor-side half of the reference's barrier fit
+# (/root/reference/python/src/spark_rapids_ml/core.py:488-640): one process
+# per Spark barrier task (= TPU-VM worker), each holding its own row
+# partitions, cooperating through a small string control plane
+# (BarrierTaskContext.allGather on Spark; FileControlPlane for plain process
+# launchers and tests).  The flow per rank:
+#
+#   1. TpuContext bootstraps jax.distributed (coordinator address allGathered
+#      like the reference's NCCL uid, cuml_context.py:75-103)
+#   2. a GLOBAL 1-D mesh is built over every device in the pod, ordered
+#      process-major so rank r's rows land on rank r's chips
+#   3. per-rank partition sizes are allGathered into a PartitionDescriptor
+#      (reference utils.py:159-196) to size the global padded array
+#   4. each rank's local rows become its process-local shards of one global
+#      row-sharded jax.Array (jax.make_array_from_process_local_data), padded
+#      rows masked through the weight vector
+#   5. the SAME pure-jax fit function used single-controller runs on every
+#      rank; GSPMD collectives ride ICI within a host and DCN across ranks
+#   6. results are replicated; every rank materializes them, rank 0's are
+#      yielded to the driver (JSON-safe encoded)
+#
+# Unlike the reference there is no second code path for the distributed
+# case — the solvers cannot tell a pod mesh from a single-host mesh.
+#
+
+from __future__ import annotations
+
+import base64
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .context import ControlPlane, LocalControlPlane, TpuContext
+from .mesh import DATA_AXIS
+from .partition import PartitionDescriptor
+
+
+class FileControlPlane:
+    """Control plane over a shared filesystem: allGather by atomic per-rank
+    message files in numbered rounds, barrier as an empty gather.
+
+    Stands in for Spark's BarrierTaskContext wherever there is no Spark —
+    subprocess launchers, mpirun-style deployments with a shared FS, and the
+    multi-controller tests.  Rendezvous root must be empty per job."""
+
+    def __init__(self, root: str, rank: int, nranks: int,
+                 timeout: float = 300.0, poll: float = 0.02):
+        self._root = root
+        self._rank = rank
+        self._nranks = nranks
+        self._round = 0
+        self._timeout = timeout
+        self._poll = poll
+        os.makedirs(root, exist_ok=True)
+
+    def allGather(self, message: str) -> List[str]:
+        r = self._round
+        self._round += 1
+        path = os.path.join(self._root, f"round{r:05d}_rank{self._rank:05d}.msg")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(message)
+        os.replace(tmp, path)  # atomic publish
+        expected = [
+            os.path.join(self._root, f"round{r:05d}_rank{i:05d}.msg")
+            for i in range(self._nranks)
+        ]
+        deadline = time.monotonic() + self._timeout
+        while not all(os.path.exists(p) for p in expected):
+            if time.monotonic() > deadline:
+                missing = [i for i, p in enumerate(expected) if not os.path.exists(p)]
+                raise TimeoutError(
+                    f"FileControlPlane round {r}: ranks {missing} never posted "
+                    f"within {self._timeout}s"
+                )
+            time.sleep(self._poll)
+        out = []
+        for p in expected:
+            with open(p) as f:
+                out.append(f.read())
+        return out
+
+    def barrier(self) -> None:
+        self.allGather("")
+
+
+def global_mesh() -> Mesh:
+    """1-D data mesh over EVERY device in the (possibly multi-process)
+    runtime, ordered process-major so the row sharding assigns rank r's
+    contiguous global row block to rank r's local devices."""
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+# -- JSON-safe model-attribute transport -------------------------------------
+# The driver gets model attributes back through Spark rows (strings), so
+# arrays ride as base64 raw bytes + dtype/shape (the reference ships cuML
+# attrs as JSON text rows the same way, core.py:625-630).
+
+def _encode_value(v: Any) -> Any:
+    if isinstance(v, jax.Array):
+        v = np.asarray(v)
+    if isinstance(v, np.ndarray):
+        return {
+            "__ndarray__": base64.b64encode(
+                np.ascontiguousarray(v).tobytes()
+            ).decode("ascii"),
+            "dtype": str(v.dtype),
+            "shape": list(v.shape),
+        }
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, dict):
+        return {k: _encode_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_encode_value(x) for x in v]
+    return v
+
+
+def _decode_value(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "__ndarray__" in v:
+            return (
+                np.frombuffer(
+                    base64.b64decode(v["__ndarray__"]), dtype=np.dtype(v["dtype"])
+                )
+                .reshape(v["shape"])
+                .copy()
+            )
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    return v
+
+
+def encode_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: _encode_value(v) for k, v in attrs.items()}
+
+
+def decode_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: _decode_value(v) for k, v in attrs.items()}
+
+
+# -- the distributed fit session ---------------------------------------------
+
+class DistributedFitSession:
+    """One jax.distributed lifetime; fits any number of estimators over the
+    pod-wide mesh (the per-fit NCCL create/destroy of the reference,
+    cuml_context.py:109-166, generalized so callers can amortize the
+    bootstrap across fits)."""
+
+    def __init__(self, rank: int, nranks: int, control_plane: ControlPlane):
+        self.rank = rank
+        self.nranks = nranks
+        self.control_plane = control_plane
+        self.mesh = global_mesh()
+
+    # FitInputs construction (executor-side analog of
+    # _TpuCaller._build_fit_inputs, which is single-controller)
+    def build_fit_inputs(self, estimator: Any, df: Any) -> Any:
+        from ..core import FitInputs
+
+        # A rank can legitimately hold ZERO rows (fewer rows than barrier
+        # tasks, skewed repartition).  It must still join every gather —
+        # bailing out locally would hang the other ranks — so it reports
+        # empty sizes and takes its dtype from the data-bearing ranks.
+        rank_has_rows = any(len(p) > 0 for p in df.partitions)
+        if rank_has_rows:
+            feats, labels, weights, dtype = estimator._pre_process_data(df)
+        else:
+            feats, weights, dtype = [], None, None
+            labels = [] if estimator._fit_label_col() is not None else None
+        partition_rows = [f.shape[0] for f in feats]
+        nonempty = [f for f in feats if f.shape[0] > 0]
+        n_loc = sum(partition_rows)
+        n_cols_loc = nonempty[0].shape[1] if nonempty else 0
+        pdesc = PartitionDescriptor.gather(
+            partition_rows, n_cols_loc, self.rank, self.nranks,
+            self.control_plane,
+            extra={"dtype": str(dtype) if dtype is not None else ""},
+        )
+        if pdesc.m == 0:
+            raise RuntimeError("Dataset is empty; cannot fit")
+        n_cols = pdesc.n
+        dtypes = {e["dtype"] for e in pdesc.extras if e.get("dtype")}
+        if len(dtypes) > 1:
+            raise ValueError(f"ranks disagree on input dtype: {sorted(dtypes)}")
+        if dtype is None:
+            dtype = np.dtype(dtypes.pop())
+
+        n_total_dev = self.mesh.devices.size
+        if n_total_dev % self.nranks != 0:
+            raise RuntimeError(
+                f"{n_total_dev} devices do not divide evenly over "
+                f"{self.nranks} ranks"
+            )
+        local_dev = n_total_dev // self.nranks
+        # every rank contributes the same padded share so the global array is
+        # evenly row-sharded; the share covers the LARGEST rank (unbalanced
+        # partitions cost padding, not correctness — Spark's repartition
+        # keeps them near-equal anyway)
+        max_rank_rows = max(pdesc.rank_rows(r) for r in range(self.nranks))
+        share = -(-max_rank_rows // local_dev) * local_dev
+        n_pad = share * self.nranks
+
+        def _to_global(local_cols: int, fill: Optional[np.ndarray], is_2d: bool):
+            shape = (share, local_cols) if is_2d else (share,)
+            buf = np.zeros(shape, dtype=dtype)
+            if fill is not None and fill.shape[0]:
+                buf[: fill.shape[0]] = fill
+            gshape = (n_pad, local_cols) if is_2d else (n_pad,)
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P(DATA_AXIS)), buf, global_shape=gshape
+            )
+
+        X_loc = (
+            np.concatenate(nonempty, axis=0)
+            if nonempty
+            else np.zeros((0, n_cols), dtype=dtype)
+        )
+        if X_loc.shape[0] and X_loc.shape[1] != n_cols:
+            raise ValueError(
+                f"rank {self.rank} has {X_loc.shape[1]} feature columns, "
+                f"other ranks have {n_cols}"
+            )
+        Xs = _to_global(n_cols, X_loc if X_loc.shape[0] else None, is_2d=True)
+
+        w_loc = (
+            np.concatenate(weights)
+            if weights  # None or [] (empty rank) -> valid-row ones mask
+            else np.ones(n_loc, dtype=dtype)
+        )
+        ws = _to_global(0, w_loc, is_2d=False)
+
+        ys = None
+        if labels is not None:
+            y_loc = (
+                np.concatenate(labels) if labels else np.zeros(0, dtype=dtype)
+            )
+            ys = _to_global(0, y_loc, is_2d=False)
+
+        return FitInputs(
+            X=Xs,
+            weight=ws,
+            y=ys,
+            n_rows=pdesc.m,
+            n_cols=n_cols,
+            mesh=self.mesh,
+            pdesc=pdesc,
+            dtype=dtype,
+        )
+
+    def fit(
+        self,
+        estimator: Any,
+        partitions: Sequence[pd.DataFrame],
+        extra_params: Optional[List[Dict[str, Any]]] = None,
+    ) -> List[Dict[str, Any]]:
+        """Run the estimator's fit function over the pod mesh; returns the
+        JSON-safe encoded model-attribute dict(s) (one per param map)."""
+        from ..dataframe import DataFrame
+
+        df = DataFrame(list(partitions))
+        inputs = self.build_fit_inputs(estimator, df)
+        fit_func = estimator._get_tpu_fit_func(df, extra_params)
+        result = fit_func(inputs, dict(estimator._tpu_params))
+        self.control_plane.barrier()
+        results = result if isinstance(result, list) else [result]
+        return [encode_attrs(r) for r in results]
+
+
+@contextlib.contextmanager
+def distributed_session(
+    rank: int, nranks: int, control_plane: Optional[ControlPlane] = None
+) -> Iterator[DistributedFitSession]:
+    cp = control_plane or LocalControlPlane()
+    with TpuContext(rank, nranks, cp):
+        yield DistributedFitSession(rank, nranks, cp)
+
+
+def run_distributed_fit(
+    estimator: Any,
+    partitions: Sequence[pd.DataFrame],
+    rank: int,
+    nranks: int,
+    control_plane: Optional[ControlPlane] = None,
+    extra_params: Optional[List[Dict[str, Any]]] = None,
+) -> List[Dict[str, Any]]:
+    """One-shot: bootstrap the distributed runtime, fit, tear down.  This is
+    what the Spark barrier UDF calls per task (spark/adapter.run_barrier_fit);
+    the reference equivalent is the body of _train_udf at core.py:558-632."""
+    with distributed_session(rank, nranks, control_plane) as session:
+        return session.fit(estimator, partitions, extra_params)

@@ -117,9 +117,10 @@ def run_barrier_fit(
     """Dispatch `fit_closure` over a Spark barrier stage, one task per TPU-VM
     worker process.
 
-    fit_closure(partitions, rank, nranks, control_plane) runs on the executor;
-    rank 0 returns the model-attribute rows.  Mirrors the dispatch shape of
-    the reference's _call_cuml_fit_func (core.py:488-640) with jax.distributed
+    fit_closure(partitions, rank, nranks, control_plane) runs on the executor
+    and returns JSON-safe encoded attribute dicts (parallel/runner encoding);
+    rank 0's are collected to the driver.  Mirrors the dispatch shape of the
+    reference's _call_cuml_fit_func (core.py:488-640) with jax.distributed
     replacing NCCL.
     """
     import json
@@ -127,7 +128,6 @@ def run_barrier_fit(
     from pyspark import BarrierTaskContext
 
     sdf = sdf.repartition(num_workers)
-    fields = sdf.schema.fieldNames()
 
     def _train_udf(iterator):
         ctx = BarrierTaskContext.get()
@@ -148,3 +148,64 @@ def run_barrier_fit(
     rdd = try_stage_level_scheduling(rdd, sdf.sparkSession)
     rows = rdd.collect()
     return [json.loads(r["model_attributes"]) for r in rows]
+
+
+NUM_WORKERS_CONF = "spark.rapids.ml.tpu.numWorkers"
+
+
+def infer_spark_num_workers(estimator: Any, spark: Any) -> int:
+    """Number of barrier tasks (= TPU-VM worker processes = jax.distributed
+    ranks) for a cluster fit.  This is the reference's num_workers semantics
+    — one task per accelerator worker (params.py:353-385) — NOT the
+    single-controller device count: a barrier stage with one task per mesh
+    device would have several processes fighting over the same chips.
+
+    Resolution order: explicit estimator num_workers (the user's statement
+    of how many TPU-VM workers the cluster has) > our own conf
+    spark.rapids.ml.tpu.numWorkers > spark.executor.instances (one TPU-VM
+    worker per executor) > 1 (single worker, with a log note)."""
+    if estimator._num_workers is not None:
+        return int(estimator._num_workers)
+    conf_get = spark.sparkContext.getConf().get
+    own = conf_get(NUM_WORKERS_CONF)
+    if own is not None:
+        return int(own)
+    instances = conf_get("spark.executor.instances")
+    if instances is not None and int(instances) > 0:
+        return int(instances)
+    from ..utils import get_logger
+
+    get_logger(infer_spark_num_workers).info(
+        "cannot infer cluster worker count (set num_workers or %s); "
+        "training with a single barrier task",
+        NUM_WORKERS_CONF,
+    )
+    return 1
+
+
+def barrier_fit_estimator(
+    estimator: Any,
+    sdf: Any,
+    extra_params: Any = None,
+) -> List[Dict[str, Any]]:
+    """fit() entry for a live pyspark DataFrame: train *inside the executors*
+    under a barrier stage (never collecting the dataset to the driver), one
+    rank per TPU-VM worker, jax.distributed spanning the pod.  Returns
+    DECODED model-attribute dicts ready for _create_model.
+
+    This is what makes the framework a distributed product the way the
+    reference is (core.py:488-640 + cuml_context.py:75-147): the estimator
+    object rides Spark's closure serialization to the tasks, and each task
+    runs parallel/runner.run_distributed_fit over its partitions."""
+    from ..parallel import runner
+
+    num_workers = infer_spark_num_workers(estimator, sdf.sparkSession)
+
+    def _closure(partitions, rank, nranks, control_plane):
+        return runner.run_distributed_fit(
+            estimator, partitions, rank, nranks, control_plane,
+            extra_params=extra_params,
+        )
+
+    rows = run_barrier_fit(sdf, num_workers, _closure)
+    return [runner.decode_attrs(r) for r in rows]

@@ -1,0 +1,58 @@
+#
+# Barrier-task stand-in for the multi-controller tests: one OS process per
+# rank (what a Spark barrier task would be on a real cluster,
+# reference core.py:558-640), rendezvous over a FileControlPlane directory,
+# data shard + estimators staged on disk by the test driver.
+#
+# Invoked as: python mc_worker.py <rank> <nranks> <jobdir>
+# with env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N
+#
+import json
+import os
+import sys
+
+
+def main() -> None:
+    rank, nranks, root = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import numpy as np
+    import pandas as pd
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from spark_rapids_ml_tpu.core import load
+    from spark_rapids_ml_tpu.parallel.runner import (
+        FileControlPlane,
+        distributed_session,
+    )
+
+    shard = np.load(os.path.join(root, f"shard_{rank}.npz"))
+    part = pd.DataFrame({"features": list(shard["X"])})
+    if "y" in shard.files:
+        part["label"] = shard["y"]
+
+    with open(os.path.join(root, "estimators.json")) as f:
+        names = json.load(f)
+
+    cp = FileControlPlane(os.path.join(root, "cp"), rank, nranks)
+    out = {}
+    # one jax.distributed lifetime for every fit (the session amortizes the
+    # bootstrap; each fit still barriers like the reference's per-fit NCCL)
+    with distributed_session(rank, nranks, cp) as session:
+        import jax
+
+        meta = {
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "process_index": jax.process_index(),
+        }
+        for name in names:
+            est = load(os.path.join(root, f"est_{name}"))
+            out[name] = session.fit(est, [part])
+
+    if rank == 0:
+        with open(os.path.join(root, "attrs.json"), "w") as f:
+            json.dump({"meta": meta, "results": out}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,220 @@
+#
+# fit(pyspark_df) must train through the Spark barrier path — NOT collect to
+# the driver (VERDICT round 1, item 1).  pyspark is not installable on this
+# image (no network; see NOTES.md), so the pyspark surfaces run_barrier_fit
+# actually touches (repartition/mapInPandas/rdd.barrier/collect,
+# BarrierTaskContext) are mocked faithfully in-process with ONE barrier task;
+# the real multi-process jax.distributed execution underneath is covered by
+# test_multicontroller.py with OS-process workers.
+#
+import sys
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu import KMeans, LinearRegression
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+
+class _FakeBarrierTaskContext:
+    _current = None
+
+    def __init__(self, rank: int):
+        self._rank = rank
+
+    @classmethod
+    def get(cls):
+        return cls._current
+
+    def partitionId(self):
+        return self._rank
+
+    def allGather(self, message):
+        return [message]
+
+    def barrier(self):
+        return None
+
+
+class _FakeRdd:
+    def __init__(self, partitions, udf=None):
+        self._partitions = partitions
+        self._udf = udf
+
+    def getNumPartitions(self):
+        return len(self._partitions)
+
+    def barrier(self):
+        return self
+
+    def mapPartitions(self, f):
+        return self
+
+    def withResources(self, profile):
+        return self
+
+    def collect(self):
+        rows = []
+        for rank, part in enumerate(self._partitions):
+            _FakeBarrierTaskContext._current = _FakeBarrierTaskContext(rank)
+            try:
+                for out in self._udf(iter([part])):
+                    for _, r in out.iterrows():
+                        rows.append({"model_attributes": r["model_attributes"]})
+            finally:
+                _FakeBarrierTaskContext._current = None
+        return rows
+
+
+class _FakeConf:
+    def get(self, key, default=None):
+        return {"spark.master": "local[1]"}.get(key, default)
+
+
+class _FakeSparkSession:
+    version = "3.5.0"
+
+    def __init__(self):
+        self.sparkContext = types.SimpleNamespace(getConf=lambda: _FakeConf())
+
+
+class _FakeSparkDataFrame:
+    """Just enough of pyspark.sql.DataFrame for run_barrier_fit; the class
+    advertises the pyspark module path so core._is_pyspark_dataframe routes
+    it to the barrier dispatcher."""
+
+    def __init__(self, partitions, udf=None):
+        self._partitions = partitions
+        self._udf = udf
+        self.sparkSession = _FakeSparkSession()
+
+    def repartition(self, n):
+        if n == len(self._partitions):
+            return self
+        whole = pd.concat(self._partitions, ignore_index=True)
+        idx = np.array_split(np.arange(len(whole)), n)
+        return _FakeSparkDataFrame(
+            [whole.iloc[ix].reset_index(drop=True) for ix in idx]
+        )
+
+    def mapInPandas(self, udf, schema=None):
+        return _FakeSparkDataFrame(self._partitions, udf=udf)
+
+    @property
+    def rdd(self):
+        return _FakeRdd(self._partitions, self._udf)
+
+    @property
+    def columns(self):
+        return list(self._partitions[0].columns)
+
+
+_FakeSparkDataFrame.__module__ = "pyspark.sql.dataframe"
+
+
+@pytest.fixture()
+def fake_pyspark(monkeypatch):
+    mod = types.ModuleType("pyspark")
+    mod.BarrierTaskContext = _FakeBarrierTaskContext
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+    monkeypatch.delenv("SRML_SPARK_COLLECT", raising=False)
+
+
+def _data():
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((600, 6)).astype(np.float32)
+    X[:300] += 4.0
+    y = (X @ rng.standard_normal(6).astype(np.float32)).astype(np.float32)
+    return X, y
+
+
+def _fake_sdf(X, y=None):
+    pdf = pd.DataFrame({"features": list(X)})
+    if y is not None:
+        pdf["label"] = y
+    return _FakeSparkDataFrame([pdf])
+
+
+def test_kmeans_fit_routes_through_barrier(fake_pyspark):
+    X, _ = _data()
+    model = KMeans(k=2, maxIter=15, seed=5).fit(_fake_sdf(X))
+    baseline = KMeans(k=2, maxIter=15, seed=5).fit(DataFrame.from_numpy(X))
+    np.testing.assert_allclose(
+        np.asarray(model.cluster_centers_),
+        np.asarray(baseline.cluster_centers_),
+        rtol=1e-5, atol=1e-5,
+    )
+    # and the returned model is a full working model
+    preds = model.transform(DataFrame.from_numpy(X)).toPandas()["prediction"]
+    assert set(np.unique(preds)) == {0, 1}
+
+
+def test_linreg_fit_multiple_single_pass_over_barrier(fake_pyspark):
+    X, y = _data()
+    est = LinearRegression(maxIter=50)
+    pm = [
+        {est.getParam("regParam"): 0.0},
+        {est.getParam("regParam"): 0.5},
+    ]
+    models = est.fit(_fake_sdf(X, y), pm)
+    assert len(models) == 2
+    facade = DataFrame.from_numpy(X, y)
+    for m, p in zip(models, pm):
+        b = LinearRegression(maxIter=50, regParam=list(p.values())[0]).fit(facade)
+        np.testing.assert_allclose(
+            np.asarray(m.coef_), np.asarray(b.coef_), rtol=1e-4, atol=1e-5
+        )
+    # the two regularizations genuinely differ
+    assert not np.allclose(
+        np.asarray(models[0].coef_), np.asarray(models[1].coef_), rtol=1e-3
+    )
+
+
+def test_missing_input_column_fails_on_driver(fake_pyspark):
+    """A wrong featuresCol must raise BEFORE any barrier stage launches —
+    not as an executor traceback."""
+    X, _ = _data()
+    est = KMeans(k=2, maxIter=5).setFeaturesCol("nope")
+    with pytest.raises(ValueError, match="nope"):
+        est.fit(_fake_sdf(X))
+
+
+def test_num_workers_inference_order(fake_pyspark):
+    from spark_rapids_ml_tpu.spark.adapter import (
+        NUM_WORKERS_CONF,
+        infer_spark_num_workers,
+    )
+
+    class _Spark:
+        def __init__(self, conf):
+            self.sparkContext = types.SimpleNamespace(
+                getConf=lambda: types.SimpleNamespace(get=conf.get)
+            )
+
+    est = KMeans(k=2)
+    # explicit estimator setting wins
+    est._num_workers = 3
+    assert infer_spark_num_workers(est, _Spark({NUM_WORKERS_CONF: "5"})) == 3
+    est._num_workers = None
+    # then our own conf
+    assert infer_spark_num_workers(
+        est, _Spark({NUM_WORKERS_CONF: "5", "spark.executor.instances": "7"})
+    ) == 5
+    # then executor instances
+    assert infer_spark_num_workers(
+        est, _Spark({"spark.executor.instances": "7"})
+    ) == 7
+    # fallback: single worker (NOT the partition or device count)
+    assert infer_spark_num_workers(est, _Spark({})) == 1
+
+
+def test_collect_override_falls_back_to_driver_local(fake_pyspark, monkeypatch):
+    """SRML_SPARK_COLLECT=1 keeps the old driver-collect path for single
+    TPU-VM notebooks; the mock lacks toPandas so routing there must fail
+    loudly (proving the switch flips the path, not just the default)."""
+    monkeypatch.setenv("SRML_SPARK_COLLECT", "1")
+    X, _ = _data()
+    with pytest.raises((AttributeError, TypeError)):
+        KMeans(k=2, maxIter=5).fit(_fake_sdf(X))
