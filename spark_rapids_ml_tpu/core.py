@@ -372,6 +372,14 @@ class _FitMultipleIterator:
 class _TpuEstimator(_TpuCaller):
     """Base estimator (reference _CumlEstimator core.py:717-916)."""
 
+    # Whether this estimator's fit function runs correctly over a
+    # multi-process (nranks > 1) mesh: it must never host-fetch the
+    # row-sharded FitInputs arrays (np.asarray on an array spanning
+    # non-addressable devices raises).  Estimators that do host-side label
+    # discovery / binning mark themselves False until those steps move on
+    # device or behind a gather.
+    _supports_multicontroller_fit = True
+
     def __init__(self) -> None:
         super().__init__()
         self.logger = get_logger(type(self))

@@ -202,6 +202,10 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
     """Distributed logistic regression on a TPU mesh via fully-jitted
     L-BFGS/OWL-QN with psum'd loss/grad (ops/lbfgs.py, ops/logistic.py)."""
 
+    # host-side class discovery (np.unique on fetched labels) blocks
+    # multi-process fits until it moves on device
+    _supports_multicontroller_fit = False
+
     def __init__(self, **kwargs: Any) -> None:
         if not kwargs.get("float32_inputs", True):
             get_logger(type(self)).warning(

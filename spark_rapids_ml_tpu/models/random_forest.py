@@ -242,8 +242,21 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
         self._initialize_tpu_params()
         self._set_params(**kwargs)
 
+    # binning sample + label-stat encoding host-fetch the sharded inputs
+    _supports_multicontroller_fit = False
+
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         return True
+
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        from ..evaluation import (
+            MulticlassClassificationEvaluator,
+            RegressionEvaluator,
+        )
+
+        if self._is_classification:
+            return isinstance(evaluator, MulticlassClassificationEvaluator)
+        return isinstance(evaluator, RegressionEvaluator)
 
     def _encode_labels(self, y: np.ndarray, valid: np.ndarray):
         raise NotImplementedError
@@ -387,7 +400,85 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
 
 
 class _RandomForestModelBase(_RandomForestParams, _TpuModelWithPredictionCol):
-    """Shared forest model: dense arrays + vectorized traversal predict."""
+    """Shared forest model: dense arrays + vectorized traversal predict.
+
+    A _combine'd multi-model stores every sub-model's trees concatenated
+    along the tree axis with `_tree_counts` recording the per-model counts
+    (the reference concatenates treelite handles the same way, tree.py:592);
+    it only supports _transformEvaluate, not transform."""
+
+    @property
+    def _num_models(self) -> int:
+        counts = getattr(self, "_tree_counts", None)
+        return len(counts) if counts else 1
+
+    @classmethod
+    def _combine(cls, models: List["_RandomForestModelBase"]) -> "_RandomForestModelBase":
+        assert models and all(isinstance(m, cls) for m in models)
+        first = models[0]
+        assert all(m.n_cols == first.n_cols for m in models)
+        V = first.leaf_values_.shape[2]
+        assert all(m.leaf_values_.shape[2] == V for m in models), (
+            "cannot combine forests with different value widths"
+        )
+        # dense layouts may differ in depth (maxDepth in the param grid):
+        # shallower trees embed unchanged in the deeper node indexing, so
+        # pad every model's node axis to the largest layout
+        M_max = max(m.features_.shape[1] for m in models)
+
+        def pad_nodes(a: np.ndarray, fill=0) -> np.ndarray:
+            if a.shape[1] == M_max:
+                return a
+            width = [(0, 0), (0, M_max - a.shape[1])] + [(0, 0)] * (a.ndim - 2)
+            return np.pad(a, width, constant_values=fill)
+
+        kwargs = dict(
+            features_=np.concatenate([pad_nodes(m.features_, -1) for m in models]),
+            thresholds_=np.concatenate([pad_nodes(m.thresholds_) for m in models]),
+            leaf_values_=np.concatenate([pad_nodes(m.leaf_values_) for m in models]),
+            node_counts_=np.concatenate([pad_nodes(m.node_counts_) for m in models]),
+            impurities_=np.concatenate([pad_nodes(m.impurities_) for m in models]),
+            max_depth=max(int(m.max_depth) for m in models),
+            n_cols=first.n_cols,
+            dtype=first.dtype,
+        )
+        if hasattr(first, "classes_"):
+            assert all(
+                np.array_equal(m.classes_, first.classes_) for m in models
+            ), "cannot combine classifiers fit on different label sets"
+            kwargs.update(classes_=first.classes_, num_classes=first.num_classes)
+        combined = cls(**kwargs)
+        combined._tree_counts = [m.features_.shape[0] for m in models]
+        first._copyValues(combined)
+        combined._tpu_params.update(first._tpu_params)
+        combined._float32_inputs = first._float32_inputs
+        return combined
+
+    def _per_model_values(self, features: np.ndarray) -> List[np.ndarray]:
+        """Mean leaf values per sub-model, one (N, V) array each — a single
+        device pass per sub-model tree slice over one resident feature batch."""
+        features = np.atleast_2d(np.asarray(features))
+        if features.shape[1] != self.n_cols:
+            raise ValueError(
+                f"feature width {features.shape[1]} != model n_cols {self.n_cols}"
+            )
+        np_dtype = self._transform_dtype(self.dtype)
+        f, t, v = self._forest_arrays()
+        feats_dev = jax.device_put(np.asarray(features, np_dtype))
+        counts = getattr(self, "_tree_counts", None) or [self.features_.shape[0]]
+        out, off = [], 0
+        for c in counts:
+            sl = slice(off, off + c)
+            off += c
+            out.append(
+                np.asarray(
+                    forest_predict_kernel(
+                        feats_dev, f[sl], t[sl], v[sl],
+                        max_depth=int(self.max_depth),
+                    )
+                )
+            )
+        return out
 
     def _forest_arrays(self):
         np_dtype = self._transform_dtype(self.dtype)
@@ -398,21 +489,11 @@ class _RandomForestModelBase(_RandomForestParams, _TpuModelWithPredictionCol):
         )
 
     def _predict_values(self, features: np.ndarray) -> np.ndarray:
-        features = np.atleast_2d(np.asarray(features))
-        if features.shape[1] != self.n_cols:
-            # gathers clamp out-of-range feature ids, which would silently
-            # mispredict — reject wrong-width inputs explicitly
-            raise ValueError(
-                f"feature width {features.shape[1]} != model n_cols {self.n_cols}"
-            )
-        np_dtype = self._transform_dtype(self.dtype)
-        f, t, v = self._forest_arrays()
-        return np.asarray(
-            forest_predict_kernel(
-                jax.device_put(np.asarray(features, np_dtype)), f, t, v,
-                max_depth=int(self.max_depth),
-            )
+        assert self._num_models == 1, (
+            "transform() on a combined multi-model is unsupported; use "
+            "_transformEvaluate"
         )
+        return self._per_model_values(features)[0]
 
     @property
     def getNumTrees(self) -> int:  # property for pyspark API parity
@@ -555,10 +636,12 @@ class RandomForestClassificationModel(
         classes = self.classes_
 
         def _predict_all(feats: np.ndarray):
-            probs = self._predict_values(feats)
-            probs = probs / np.maximum(probs.sum(axis=1, keepdims=True), 1e-12)
-            preds = classes[probs.argmax(axis=1)].astype(np.float64)
-            return preds[None, :], probs[None, :, :]
+            preds, probs = [], []
+            for p in self._per_model_values(feats):
+                p = p / np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
+                probs.append(p)
+                preds.append(classes[p.argmax(axis=1)].astype(np.float64))
+            return np.stack(preds), np.stack(probs)
 
         return _predict_all
 
@@ -574,7 +657,7 @@ class RandomForestClassificationModel(
         from .logistic_regression import _ClassificationModelEvaluationMixIn
 
         return _ClassificationModelEvaluationMixIn._transform_evaluate(
-            self, dataset, evaluator, 1
+            self, dataset, evaluator, self._num_models
         )
 
     def cpu(self):
@@ -655,7 +738,9 @@ class RandomForestRegressionModel(_RandomForestModelBase):
 
     def _get_eval_predict_func(self) -> Callable[[np.ndarray], np.ndarray]:
         def _predict_all(feats: np.ndarray) -> np.ndarray:
-            return self._predict_values(feats)[:, 0][None, :].astype(np.float64)
+            return np.stack(
+                [p[:, 0].astype(np.float64) for p in self._per_model_values(feats)]
+            )
 
         return _predict_all
 
@@ -666,7 +751,7 @@ class RandomForestRegressionModel(_RandomForestModelBase):
         from .linear_regression import _RegressionModelEvaluationMixIn
 
         return _RegressionModelEvaluationMixIn._transform_evaluate(
-            self, dataset, evaluator, 1
+            self, dataset, evaluator, self._num_models
         )
 
     def cpu(self):

@@ -142,6 +142,10 @@ class UMAP(_UMAPParams, _TpuEstimator):
     """UMAP on a TPU mesh: exact mesh-distributed kNN graph, vectorized
     fuzzy-set calibration, one-jit SGD layout."""
 
+    # single-node fit by design (reference umap.py:831-850 coalesces to one
+    # partition); the fit func host-fetches the whole dataset
+    _supports_multicontroller_fit = False
+
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()
         self._initialize_tpu_params()

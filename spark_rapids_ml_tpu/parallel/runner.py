@@ -271,6 +271,15 @@ class DistributedFitSession:
         JSON-safe encoded model-attribute dict(s) (one per param map)."""
         from ..dataframe import DataFrame
 
+        if self.nranks > 1 and not getattr(
+            estimator, "_supports_multicontroller_fit", True
+        ):
+            raise NotImplementedError(
+                f"{type(estimator).__name__} does not yet support "
+                "multi-process (barrier) training: its fit function "
+                "host-fetches row-sharded inputs. Train with num_workers=1 "
+                "or SRML_SPARK_COLLECT=1 (driver-local fit)."
+            )
         df = DataFrame(list(partitions))
         inputs = self.build_fit_inputs(estimator, df)
         fit_func = estimator._get_tpu_fit_func(df, extra_params)

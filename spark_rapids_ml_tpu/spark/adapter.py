@@ -160,12 +160,12 @@ def infer_spark_num_workers(estimator: Any, spark: Any) -> int:
     single-controller device count: a barrier stage with one task per mesh
     device would have several processes fighting over the same chips.
 
-    Resolution order: explicit estimator num_workers (the user's statement
-    of how many TPU-VM workers the cluster has) > our own conf
+    The estimator's own num_workers is deliberately NOT consulted: across
+    the rest of the framework it means mesh DEVICE count (params.py
+    _infer_num_workers), and several barrier tasks per TPU-VM host would
+    fight over the same chips.  Resolution order: our conf
     spark.rapids.ml.tpu.numWorkers > spark.executor.instances (one TPU-VM
     worker per executor) > 1 (single worker, with a log note)."""
-    if estimator._num_workers is not None:
-        return int(estimator._num_workers)
     conf_get = spark.sparkContext.getConf().get
     own = conf_get(NUM_WORKERS_CONF)
     if own is not None:

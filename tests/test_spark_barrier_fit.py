@@ -194,11 +194,12 @@ def test_num_workers_inference_order(fake_pyspark):
             )
 
     est = KMeans(k=2)
-    # explicit estimator setting wins
+    # estimator num_workers means mesh DEVICE count everywhere else, so the
+    # barrier task count deliberately ignores it — even when set
     est._num_workers = 3
-    assert infer_spark_num_workers(est, _Spark({NUM_WORKERS_CONF: "5"})) == 3
+    assert infer_spark_num_workers(est, _Spark({NUM_WORKERS_CONF: "5"})) == 5
     est._num_workers = None
-    # then our own conf
+    # our own conf beats executor instances
     assert infer_spark_num_workers(
         est, _Spark({NUM_WORKERS_CONF: "5", "spark.executor.instances": "7"})
     ) == 5

@@ -108,3 +108,68 @@ def test_cv_model_persistence(tmp_path):
     p1 = cv_model.transform(df).toPandas()["prediction"]
     p2 = loaded.transform(df).toPandas()["prediction"]
     np.testing.assert_allclose(p1, p2, atol=1e-7)
+
+
+def test_cv_random_forest_classifier_single_pass():
+    from spark_rapids_ml_tpu import RandomForestClassifier
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(240, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=3)
+    est = RandomForestClassifier(numTrees=5, seed=9)
+    assert est._supportsTransformEvaluate(
+        MulticlassClassificationEvaluator(metricName="accuracy")
+    )
+    # grid varies BOTH tree count and depth: _combine must concatenate
+    # differing dense layouts
+    grid = (
+        ParamGridBuilder()
+        .addGrid(RandomForestClassifier.maxDepth, [1, 6])
+        .build()
+    )
+    eva = MulticlassClassificationEvaluator(metricName="accuracy")
+    cv = CrossValidator(estimator=est, estimatorParamMaps=grid, evaluator=eva, numFolds=3)
+    cv_model = cv.fit(df)
+    assert len(cv_model.avgMetrics) == 2
+    # depth-6 forest must beat decision stumps on this 2-feature interaction
+    assert cv_model.avgMetrics[1] > cv_model.avgMetrics[0]
+    assert cv_model.bestModel.getOrDefault("maxDepth") == 6
+
+
+def test_cv_random_forest_regressor_single_pass():
+    from spark_rapids_ml_tpu import RandomForestRegressor
+
+    df, X, y = _reg_df(n=240)
+    est = RandomForestRegressor(numTrees=5, seed=9)
+    eva = RegressionEvaluator(metricName="rmse")
+    assert est._supportsTransformEvaluate(eva)
+    grid = ParamGridBuilder().addGrid(RandomForestRegressor.maxDepth, [1, 7]).build()
+    cv = CrossValidator(estimator=est, estimatorParamMaps=grid, evaluator=eva, numFolds=3)
+    cv_model = cv.fit(df)
+    assert cv_model.avgMetrics[1] < cv_model.avgMetrics[0]  # rmse: deeper wins
+    assert cv_model.bestModel.getOrDefault("maxDepth") == 7
+
+
+def test_rf_combined_multi_model_matches_per_model_eval():
+    from spark_rapids_ml_tpu import RandomForestClassifier
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(200, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    train = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    est = RandomForestClassifier(numTrees=4, seed=3)
+    pm = [
+        {est.getParam("maxDepth"): 2},
+        {est.getParam("maxDepth"): 5},
+    ]
+    models = [m for _, m in est.fitMultiple(train, pm)]
+    combined = models[0]._combine(models)
+    assert combined._num_models == 2
+    eva = MulticlassClassificationEvaluator(metricName="accuracy")
+    single = [eva.evaluate(m.transform(train)) for m in models]
+    fused = combined._transformEvaluate(train, eva)
+    np.testing.assert_allclose(fused, single, atol=1e-12)
+    # combined models refuse plain transform (ambiguous tree averaging)
+    with pytest.raises(AssertionError):
+        combined.transform(train)
