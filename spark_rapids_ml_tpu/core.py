@@ -49,6 +49,15 @@ def _is_pyspark_dataframe(dataset: Any) -> bool:
     return (type(dataset).__module__ or "").startswith("pyspark.sql")
 
 
+def _maybe_x64(dtype: Any):
+    """jax x64 scope for float64 fits; a no-op for float32."""
+    import contextlib
+
+    if np.dtype(dtype) == np.float64:
+        return jax.enable_x64(True)
+    return contextlib.nullcontext()
+
+
 # single-slot device-input cache; see _TpuCaller._build_fit_inputs
 _FIT_INPUT_CACHE: Dict[str, Any] = {}
 
@@ -291,20 +300,29 @@ class _TpuCaller(_TpuParams):
         profiling.reset_phase_times()
         df = as_dataframe(dataset)
         self._validate_parameters(df)
-        with profiling.phase("srml.ingest"):
-            inputs = self._build_fit_inputs(df)
-        extra_params = None
-        if paramMaps is not None:
-            extra_params = [self._paramMap_to_tpu_overrides(pm) for pm in paramMaps]
-        fit_func = self._get_tpu_fit_func(df, extra_params)
-        logger = get_logger(type(self))
-        logger.info(
-            "Invoking TPU fit: %d rows x %d cols on %d-device mesh",
-            inputs.n_rows, inputs.n_cols, inputs.mesh.devices.size,
-        )
-        with profiling.maybe_trace(type(self).__name__):
-            with profiling.phase("srml.fit"):
-                result = fit_func(inputs, dict(self._tpu_params))
+        # float64 fits genuinely run in float64 (reference core.py:363-401
+        # keeps f64 end-to-end): without x64, jax.device_put silently
+        # canonicalizes f64 -> f32.  The x64 scope must cover BOTH ingest
+        # (device_put) and the fit (trace-time dtypes); it recompiles the
+        # kernels for f64, which TPUs execute via (slower) emulation.
+        input_col, input_cols = self._get_input_columns()
+        with _maybe_x64(self._use_dtype(df, input_col, input_cols)):
+            with profiling.phase("srml.ingest"):
+                inputs = self._build_fit_inputs(df)
+            extra_params = None
+            if paramMaps is not None:
+                extra_params = [
+                    self._paramMap_to_tpu_overrides(pm) for pm in paramMaps
+                ]
+            fit_func = self._get_tpu_fit_func(df, extra_params)
+            logger = get_logger(type(self))
+            logger.info(
+                "Invoking TPU fit: %d rows x %d cols on %d-device mesh",
+                inputs.n_rows, inputs.n_cols, inputs.mesh.devices.size,
+            )
+            with profiling.maybe_trace(type(self).__name__):
+                with profiling.phase("srml.fit"):
+                    result = fit_func(inputs, dict(self._tpu_params))
         self._last_fit_phase_times = profiling.phase_times()
         return result
 

@@ -22,6 +22,7 @@
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -86,6 +87,84 @@ def _str_or_numerical(value: str) -> Union[str, float, int]:
             return float(value)
         except (TypeError, ValueError):
             return value
+
+
+def _maybe_grow_mxu(
+    inputs,
+    Xb,
+    edges,
+    stats,
+    n_trees,
+    bootstrap,
+    seed,
+    is_classification,
+    *,
+    max_depth,
+    n_bins,
+    kind,
+    max_features,
+    min_samples_leaf,
+    min_impurity_decrease,
+):
+    """Route the fit through the MXU histogram builder (ops/forest_mxu) when
+    the hardware and shape qualify; None -> caller takes the scatter path.
+    TPU scatter sustains ~10M updates/s, the MXU path ~36 TF-equivalent."""
+    from ..ops import forest_mxu
+    from ..ops.forest_hist import _ROW_TILE
+
+    s_split = 2 if not is_classification else stats.shape[1]
+    if (
+        jax.default_backend() != "tpu"
+        or inputs.mesh.devices.size != 1
+        or n_bins > 128
+        or max_features > 1024
+        or not forest_mxu.mxu_depth_supported(max_depth, s_split)
+    ):
+        # the pallas kernel is single-chip (no sharding rule yet): sharded
+        # fits keep the scatter path, which runs correctly under GSPMD
+        return None
+    n = Xb.shape[0]
+    n_pad = -(-n // _ROW_TILE) * _ROW_TILE
+
+    @partial(jax.jit, static_argnames=("n_pad",))
+    def _layout(Xb, stats, weight, n_pad):
+        pad = n_pad - Xb.shape[0]
+        # cast before pad/transpose: the int8 copies are 4x smaller than the
+        # int32 bin matrix they derive from
+        bins_fm = jnp.pad(Xb.astype(jnp.int8), ((0, pad), (0, 0))).T
+        st = jnp.pad(stats, ((0, pad), (0, 0))).T  # (S_in, n_pad)
+        w = jnp.pad(weight, (0, pad))
+        return bins_fm, st, w
+
+    bins_fm, st_fm, w_pad = _layout(Xb, stats, inputs.weight, n_pad)
+    if is_classification:
+        base_stats, stats3 = st_fm, None
+        # class index per row (deep phase rebuilds one-hot stats post-sort)
+        y_vals = jnp.argmax(st_fm, axis=0).astype(jnp.float32)
+    else:
+        # stats rows are (1, y, y^2)*mask; split search needs only (w, wy)
+        base_stats, stats3 = st_fm[:2], st_fm
+        y_vals = st_fm[1]
+    key = jax.random.PRNGKey((seed + 104729) & 0x7FFFFFFF)
+    if bootstrap:
+        bw = jax.random.poisson(key, 1.0, (n_trees, n_pad)).astype(w_pad.dtype)
+        w_trees = w_pad[None, :] * bw
+    else:
+        w_trees = jnp.broadcast_to(w_pad[None, :], (n_trees, n_pad))
+    try:
+        return forest_mxu.grow_forest_mxu(
+            bins_fm, base_stats, w_trees, stats3, edges,
+            max_depth=max_depth, n_bins=n_bins, kind=kind,
+            max_features=int(max_features),
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease,
+            seed=seed, y_vals=y_vals,
+        )
+    except forest_mxu._DeepPhaseSkewError as e:
+        get_logger(_maybe_grow_mxu).info(
+            "MXU path declined (%s); falling back to scatter builder", e
+        )
+        return None
 
 
 class _RandomForestClass(_TpuParams):
@@ -302,6 +381,28 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
                 ),
             )
             key = jax.random.PRNGKey(seed)
+            mxu = _maybe_grow_mxu(
+                inputs, Xb, edges, stats, n_trees, bootstrap, seed,
+                is_classification, **grow_kwargs,
+            )
+            if mxu is not None:
+                features, thresholds, leaf_values, node_counts, impurities = mxu
+                logger.info(
+                    "grew %d trees on the MXU histogram path (depth<=%d, "
+                    "bins=%d)", n_trees, max_depth, n_bins,
+                )
+                attrs = {
+                    "features_": features,
+                    "thresholds_": thresholds,
+                    "leaf_values_": leaf_values,
+                    "node_counts_": node_counts,
+                    "impurities_": impurities,
+                    "max_depth": max_depth,
+                    "n_cols": inputs.n_cols,
+                    "dtype": str(inputs.dtype),
+                }
+                attrs.update(extra_attrs)
+                return attrs
             # Lock-step forest growth (one host level-loop for ALL trees)
             # unless the batched path's device buffers would be too large:
             # the (combined, D) feature-subset scores at the deepest level,

@@ -70,6 +70,33 @@ def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
     return jax.vmap(per_col, in_axes=(1, 0), out_axes=1)(X, edges)
 
 
+@partial(jax.jit, static_argnames=())
+def _bin_chunk_t(X_chunk: jax.Array, edges: jax.Array) -> jax.Array:
+    def per_col(col, e):
+        return jnp.searchsorted(e, col, side="left").astype(jnp.int8)
+
+    return jax.vmap(per_col, in_axes=(1, 0), out_axes=0)(X_chunk, edges)
+
+
+def bin_features_feature_major(
+    X: jax.Array, edges: jax.Array, chunk: int = 65536
+) -> jax.Array:
+    """(N, D) f32 -> (D, N) int8 binned, row-chunked so peak temp memory is
+    one (chunk, D) tile instead of a full int32 (N, D) copy (which OOMs at
+    the 3000-column benchmark shape).  A host-level chunk loop — putting the
+    searchsorted vmap inside lax.scan produced a faulting TPU kernel on the
+    axon backend.  Requires n_bins <= 128 (int8)."""
+    n, d = X.shape
+    chunk = min(chunk, n)
+    parts = []
+    for i in range(0, n, chunk):
+        c = min(chunk, n - i)
+        parts.append(
+            _bin_chunk_t(jax.lax.dynamic_slice_in_dim(X, i, c), edges)
+        )
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
 def _chunk_histogram(Xb, stats, rel_node, lo, node_batch, n_bins):
     """Per-(node, feature, bin) stat sums for nodes [lo, lo+node_batch):
     (S, node_batch, D, n_bins) — S-LEADING, scalar scatters per stat (see

@@ -1,0 +1,619 @@
+#
+# MXU forest builder: lock-step level-wise growth driven by the pallas
+# histogram kernel (ops/forest_hist.py).
+#
+# Replaces the scatter-bound grow_forest path (ops/forest.py) on TPU for the
+# depths where every level's (node, stat) slots fit one 128-slot matmul
+# (2^level * s_dim <= 128).  Design notes:
+#
+#   - Trees grow LOCK-STEP; at shallow levels several trees pack into one
+#     128-slot scan and share the streamed one-hot operand.
+#   - Feature subsets (featureSubsetStrategy) are sampled per (tree-group,
+#     level) — one subset shared by the <= 64 trees packed into a scan.
+#     cuML/Spark sample per node; per-(group, level) sampling keeps the
+#     de-correlation role (random-subspace forests, Ho 1998) while letting
+#     histogram work ride a single MXU operand.  Groups shrink to one tree
+#     by the depth where per-node sampling would matter most.
+#   - Regression split search uses only (w, w*y) histograms: the w*y^2 term
+#     cancels in the weighted variance gain (sum_c (wy_c)^2/w_c is monotone
+#     in it), halving slot usage; node impurities come from a per-node
+#     3-stat mini-scan.
+#   - Row routing is scatter-free: per level, the <= n_nodes chosen feature
+#     rows are selected by a tiny one-hot matmul and compared against each
+#     node's split bin under the node mask.
+#
+# The returned dense tree arrays are identical in layout to grow_forest's,
+# so models/random_forest.py consumes either builder interchangeably.
+#
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .forest_hist import (
+    M_SLOTS,
+    _F_BLOCK,
+    _ROW_TILE,
+    _ROW_TILE_DEEP,
+    gather_rows_matmul,
+    node_histograms,
+    node_histograms_bucketed,
+)
+
+_LANE = _ROW_TILE
+
+
+def _shallow_levels(s_dim: int) -> int:
+    """Levels the single-scan phase can host: 2^l * s_dim <= M_SLOTS."""
+    l = 0
+    while (2 ** (l + 1)) * s_dim <= M_SLOTS:
+        l += 1
+    return l  # deepest supported level index
+
+
+def mxu_depth_supported(max_depth: int, s_dim: int) -> bool:
+    """Shallow phase hosts levels up to L_s; the bucketed deep phase covers
+    another L_s + 1 levels (one bucket per level-(L_s+1) node, each again
+    bounded by the slot budget)."""
+    l_s = _shallow_levels(s_dim)
+    return max_depth <= 2 * l_s + 1
+
+
+@partial(jax.jit, static_argnames=("tpack", "s_dim"))
+def _stats_rows(base_s: jax.Array, w_group: jax.Array, tpack: int, s_dim: int):
+    """(tpack*S, N) stat rows = per-tree bootstrap weight x base stats.
+    base_s: (S, N); w_group: (tpack, N)."""
+    out = base_s[None, :, :] * w_group[:, None, :]
+    return out.reshape(tpack * s_dim, base_s.shape[1])
+
+
+@partial(jax.jit, static_argnames=("tpack", "nodes", "s_dim", "kind"))
+def _split_from_hist(
+    H: jax.Array,          # (F_pad, slots, B) slot-packed histogram
+    node_tot: jax.Array,   # (tpack, nodes, 3) (w, wy, wy2); None for clf
+    feat_valid: jax.Array, # (F_pad,) bool — padding features masked
+    tpack: int,
+    nodes: int,
+    s_dim: int,
+    kind: str,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+):
+    """Best split per (tree, node) from the slot-packed histogram.  The
+    tpack axis is any batch of independent slot groups — lock-step trees in
+    the shallow phase, buckets in the deep phase.
+
+    Returns (best_f_local, best_bin, split_ok, node_w, node_imp, node_val)
+    with leading (tpack, nodes) axes; node_val is (tpack, nodes, V)."""
+    F_pad, _, B = H.shape
+    used = tpack * nodes * s_dim
+    hist = H[:, :used, :].reshape(F_pad, tpack, nodes, s_dim, B)
+    hist = jnp.transpose(hist, (1, 3, 2, 0, 4))  # (tpack, S, nodes, F, B)
+    left = jnp.cumsum(hist, axis=-1)
+    right = left[..., -1:] - left
+
+    if kind == "regression":
+        p_w = node_tot[:, :, 0]
+        l_w, l_wy = left[:, 0], left[:, 1]
+        r_w, r_wy = right[:, 0], right[:, 1]
+        p_wy, p_wy2 = node_tot[:, :, 1], node_tot[:, :, 2]
+        # weighted variance gain with the wy^2 terms cancelled:
+        # gain = wy_l^2/w_l + wy_r^2/w_r - wy_p^2/w_p
+        eps = 1e-12
+        gain = (
+            l_wy * l_wy / jnp.maximum(l_w, eps)
+            + r_wy * r_wy / jnp.maximum(r_w, eps)
+            - (p_wy * p_wy / jnp.maximum(p_w, eps))[:, :, None, None]
+        )
+        p_imp = jnp.maximum(
+            p_wy2 / jnp.maximum(p_w, eps)
+            - (p_wy / jnp.maximum(p_w, eps)) ** 2,
+            0.0,
+        )
+        p_val = (p_wy / jnp.maximum(p_w, eps))[:, :, None]
+    else:
+        l_w = left.sum(axis=1)
+        r_w = right.sum(axis=1)
+        eps = 1e-12
+        pl_ = left / jnp.maximum(l_w, eps)[:, None]
+        pr_ = right / jnp.maximum(r_w, eps)[:, None]
+        if kind == "entropy":
+            l_imp = -(pl_ * jnp.log2(jnp.maximum(pl_, eps))).sum(axis=1)
+            r_imp = -(pr_ * jnp.log2(jnp.maximum(pr_, eps))).sum(axis=1)
+        else:  # gini
+            l_imp = 1.0 - (pl_ * pl_).sum(axis=1)
+            r_imp = 1.0 - (pr_ * pr_).sum(axis=1)
+        # parent impurity/weight from the per-node class totals folded into
+        # H: total over any feature == node class counts (feature 0 here)
+        node_cls = hist[:, :, :, 0, :].sum(axis=-1)  # (tpack, S, nodes)
+        node_cls = jnp.moveaxis(node_cls, 1, 2)      # (tpack, nodes, S)
+        p_w = node_cls.sum(axis=2)
+        pw_safe = jnp.maximum(p_w, eps)
+        pp = node_cls / pw_safe[:, :, None]
+        if kind == "entropy":
+            p_imp = -(pp * jnp.log2(jnp.maximum(pp, eps))).sum(axis=2)
+        else:
+            p_imp = 1.0 - (pp * pp).sum(axis=2)
+        p_val = pp
+        gain = (
+            p_imp[:, :, None, None] * p_w[:, :, None, None]
+            - (l_imp * l_w + r_imp * r_w)
+        )
+
+    ok_lr = (l_w >= min_samples_leaf) & (r_w >= min_samples_leaf)
+    gain = jnp.where(ok_lr, gain, -jnp.inf)
+    gain = gain.at[..., -1].set(-jnp.inf)  # last bin: empty right side
+    gain = jnp.where(feat_valid[None, None, :, None], gain, -jnp.inf)
+    flat = gain.reshape(tpack, nodes, -1)
+    best = jnp.argmax(flat, axis=-1)
+    best_gain = jnp.take_along_axis(flat, best[..., None], axis=-1)[..., 0]
+    bf = (best // B).astype(jnp.int32)
+    bb = (best % B).astype(jnp.int32)
+    noise_floor = 1e-6 * p_imp * p_w + 1e-30
+    split_ok = (
+        jnp.isfinite(best_gain)
+        & (p_imp > 0)
+        & (best_gain > jnp.maximum(min_impurity_decrease * p_w, noise_floor))
+        & (p_w >= 2 * min_samples_leaf)
+    )
+    return bf, bb, split_ok, p_w, p_imp, p_val
+
+
+@partial(jax.jit, static_argnames=("nodes",))
+def _node_totals(node_rel: jax.Array, stats3: jax.Array, nodes: int):
+    """(tpack, nodes, S3) per-node stat sums via a tiny slot matmul:
+    node_rel (tpack, N), stats3 (tpack, S3, N)."""
+    tpack, n = node_rel.shape
+    on = (
+        node_rel[:, None, :]
+        == jnp.arange(nodes, dtype=node_rel.dtype)[None, :, None]
+    ).astype(stats3.dtype)  # (tpack, nodes, N)
+    return jnp.einsum(
+        "tcn,tsn->tcs", on, stats3, preferred_element_type=jnp.float32
+    )
+
+
+@jax.jit
+def _route(
+    sub: jax.Array,        # (F_pad, N) int32 this level's subset rows
+    node_rel: jax.Array,   # (tpack, N)
+    bf_local: jax.Array,   # (tpack, nodes) local feature index
+    bb: jax.Array,         # (tpack, nodes)
+    ok: jax.Array,         # (tpack, nodes) bool
+):
+    """Scatter-free routing: select each node's split-feature row with a
+    one-hot matmul, then move rows to 2c / 2c+1 (sentinel 2*nodes when the
+    node stopped)."""
+    tpack, nodes = bf_local.shape
+    F_pad = sub.shape[0]
+    sel = (
+        bf_local[:, :, None] == jnp.arange(F_pad, dtype=bf_local.dtype)[None, None, :]
+    ).astype(jnp.float32)  # (tpack, nodes, F_pad)
+    sel_bins = jnp.einsum(
+        "tcf,fn->tcn", sel, sub.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)  # (tpack, nodes, N): node c's feature row
+    on = (
+        node_rel[:, None, :]
+        == jnp.arange(nodes, dtype=node_rel.dtype)[None, :, None]
+    )  # (tpack, nodes, N)
+    go_right = (
+        (sel_bins > bb[:, :, None]) & on & ok[:, :, None]
+    ).any(axis=1)
+    stays = (on & ok[:, :, None]).any(axis=1)
+    new_rel = jnp.where(
+        stays, 2 * node_rel + go_right.astype(jnp.int32), 2 * nodes
+    )
+    return new_rel
+
+
+class _DeepPhaseSkewError(RuntimeError):
+    """Raised when equal-cap bucket padding would blow up memory; callers
+    fall back to the scatter builder."""
+
+
+@partial(jax.jit, static_argnames=("f_pad",))
+def _pack_rows(sub: jax.Array, f_pad: int) -> jax.Array:
+    """(f_pad, N) int8 -> (f_pad//4, N) int32, 4 bin bytes per word, so the
+    deep-phase payload sort moves 4 features per operand."""
+    v = sub.astype(jnp.int32).reshape(f_pad // 4, 4, -1)
+    return v[:, 0] | (v[:, 1] << 8) | (v[:, 2] << 16) | (v[:, 3] << 24)
+
+
+@partial(jax.jit, static_argnames=())
+def _unpack_rows(packed: jax.Array) -> jax.Array:
+    """(P, N) int32 -> (4P, N) int8 inverse of _pack_rows."""
+    p = packed[:, None, :]
+    parts = jnp.concatenate(
+        [(p >> (8 * i)) & 0xFF for i in range(4)], axis=1
+    )
+    return parts.reshape(-1, packed.shape[1]).astype(jnp.int8)
+
+
+def _deep_phase(
+    rel: jax.Array,          # (T, n_pad) node ids AT the bucket level
+    bins_fm: jax.Array,
+    w_trees: jax.Array,
+    y_vals: jax.Array,       # (n_pad,) label/target values (f32)
+    edges: np.ndarray,
+    outputs,                 # (feature, threshold, leaf_value, n_samples, impurity)
+    rng: np.random.Generator,
+    *,
+    bucket_level: int,
+    max_depth: int,
+    n_bins: int,
+    kind: str,
+    s_dim: int,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+    interpret: bool = False,
+) -> None:
+    """Levels past the 128-slot budget: rows are grouped ONCE per tree by
+    their bucket-level ancestor via a batched payload sort (the only fast
+    data-movement primitive on this backend — XLA gather/scatter scalarize),
+    then every deeper level histograms each equal-padded bucket against its
+    own <= 128 local slots.  The per-tree deep feature subset rides the sort
+    as packed int32 payload; buckets never move again because routing keeps
+    rows inside their subtree."""
+    feature, threshold, leaf_value, n_samples, impurity = outputs
+    T, n_pad = rel.shape
+    D = bins_fm.shape[0]
+    n_buckets = 2**bucket_level
+    F = int(max_features)
+    f_pad = -(-max(F, 4) // _F_BLOCK) * _F_BLOCK
+
+    # one deep subset per tree, shared by its levels >= bucket_level (the
+    # random-subspace compromise documented in the module header)
+    feats_all = np.stack(
+        [rng.choice(D, F, replace=False).astype(np.int32) for _ in range(T)]
+    )
+
+    # --- batched bucket sort with per-bucket equal padding ---------------
+    keys = jnp.minimum(rel, n_buckets).astype(jnp.int32)
+    sorted_keys = jnp.sort(keys, axis=1)
+    bounds = jax.vmap(
+        lambda sk: jnp.searchsorted(sk, jnp.arange(n_buckets + 1))
+    )(sorted_keys)
+    counts = np.asarray(bounds[:, 1:] - bounds[:, :-1])  # (T, n_buckets)
+    cap = int(-(-max(int(counts.max()), 1) // _ROW_TILE_DEEP) * _ROW_TILE_DEEP)
+    n2 = n_buckets * cap
+    if n2 > 3 * n_pad + n_buckets * _ROW_TILE_DEEP:
+        # equal-cap padding sizes every bucket to the LARGEST one; heavily
+        # skewed trees (one bucket holding most rows) would multiply the
+        # sort/histogram working set by up to n_buckets.  Bail out to the
+        # scatter builder rather than risk HBM exhaustion.
+        raise _DeepPhaseSkewError(
+            f"bucket skew: cap {cap} x {n_buckets} buckets vs {n_pad} rows"
+        )
+    # dummy rows fill every bucket to cap; key n_buckets = discard filler
+    dkeys = np.full((T, n2), n_buckets, np.int32)
+    for t in range(T):
+        reps = np.clip(cap - counts[t], 0, None)
+        dk = np.repeat(np.arange(n_buckets, dtype=np.int32), reps)
+        dkeys[t, : dk.size] = dk
+    P = f_pad // 4
+    g_chunk = 16384 if n_pad % 16384 == 0 else _ROW_TILE
+    packed = jnp.stack(
+        [
+            _pack_rows(
+                gather_rows_matmul(bins_fm, jnp.asarray(feats_all[t]),
+                                   f_pad=f_pad, chunk=g_chunk),
+                f_pad,
+            )
+            for t in range(T)
+        ]
+    )  # (T, P, n_pad)
+    zeros_d = jnp.zeros((T, n2), jnp.int32)
+    operands = [jnp.concatenate([keys, jnp.asarray(dkeys)], axis=1)]
+    for p in range(P):
+        operands.append(jnp.concatenate([packed[:, p, :], zeros_d], axis=1))
+    operands.append(
+        jnp.concatenate([w_trees, zeros_d.astype(w_trees.dtype)], axis=1)
+    )
+    operands.append(
+        jnp.concatenate(
+            [jnp.broadcast_to(y_vals, (T, n_pad)), zeros_d.astype(jnp.float32)],
+            axis=1,
+        )
+    )
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=1, dimension=1)
+    del packed, operands
+    keys_s = sorted_ops[0][:, :n2]
+    packed_s = [o[:, :n2] for o in sorted_ops[1 : 1 + P]]
+    w_s = sorted_ops[1 + P][:, :n2]
+    y_s = sorted_ops[2 + P][:, :n2]
+    del sorted_ops
+
+    # local node id within the bucket subtree; dummies carry local 0 with
+    # weight 0 (they never contribute)
+    rel_loc = jnp.zeros((T, n2), jnp.int32)
+    bucket_of = jnp.arange(n2, dtype=jnp.int32) // cap
+
+    for level in range(bucket_level, max_depth + 1):
+        local = 2 ** (level - bucket_level)
+        nodes_lvl = n_buckets * local
+        base = 2**level - 1
+        is_last = level == max_depth
+        for t in range(T):
+            sub_t = _unpack_rows(jnp.stack(
+                [p[t] for p in packed_s]
+            ))  # (f_pad, n2)
+            if kind == "regression":
+                stats_t = jnp.stack([w_s[t], w_s[t] * y_s[t]])
+                tot3 = jnp.stack(
+                    [w_s[t], w_s[t] * y_s[t], w_s[t] * y_s[t] * y_s[t]]
+                )
+            else:
+                cls = jnp.arange(s_dim, dtype=jnp.float32)
+                stats_t = w_s[t][None, :] * (
+                    y_s[t][None, :] == cls[:, None]
+                ).astype(jnp.float32)
+                tot3 = None
+            if kind == "regression":
+                node_tot = _node_totals_bucketed(
+                    rel_loc[t], tot3, bucket_of, n_buckets, local, cap
+                )
+            else:
+                node_tot = None
+            if is_last and kind == "regression":
+                # regression leaves need only the (w, wy, wy2) node totals —
+                # no histogram at all
+                H = None
+            else:
+                if is_last:
+                    # classification leaf: totals only -> one feature block
+                    sub_t = sub_t[:_F_BLOCK]
+                H = node_histograms_bucketed(
+                    sub_t, rel_loc[t][None, :], stats_t,
+                    n_buckets=n_buckets, nodes=local, s_dim=s_dim,
+                    n_bins=n_bins, interpret=interpret,
+                )  # (n_buckets, f_pad, slots_pad, B)
+            if is_last:
+                # leaf level: totals only
+                if kind != "regression":
+                    hist0 = (
+                        H[:, 0, : local * s_dim, :]
+                        .reshape(n_buckets * local, s_dim, n_bins)
+                        .sum(-1)
+                    )
+                    tot_h = np.asarray(hist0)  # (nodes_lvl, S) class sums
+                if kind == "regression":
+                    th = np.asarray(node_tot).reshape(nodes_lvl, 3)
+                    w_n = np.maximum(th[:, 0], 1e-12)
+                    val = (th[:, 1] / w_n)[:, None]
+                    imp = np.maximum(th[:, 2] / w_n - (th[:, 1] / w_n) ** 2, 0.0)
+                    cnt = th[:, 0]
+                else:
+                    w_n = np.maximum(tot_h.sum(1), 1e-12)
+                    val = tot_h / w_n[:, None]
+                    if kind == "entropy":
+                        imp = -(val * np.log2(np.maximum(val, 1e-12))).sum(1)
+                    else:
+                        imp = 1.0 - (val * val).sum(1)
+                    cnt = tot_h.sum(1)
+                sl = slice(base, base + nodes_lvl)
+                n_samples[t, sl] = cnt
+                impurity[t, sl] = imp
+                leaf_value[t, sl] = val
+                continue
+            Hf = jnp.transpose(
+                H[:, :, : local * s_dim, :], (1, 0, 2, 3)
+            ).reshape(f_pad, n_buckets * local * s_dim, n_bins)
+            feat_valid = jnp.arange(f_pad) < F
+            bf, bb, ok, p_w, p_imp, p_val = _split_from_hist(
+                Hf, node_tot, feat_valid, n_buckets, local, s_dim, kind,
+                float(min_samples_leaf), float(min_impurity_decrease),
+            )  # leading (n_buckets, local)
+            new_loc = _route_bucketed(
+                sub_t, rel_loc[t], bucket_of, bf, bb, ok, cap
+            )
+            rel_loc = rel_loc.at[t].set(new_loc)
+            bf_h, bb_h, ok_h, pw_h, pi_h, pv_h = jax.device_get(
+                (bf, bb, ok, p_w, p_imp, p_val)
+            )
+            gf = feats_all[t][np.minimum(bf_h.reshape(-1), F - 1)]
+            sl = slice(base, base + nodes_lvl)
+            n_samples[t, sl] = pw_h.reshape(-1)
+            impurity[t, sl] = pi_h.reshape(-1)
+            leaf_value[t, sl] = pv_h.reshape(nodes_lvl, -1)
+            okf = ok_h.reshape(-1)
+            feature[t, sl] = np.where(okf, gf, -1)
+            threshold[t, sl] = np.where(
+                okf,
+                edges[gf, np.minimum(bb_h.reshape(-1), edges.shape[1] - 1)],
+                0.0,
+            )
+
+
+@partial(jax.jit, static_argnames=("n_buckets", "local", "cap"))
+def _node_totals_bucketed(
+    rel_loc: jax.Array,   # (n2,)
+    stats3: jax.Array,    # (3, n2)
+    bucket_of: jax.Array, # (n2,)
+    n_buckets: int,
+    local: int,
+    cap: int,
+):
+    """(n_buckets, local, 3) per-node stat sums via bucket-blocked one-hot
+    contraction (cap rows per bucket are contiguous)."""
+    st = stats3.reshape(3, n_buckets, cap)
+    rl = rel_loc.reshape(n_buckets, cap)
+    on = (
+        rl[:, None, :] == jnp.arange(local, dtype=rl.dtype)[None, :, None]
+    ).astype(stats3.dtype)  # (n_buckets, local, cap)
+    return jnp.einsum(
+        "blc,sbc->bls", on, st, preferred_element_type=jnp.float32
+    )
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _route_bucketed(
+    sub: jax.Array,       # (f_pad, n2)
+    rel_loc: jax.Array,   # (n2,)
+    bucket_of: jax.Array, # (n2,)
+    bf: jax.Array,        # (n_buckets, local)
+    bb: jax.Array,
+    ok: jax.Array,
+    cap: int,
+):
+    n_buckets, local = bf.shape
+    f_pad = sub.shape[0]
+    sel = (
+        bf[:, :, None] == jnp.arange(f_pad, dtype=bf.dtype)[None, None, :]
+    ).astype(jnp.float32)
+    sub_b = sub.reshape(f_pad, n_buckets, cap).astype(jnp.float32)
+    sel_bins = jnp.einsum(
+        "blf,fbc->blc", sel, sub_b, preferred_element_type=jnp.float32
+    ).astype(jnp.int32)  # (n_buckets, local, cap)
+    rl = rel_loc.reshape(n_buckets, cap)
+    on = rl[:, None, :] == jnp.arange(local, dtype=rl.dtype)[None, :, None]
+    act = on & ok[:, :, None]
+    go = (act & (sel_bins > bb[:, :, None])).any(axis=1)
+    stays = act.any(axis=1)
+    new = jnp.where(stays, 2 * rl + go.astype(jnp.int32), 2 * local)
+    return new.reshape(-1)
+
+
+def grow_forest_mxu(
+    bins_fm: jax.Array,     # (D, N_pad) int8 feature-major binned features
+    base_stats: jax.Array,  # (S, N_pad) f32 unweighted stat rows (see below)
+    w_trees: jax.Array,     # (T, N_pad) f32 per-tree bootstrap*mask weights
+    stats3: jax.Array,      # (3, N_pad) f32 (1, y, y^2)*mask rows (reg) or None
+    edges: np.ndarray,      # (D, B-1) raw-space bin edges
+    max_depth: int,
+    n_bins: int,
+    kind: str,              # "gini" | "entropy" | "regression"
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+    seed: int,
+    y_vals: jax.Array = None,
+    interpret: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Grow T trees; returns grow_forest's host-array contract:
+    (features (T, M), thresholds, leaf_values (T, M, V), n_samples,
+    impurities).
+
+    base_stats rows: regression -> (1*mask, y*mask); classification ->
+    per-class one-hot rows (S = n_classes).  stats3 supplies the per-node
+    impurity stats for regression (ignored for classification).  y_vals
+    (raw target / class index per row) is required when max_depth exceeds
+    the shallow slot budget — the deep phase rebuilds stats from it after
+    the bucket sort."""
+    T, n_pad = w_trees.shape
+    D = bins_fm.shape[0]
+    S = base_stats.shape[0]
+    V = 1 if kind == "regression" else S
+    assert n_pad % _ROW_TILE == 0
+    assert mxu_depth_supported(max_depth, S), "depth exceeds MXU slot budget"
+    l_s = _shallow_levels(S)
+    shallow_top = min(max_depth, l_s)
+    if max_depth > l_s:
+        assert y_vals is not None, "deep growth needs y_vals"
+
+    M = 2 ** (max_depth + 1) - 1
+    feature = np.full((T, M), -1, np.int32)
+    threshold = np.zeros((T, M), np.float32)
+    leaf_value = np.zeros((T, M, V), np.float32)
+    n_samples = np.zeros((T, M), np.float32)
+    impurity = np.zeros((T, M), np.float32)
+
+    rng = np.random.default_rng(seed)
+    F = int(max_features)
+    f_pad = -(-max(F, 1) // _F_BLOCK) * _F_BLOCK
+    rel = jnp.zeros((T, n_pad), jnp.int32)
+
+    for level in range(shallow_top + 1):
+        nodes = 2**level
+        is_last = level == max_depth
+        tpack = max(1, min(T, M_SLOTS // (nodes * S)))
+        base = 2**level - 1
+        for g0 in range(0, T, tpack):
+            g1 = min(g0 + tpack, T)
+            tp = g1 - g0
+            rel_g = rel[g0:g1]
+            w_g = w_trees[g0:g1]
+            # per-node (w, wy, wy2) totals: the regression gain needs them
+            # every level; classification derives its totals from the
+            # histogram, so it only computes them at the leaf level
+            if kind == "regression":
+                tot = _node_totals(
+                    rel_g, stats3[None, :, :] * w_g[:, None, :], nodes
+                )
+            else:
+                tot = None
+                if is_last:
+                    cls_tot = _node_totals(
+                        rel_g, base_stats[None, :, :] * w_g[:, None, :], nodes
+                    )
+            if is_last:
+                # leaf level: values/impurities only, no split search
+                if kind == "regression":
+                    tot_h = np.asarray(tot)
+                    w_n = np.maximum(tot_h[:, :, 0], 1e-12)
+                    val = (tot_h[:, :, 1] / w_n)[:, :, None]
+                    imp = np.maximum(
+                        tot_h[:, :, 2] / w_n - (tot_h[:, :, 1] / w_n) ** 2, 0.0
+                    )
+                else:
+                    cls_h = np.asarray(cls_tot)
+                    w_n = np.maximum(cls_h.sum(axis=2), 1e-12)
+                    val = cls_h / w_n[:, :, None]
+                    if kind == "entropy":
+                        imp = -(val * np.log2(np.maximum(val, 1e-12))).sum(2)
+                    else:
+                        imp = 1.0 - (val * val).sum(axis=2)
+                sl = slice(base, base + nodes)
+                n_samples[g0:g1, sl] = tot_h[:, :, 0] if kind == "regression" else cls_h.sum(2)
+                impurity[g0:g1, sl] = imp
+                leaf_value[g0:g1, sl] = val
+                continue
+
+            feats_np = rng.choice(D, F, replace=False).astype(np.int32)
+            feats = jnp.asarray(feats_np)
+            chunk = 16384 if n_pad % 16384 == 0 else _ROW_TILE
+            sub = gather_rows_matmul(bins_fm, feats, f_pad=f_pad, chunk=chunk)
+            stats_s = _stats_rows(base_stats, w_g, tp, S)
+            H = node_histograms(
+                sub, rel_g, stats_s, t_pack=tp, nodes=nodes, s_dim=S,
+                n_bins=n_bins, interpret=interpret,
+            )
+            feat_valid = jnp.arange(f_pad) < F
+            bf, bb, ok, p_w, p_imp, p_val = _split_from_hist(
+                H, tot, feat_valid, tp, nodes, S, kind,
+                float(min_samples_leaf), float(min_impurity_decrease),
+            )
+            new_rel = _route(sub, rel_g, bf, bb, ok)
+            rel = rel.at[g0:g1].set(new_rel)
+            bf_h, bb_h, ok_h, pw_h, pi_h, pv_h = jax.device_get(
+                (bf, bb, ok, p_w, p_imp, p_val)
+            )
+            gf = feats_np[np.minimum(bf_h, F - 1)]
+            sl = slice(base, base + nodes)
+            n_samples[g0:g1, sl] = pw_h
+            impurity[g0:g1, sl] = pi_h
+            leaf_value[g0:g1, sl] = pv_h
+            feature[g0:g1, sl] = np.where(ok_h, gf, -1)
+            threshold[g0:g1, sl] = np.where(
+                ok_h,
+                edges[gf, np.minimum(bb_h, edges.shape[1] - 1)],
+                0.0,
+            )
+    if max_depth > l_s:
+        _deep_phase(
+            rel, bins_fm, w_trees, y_vals, edges,
+            (feature, threshold, leaf_value, n_samples, impurity), rng,
+            bucket_level=l_s + 1, max_depth=max_depth, n_bins=n_bins,
+            kind=kind, s_dim=S, max_features=F,
+            min_samples_leaf=float(min_samples_leaf),
+            min_impurity_decrease=float(min_impurity_decrease),
+            interpret=interpret,
+        )
+    return feature, threshold, leaf_value, n_samples, impurity

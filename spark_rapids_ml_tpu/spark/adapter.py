@@ -200,6 +200,16 @@ def barrier_fit_estimator(
     from ..parallel import runner
 
     num_workers = infer_spark_num_workers(estimator, sdf.sparkSession)
+    # fail fast ON THE DRIVER for estimators that cannot run multi-process —
+    # the executor-side check would surface as N opaque task tracebacks
+    if num_workers > 1 and not getattr(
+        estimator, "_supports_multicontroller_fit", True
+    ):
+        raise NotImplementedError(
+            f"{type(estimator).__name__} does not yet support multi-process "
+            "(barrier) training. Train with num_workers=1 or "
+            "SRML_SPARK_COLLECT=1 (driver-local fit)."
+        )
 
     def _closure(partitions, rank, nranks, control_plane):
         return runner.run_distributed_fit(

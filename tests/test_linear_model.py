@@ -186,3 +186,27 @@ def test_evaluator_metrics_match_sklearn():
         assert abs(got - skfn(y, preds)) < 1e-6, name
     rmse = RegressionEvaluator(metricName="rmse").evaluate(out)
     assert abs(rmse - np.sqrt(mean_squared_error(y, preds))) < 1e-6
+
+
+def test_f64_fit_matches_sklearn_at_f64_only_tolerance():
+    """float32_inputs=False on float64 data must genuinely compute in f64
+    (VERDICT r1 item 5: device_put silently downcast to f32 before).  The
+    1e-10 coefficient tolerance is unreachable in float32."""
+    from sklearn.linear_model import LinearRegression as SkLinearRegression
+
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((400, 7))          # float64
+    w = rng.standard_normal(7)
+    y = X @ w + 0.01 * rng.standard_normal(400)
+    assert X.dtype == np.float64
+    df = DataFrame.from_numpy(X, y)
+    est = LinearRegression(float32_inputs=False, standardization=False)
+    model = est.fit(df)
+    sk = SkLinearRegression().fit(X, y)
+    np.testing.assert_allclose(np.asarray(model.coef_), sk.coef_, atol=1e-10)
+    np.testing.assert_allclose(
+        float(model.intercept_), float(sk.intercept_), atol=1e-10
+    )
+    # and the f32 path demonstrably CANNOT hit this tolerance
+    m32 = LinearRegression(standardization=False).fit(df)
+    assert np.abs(np.asarray(m32.coef_) - sk.coef_).max() > 1e-9
