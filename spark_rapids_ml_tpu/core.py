@@ -79,11 +79,43 @@ def _partition_feature_block(part: pd.DataFrame, input_col: str):
     if block is None or block.shape[0] != len(part) or len(part) == 0:
         return None
     col = part[input_col]
+    if hasattr(block, "tocsr"):
+        # sparse CSR block: the placeholder column holds local row positions
+        # (DataFrame.from_numpy); any row slice/reorder breaks the 0..n-1
+        # run and the stale block is rejected
+        if int(col.iloc[0]) == 0 and int(col.iloc[-1]) == len(part) - 1:
+            return block
+        return None
     if np.array_equal(col.iloc[0], block[0]) and np.array_equal(
         col.iloc[-1], block[-1]
     ):
         return block
     return None
+
+def extract_partition_features(
+    part: pd.DataFrame,
+    input_col: Optional[str],
+    input_cols: Optional[List[str]],
+    dtype: np.dtype,
+    densify_sparse: bool = True,
+):
+    """Feature matrix for one partition, honoring a stashed feature block —
+    dense 2-D or sparse CSR (DataFrame.from_numpy).  Model-side consumers
+    (transform-evaluate, kneighbors ingest) MUST use this instead of reading
+    the column directly: sparse partitions carry a placeholder column whose
+    cells are row positions, not features."""
+    if input_col is not None:
+        block = _partition_feature_block(part, input_col)
+        if block is not None and hasattr(block, "tocsr"):
+            if densify_sparse:
+                return np.asarray(block.toarray(), dtype=dtype)
+            return block
+        if block is not None:
+            return np.asarray(block, dtype=dtype)
+        return stack_feature_cells(part[input_col].tolist(), dtype)
+    assert input_cols is not None
+    return np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+
 
 _SinglePdDataFrameBatchType = Tuple[pd.DataFrame, Optional[pd.DataFrame]]
 
@@ -125,8 +157,12 @@ class _TpuCaller(_TpuParams):
             if len(part) == 0:
                 continue
             if input_col is not None:
-                cell = np.asarray(part[input_col].iloc[0])
-                dt = cell.dtype
+                block = _partition_feature_block(part, input_col)
+                if block is not None:
+                    dt = block.dtype  # also covers sparse CSR blocks, whose
+                    # placeholder column would misreport int64
+                else:
+                    dt = np.asarray(part[input_col].iloc[0]).dtype
             else:
                 assert input_cols is not None
                 dt = np.result_type(*(part[c].dtype for c in input_cols))
@@ -140,6 +176,14 @@ class _TpuCaller(_TpuParams):
     ) -> np.ndarray:
         if input_col is not None:
             block = _partition_feature_block(part, input_col)
+            if block is not None and hasattr(block, "tocsr"):
+                if self._supports_sparse_input:
+                    return block  # CSR stays sparse through to ELL ingest
+                get_logger(type(self)).warning(
+                    "%s has no sparse path; densifying the CSR partition",
+                    type(self).__name__,
+                )
+                return np.asarray(block.toarray(), dtype=dtype)
             if block is not None:
                 return np.asarray(block, dtype=dtype)
             cells = part[input_col].tolist()
@@ -220,6 +264,24 @@ class _TpuCaller(_TpuParams):
         cached = _FIT_INPUT_CACHE.get("slot")
         if cached is not None and cached[0] == cache_key:
             Xs, n_rows, n_cols, _host_refs = cached[1]
+        elif any(hasattr(f, "tocsr") for f in nonempty):
+            # sparse ingest: CSR partitions -> one padded ELL pair, row-
+            # sharded like a dense block (ops/sparse.py).  No densification
+            # at any point; nnz is the memory footprint.
+            import scipy.sparse as sp
+
+            from .ops.sparse import ell_device_from_scipy
+
+            _FIT_INPUT_CACHE.pop("slot", None)
+            csr = sp.vstack(nonempty).tocsr() if len(nonempty) > 1 else nonempty[0]
+            n_rows, n_cols = csr.shape
+            with profiling.phase("srml.device_put"):
+                Xs = ell_device_from_scipy(csr, dtype=dtype, mesh=mesh)
+            if cacheable:
+                _FIT_INPUT_CACHE["slot"] = (
+                    cache_key,
+                    (Xs, n_rows, n_cols, list(nonempty)),
+                )
         else:
             # free the previous slot's device arrays BEFORE allocating the
             # new dataset so peak HBM is one dataset, not two
@@ -517,7 +579,12 @@ class _TpuModel(_TpuParams):
                 if input_col is not None
                 else None
             )
-            if block is not None:
+            if block is not None and hasattr(block, "tocsr"):
+                if self._supports_sparse_input:
+                    feats = block  # model transform converts CSR -> ELL
+                else:
+                    feats = np.asarray(block.toarray(), dtype=dtype)
+            elif block is not None:
                 feats = np.asarray(block, dtype=dtype)
             elif input_col is not None:
                 feats = stack_feature_cells(part[input_col].tolist(), dtype)

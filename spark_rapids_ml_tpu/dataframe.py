@@ -126,7 +126,31 @@ class DataFrame:
         weightCol: str = "weight",
     ) -> "DataFrame":
         if hasattr(X, "toarray") and hasattr(X, "tocsr"):  # scipy sparse
-            X = X.toarray()
+            # Kept SPARSE: each partition carries a CSR block in .attrs and a
+            # local-row-position placeholder column (the guard in
+            # core._partition_feature_block keys on it).  Estimators that
+            # support sparse input (the GLMs) ingest the CSR without
+            # densification (reference sparse qn path,
+            # classification.py:1206-1218); others densify per partition.
+            if feature_layout not in ("array", "vector"):
+                raise ValueError(
+                    "sparse X requires feature_layout='array'/'vector'"
+                )
+            csr = X.tocsr()
+            col = featuresCol if isinstance(featuresCol, str) else featuresCol[0]
+            n = csr.shape[0]
+            bounds = np.linspace(0, n, max(1, num_partitions) + 1, dtype=int)
+            parts = []
+            for lo, hi in zip(bounds[:-1], bounds[1:]):
+                block = csr[lo:hi]
+                pdf = pd.DataFrame({col: np.arange(hi - lo, dtype=np.int64)})
+                if y is not None:
+                    pdf[labelCol] = np.asarray(y)[lo:hi]
+                if weight is not None:
+                    pdf[weightCol] = np.asarray(weight)[lo:hi]
+                pdf.attrs[FEATURE_BLOCK_ATTR] = _FeatureBlock({col: block})
+                parts.append(pdf)
+            return cls(parts)
         X = np.asarray(X)
         if feature_layout in ("array", "vector"):
             # Build partitions directly so each carries a contiguous 2-D
@@ -270,8 +294,9 @@ class DataFrame:
         return pa.Table.from_pandas(self.toPandas(), preserve_index=False)
 
     def collect(self) -> List[Row]:
-        pdf = self.toPandas()
-        return [Row({c: row[c] for c in pdf.columns}) for _, row in pdf.iterrows()]
+        # to_dict("records") is vectorized per column; iterrows would build a
+        # pandas Series per row (O(n) Python-object overhead per row)
+        return [Row(d) for d in self.toPandas().to_dict("records")]
 
     def first(self) -> Optional[Row]:
         for p in self._partitions:

@@ -49,7 +49,7 @@ from ..ops.glm import (
     solve_elasticnet_cd,
     solve_linear,
 )
-from ..utils import get_logger, stack_feature_cells
+from ..utils import get_logger
 
 
 class _RegressionModelEvaluationMixIn:
@@ -74,10 +74,9 @@ class _RegressionModelEvaluationMixIn:
         for part in df.partitions:
             if len(part) == 0:
                 continue
-            if input_col is not None:
-                feats = stack_feature_cells(part[input_col].tolist(), dtype)
-            else:
-                feats = np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+            from ..core import extract_partition_features
+
+            feats = extract_partition_features(part, input_col, input_cols, dtype)
             labels = part[label_col].to_numpy()
             preds = predict_all(feats)  # (num_models, n)
             for i in range(num_models):
@@ -150,6 +149,10 @@ class _LinearRegressionParams(
     HasWeightCol,
     HasVerbose,
 ):
+    # CSR input fits/transforms without densification via the ELL kernels
+    # (ops/sparse.py: chunk-densified MXU Gram pass)
+    _supports_sparse_input = True
+
     loss = Param(_dummy(), "loss", "the loss function to be optimized (squaredError)", TypeConverters.toString)
     solver = Param(_dummy(), "solver", "the solver algorithm (auto|normal|eig)", TypeConverters.toString)
     aggregationDepth = Param(_dummy(), "aggregationDepth", "suggested depth for treeAggregate", TypeConverters.toInt)
@@ -249,9 +252,19 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
             assert inputs.y is not None
-            stats = linreg_sufficient_stats(
-                inputs.X, inputs.y, inputs.weight, mesh=inputs.mesh
-            )
+            from ..ops.sparse import EllMatrix, ell_sufficient_stats
+
+            if isinstance(inputs.X, EllMatrix):
+                # CSR ingest: chunk-densify + MXU Gram pass, never the whole
+                # matrix (ops/sparse.py); downstream solves are unchanged —
+                # the sufficient statistics are dense either way
+                stats = ell_sufficient_stats(
+                    inputs.X, inputs.y, inputs.weight, mesh=inputs.mesh
+                )
+            else:
+                stats = linreg_sufficient_stats(
+                    inputs.X, inputs.y, inputs.weight, mesh=inputs.mesh
+                )
             if extra_params:
                 results = []
                 for override in extra_params:
@@ -333,9 +346,13 @@ class LinearRegressionModel(
         pred_col = self.getOrDefault("predictionCol")
 
         def _transform(features: np.ndarray) -> Dict[str, Any]:
-            preds = linear_predict_kernel(
-                jax.device_put(np.asarray(features, dtype=np_dtype)), coef, intercept
-            )
+            if hasattr(features, "tocsr"):  # CSR partition -> device ELL
+                from ..ops.sparse import ell_device_from_scipy
+
+                Xd = ell_device_from_scipy(features, np_dtype)
+            else:
+                Xd = jax.device_put(np.asarray(features, dtype=np_dtype))
+            preds = linear_predict_kernel(Xd, coef, intercept)
             return {pred_col: np.asarray(preds, dtype=np.float64)}
 
         return _transform

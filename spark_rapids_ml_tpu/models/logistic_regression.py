@@ -52,7 +52,7 @@ from ..ops.logistic import (
     scores_to_labels,
     scores_to_probs,
 )
-from ..utils import get_logger, stack_feature_cells
+from ..utils import get_logger
 
 
 class _ClassificationModelEvaluationMixIn:
@@ -80,10 +80,9 @@ class _ClassificationModelEvaluationMixIn:
         for part in df.partitions:
             if len(part) == 0:
                 continue
-            if input_col is not None:
-                feats = stack_feature_cells(part[input_col].tolist(), dtype)
-            else:
-                feats = np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+            from ..core import extract_partition_features
+
+            feats = extract_partition_features(part, input_col, input_cols, dtype)
             labels = part[label_col].to_numpy()
             preds_all, probs_all = predict_all(feats)  # (M, n), (M, n, C)
             for i in range(num_models):
@@ -164,6 +163,10 @@ class _LogisticRegressionParams(
 ):
     family = Param(_dummy(), "family", "the name of family (auto|binomial|multinomial); detected automatically", TypeConverters.toString)
     threshold = Param(_dummy(), "threshold", "binary classification threshold", TypeConverters.toFloat)
+
+    # CSR input fits/transforms without densification via the ELL kernels
+    # (ops/sparse.py; reference sparse qn, classification.py:1206-1218)
+    _supports_sparse_input = True
 
     def __init__(self, *args: Any, **kwargs: Any) -> None:
         super().__init__(*args, **kwargs)
@@ -434,9 +437,13 @@ class LogisticRegressionModel(
         raw_col = self.getOrDefault("rawPredictionCol")
 
         def _transform(features: np.ndarray) -> Dict[str, Any]:
-            scores = logistic_decision_kernel(
-                jax.device_put(np.asarray(features, np_dtype)), W, b
-            )
+            if hasattr(features, "tocsr"):  # CSR partition -> device ELL
+                from ..ops.sparse import ell_device_from_scipy
+
+                Xd = ell_device_from_scipy(features, np_dtype)
+            else:
+                Xd = jax.device_put(np.asarray(features, np_dtype))
+            scores = logistic_decision_kernel(Xd, W, b)
             probs = np.asarray(scores_to_probs(scores, num_classes), np.float64)
             idx = np.asarray(
                 scores_to_labels(scores, num_classes), np.int64

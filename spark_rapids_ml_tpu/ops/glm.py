@@ -192,6 +192,10 @@ def solve_elasticnet_cd(
 
 @jax.jit
 def linear_predict_kernel(X: jax.Array, coef: jax.Array, intercept: jax.Array) -> jax.Array:
+    from .sparse import EllMatrix, ell_matvec
+
+    if isinstance(X, EllMatrix):
+        return ell_matvec(X, coef) + intercept
     return exact_matmul(X, coef) + intercept
 
 

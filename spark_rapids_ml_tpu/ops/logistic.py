@@ -33,9 +33,22 @@ def _unpack(theta: jax.Array, k: int, d: int, fit_intercept: bool):
     return W, b
 
 
+def _model_scores(X, W, b):
+    """X @ W.T + b for dense (N, D) or ELL sparse X (ops/sparse.py): the
+    sparse form is a W-row gather whose jax.grad transpose is the
+    scatter-add X.T @ r — one code path for both L-BFGS objectives, no
+    densification of sparse inputs (reference sparse qn fit,
+    classification.py:1206-1218)."""
+    from .sparse import EllMatrix, ell_matmat
+
+    if isinstance(X, EllMatrix):
+        return ell_matmat(X, W.T) + b
+    return X @ W.T + b
+
+
 def _binary_data_loss(theta, X, y01, w, d, fit_intercept):
     W, b = _unpack(theta, 1, d, fit_intercept)
-    z = X @ W[0] + b[0]
+    z = _model_scores(X, W, b)[:, 0]
     # logloss via logaddexp for stability: y in {0,1}
     ll = jnp.logaddexp(0.0, z) - y01 * z
     return (ll * w).sum() / w.sum()
@@ -43,7 +56,7 @@ def _binary_data_loss(theta, X, y01, w, d, fit_intercept):
 
 def _softmax_data_loss(theta, X, yidx, w, k, d, fit_intercept):
     W, b = _unpack(theta, k, d, fit_intercept)
-    z = X @ W.T + b  # (N, K)
+    z = _model_scores(X, W, b)  # (N, K)
     logp = z - jax.scipy.special.logsumexp(z, axis=1, keepdims=True)
     ll = -jnp.take_along_axis(logp, yidx[:, None], axis=1)[:, 0]
     return (ll * w).sum() / w.sum()
@@ -108,7 +121,12 @@ def logistic_decision_kernel(X: jax.Array, W: jax.Array, b: jax.Array) -> jax.Ar
 
     Raw decision scores (N, k): k == 1 column for binary, k columns for
     multinomial (matches cuML decision_function semantics used by the
-    reference transform, classification.py:1236-1262)."""
+    reference transform, classification.py:1236-1262).  Accepts dense or
+    ELL sparse feature blocks."""
+    from .sparse import EllMatrix
+
+    if isinstance(X, EllMatrix):
+        return _model_scores(X, W, b)
     return exact_matmul(X, W.T) + b
 
 

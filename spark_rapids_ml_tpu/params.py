@@ -516,6 +516,10 @@ class _TpuParams(_TpuClass, Params):
     _tpu_params: Dict[str, Any]
     _num_workers: Optional[int] = None
     _float32_inputs: bool = True
+    # estimators/models with a real sparse (CSR -> ELL) compute path set this
+    # True (the GLMs, mirroring cuML's sparse qn fit); everything else
+    # densifies sparse input partition-by-partition with a warning
+    _supports_sparse_input: bool = False
 
     @property
     def tpu_params(self) -> Dict[str, Any]:

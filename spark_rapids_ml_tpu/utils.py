@@ -93,6 +93,15 @@ def stack_feature_cells(cells: Any, dtype: np.dtype) -> np.ndarray:
     if n == 0:
         return np.zeros((0, 0), dtype=dtype)
     first = cells[0]
+    if np.ndim(first) == 0 and np.issubdtype(np.asarray(first).dtype, np.integer):
+        # scalar-int cells are the sparse-block placeholder column written by
+        # DataFrame.from_numpy(csr) — fail loudly instead of returning row
+        # positions as "features"
+        raise TypeError(
+            "feature column holds sparse-block placeholders, not vectors; "
+            "read this partition via core.extract_partition_features (its "
+            "features live in a CSR block in partition .attrs)"
+        )
     if hasattr(first, "toArray"):  # pyspark Vector cells
         size = len(first)
         out = np.zeros((n, size), dtype=dtype)
