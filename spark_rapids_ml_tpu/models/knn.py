@@ -32,7 +32,6 @@ from ..params import (
 )
 from ..parallel.mesh import get_mesh
 from ..ops.knn import knn_search
-from ..utils import stack_feature_cells
 
 
 class NearestNeighborsClass(_TpuParams):
@@ -121,15 +120,18 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         self._item_df = item_df
 
     def _extract_features(self, df: DataFrame, dtype) -> np.ndarray:
+        from ..core import extract_partition_features
+
         input_col, input_cols = self._get_input_columns()
         parts = []
         for part in df.partitions:
             if len(part) == 0:
                 continue
-            if input_col is not None:
-                parts.append(stack_feature_cells(part[input_col].tolist(), dtype))
-            else:
-                parts.append(np.asarray(part[input_cols].to_numpy(), dtype=dtype))
+            # block-aware: sparse CSR partitions densify here (kNN's brute
+            # distance kernel is dense)
+            parts.append(
+                extract_partition_features(part, input_col, input_cols, dtype)
+            )
         if not parts:
             return np.zeros((0, 0), dtype=dtype)
         return np.concatenate(parts, axis=0)
@@ -174,24 +176,57 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         (reference knn.py:604-672; structs here are dicts of the source
         rows)."""
         id_col = self.getIdCol()
+        # sparse-built DataFrames carry a placeholder features column (row
+        # positions, not vectors; see DataFrame.from_numpy) — building join
+        # structs from it would silently emit indices as "features"
+        from ..dataframe import FEATURE_BLOCK_ATTR
+
+        for df_ in (self._item_df, as_dataframe(query_df)):
+            for part in df_.partitions:
+                holder = part.attrs.get(FEATURE_BLOCK_ATTR)
+                if holder is not None and any(
+                    hasattr(b, "tocsr") for b in holder.blocks.values()
+                ):
+                    raise TypeError(
+                        "exactNearestNeighborsJoin does not support "
+                        "sparse-built DataFrames (their feature column is a "
+                        "placeholder); densify the input first"
+                    )
         item_df, query_df_withid, knn_df = self.kneighbors(query_df)
         item_pdf = item_df.toPandas().set_index(id_col, drop=False)
         query_pdf = query_df_withid.toPandas().set_index(id_col, drop=False)
         drop_generated = not self.isDefined("idCol")
-        rows = []
-        for _, row in knn_df.toPandas().iterrows():
-            qid = row[f"query_{id_col}"]
-            q_struct = query_pdf.loc[qid].to_dict()
-            if drop_generated:
-                q_struct.pop(id_col, None)
-            for item_id, dist in zip(row["indices"], row["distances"]):
-                i_struct = item_pdf.loc[item_id].to_dict()
-                if drop_generated:
-                    i_struct.pop(id_col, None)
-                rows.append(
-                    {"item_df": i_struct, "query_df": q_struct, distCol: float(dist)}
-                )
-        return DataFrame.from_pandas(pd.DataFrame(rows), query_df_withid.num_partitions)
+        # fully vectorized explode: positional id->row maps + one
+        # to_dict("records") per side (the per-element iterrows/.loc loop
+        # this replaces was O(n*k) Python-object work — unusable at the
+        # reference's scale, where the same result is two Spark joins,
+        # knn.py:604-672)
+        knn_pdf = knn_df.toPandas()
+        cols = ["item_df", "query_df", distCol]
+        if len(knn_pdf) == 0:
+            return DataFrame.from_pandas(
+                pd.DataFrame({c: [] for c in cols}), query_df_withid.num_partitions
+            )
+        qids = knn_pdf[f"query_{id_col}"].to_numpy()
+        ind = np.asarray(knn_pdf["indices"].tolist())
+        dist = np.asarray(knn_pdf["distances"].tolist(), dtype=np.float64)
+        k = ind.shape[1]
+        q_side = query_pdf.drop(columns=[id_col]) if drop_generated else query_pdf
+        i_side = item_pdf.drop(columns=[id_col]) if drop_generated else item_pdf
+        q_structs = q_side.iloc[query_pdf.index.get_indexer(qids)].to_dict("records")
+        i_structs = i_side.iloc[
+            item_pdf.index.get_indexer(ind.ravel())
+        ].to_dict("records")
+        out = pd.DataFrame(
+            {
+                "item_df": i_structs,
+                # one struct per query, shared by its k join rows (same
+                # sharing the per-row loop produced)
+                "query_df": np.repeat(np.asarray(q_structs, dtype=object), k),
+                distCol: dist.ravel(),
+            }
+        )
+        return DataFrame.from_pandas(out, query_df_withid.num_partitions)
 
     def _get_tpu_transform_func(self, dataset):  # pragma: no cover
         raise NotImplementedError(

@@ -610,20 +610,33 @@ class _RandomForestModelBase(_RandomForestParams, _TpuModelWithPredictionCol):
 
     def trees_to_dicts(self) -> List[Dict[str, Any]]:
         """Portable nested-dict forest export — the role the reference's
-        treelite JSON plays for translate_trees (utils.py:385-447)."""
+        treelite JSON plays for translate_trees (utils.py:385-447).
+
+        The dense node arrays are converted to Python lists ONCE per forest
+        (vectorized tolist) before the per-node walk: numpy scalar getitem
+        inside the recursion costs ~1 us x 5 arrays x 131k nodes per
+        depth-16 tree, which is felt the first time a 100-tree forest goes
+        through cpu()."""
+        feats = np.asarray(self.features_).tolist()
+        thr = np.asarray(self.thresholds_).tolist()
+        leaf = np.asarray(self.leaf_values_).tolist()
+        cnt = np.asarray(self.node_counts_).tolist()
+        imp = np.asarray(self.impurities_).tolist()
         out = []
-        for t in range(self.features_.shape[0]):
+        for t in range(len(feats)):
+            f, th, lv, ct, im = feats[t], thr[t], leaf[t], cnt[t], imp[t]
+
             def node_dict(i: int) -> Dict[str, Any]:
-                if self.features_[t, i] < 0:
+                if f[i] < 0:
                     return {
-                        "leaf_value": self.leaf_values_[t, i].tolist(),
-                        "instance_count": float(self.node_counts_[t, i]),
+                        "leaf_value": lv[i],
+                        "instance_count": float(ct[i]),
                     }
                 return {
-                    "split_feature": int(self.features_[t, i]),
-                    "threshold": float(self.thresholds_[t, i]),
-                    "gain": float(self.impurities_[t, i]),
-                    "instance_count": float(self.node_counts_[t, i]),
+                    "split_feature": int(f[i]),
+                    "threshold": float(th[i]),
+                    "gain": float(im[i]),
+                    "instance_count": float(ct[i]),
                     "yes": node_dict(2 * i + 1),
                     "no": node_dict(2 * i + 2),
                 }

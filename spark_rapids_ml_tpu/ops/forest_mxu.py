@@ -336,6 +336,10 @@ def _deep_phase(
     rel_loc = jnp.zeros((T, n2), jnp.int32)
     bucket_of = jnp.arange(n2, dtype=jnp.int32) // cap
 
+    # deferred host fetches, same rationale as the shallow phase: a
+    # device_get per (tree, level) would serialize T x levels round-trips
+    pending = []  # (tag, t, level_slice, device_arrays)
+
     for level in range(bucket_level, max_depth + 1):
         local = 2 ** (level - bucket_level)
         nodes_lvl = n_buckets * local
@@ -376,32 +380,17 @@ def _deep_phase(
                     n_bins=n_bins, interpret=interpret,
                 )  # (n_buckets, f_pad, slots_pad, B)
             if is_last:
-                # leaf level: totals only
-                if kind != "regression":
+                # leaf level: totals only (fetch deferred)
+                sl = slice(base, base + nodes_lvl)
+                if kind == "regression":
+                    pending.append(("leaf_reg", t, sl, node_tot))
+                else:
                     hist0 = (
                         H[:, 0, : local * s_dim, :]
                         .reshape(n_buckets * local, s_dim, n_bins)
                         .sum(-1)
-                    )
-                    tot_h = np.asarray(hist0)  # (nodes_lvl, S) class sums
-                if kind == "regression":
-                    th = np.asarray(node_tot).reshape(nodes_lvl, 3)
-                    w_n = np.maximum(th[:, 0], 1e-12)
-                    val = (th[:, 1] / w_n)[:, None]
-                    imp = np.maximum(th[:, 2] / w_n - (th[:, 1] / w_n) ** 2, 0.0)
-                    cnt = th[:, 0]
-                else:
-                    w_n = np.maximum(tot_h.sum(1), 1e-12)
-                    val = tot_h / w_n[:, None]
-                    if kind == "entropy":
-                        imp = -(val * np.log2(np.maximum(val, 1e-12))).sum(1)
-                    else:
-                        imp = 1.0 - (val * val).sum(1)
-                    cnt = tot_h.sum(1)
-                sl = slice(base, base + nodes_lvl)
-                n_samples[t, sl] = cnt
-                impurity[t, sl] = imp
-                leaf_value[t, sl] = val
+                    )  # (nodes_lvl, S) class sums
+                    pending.append(("leaf_cls", t, sl, hist0))
                 continue
             Hf = jnp.transpose(
                 H[:, :, : local * s_dim, :], (1, 0, 2, 3)
@@ -415,14 +404,45 @@ def _deep_phase(
                 sub_t, rel_loc[t], bucket_of, bf, bb, ok, cap
             )
             rel_loc = rel_loc.at[t].set(new_loc)
-            bf_h, bb_h, ok_h, pw_h, pi_h, pv_h = jax.device_get(
-                (bf, bb, ok, p_w, p_imp, p_val)
-            )
-            gf = feats_all[t][np.minimum(bf_h.reshape(-1), F - 1)]
             sl = slice(base, base + nodes_lvl)
+            pending.append(("split", t, sl, (bf, bb, ok, p_w, p_imp, p_val)))
+
+    # single host fetch for the whole deep phase
+    _drain_deep_pending(pending, feats_all, edges, outputs, kind, F)
+
+
+def _drain_deep_pending(pending, feats_all, edges, outputs, kind, F):
+    """One host fetch + numpy writes for all deferred deep-phase results
+    (shared by the bucketed and windowed deep phases)."""
+    feature, threshold, leaf_value, n_samples, impurity = outputs
+    fetched = jax.device_get([p[3] for p in pending])
+    for (tag, t, sl, _), got in zip(pending, fetched):
+        nodes_sl = sl.stop - sl.start
+        if tag == "leaf_reg":
+            th = np.asarray(got).reshape(nodes_sl, 3)
+            w_n = np.maximum(th[:, 0], 1e-12)
+            n_samples[t, sl] = th[:, 0]
+            impurity[t, sl] = np.maximum(
+                th[:, 2] / w_n - (th[:, 1] / w_n) ** 2, 0.0
+            )
+            leaf_value[t, sl] = (th[:, 1] / w_n)[:, None]
+        elif tag == "leaf_cls":
+            tot_h = np.asarray(got).reshape(nodes_sl, -1)
+            w_n = np.maximum(tot_h.sum(1), 1e-12)
+            val = tot_h / w_n[:, None]
+            if kind == "entropy":
+                imp = -(val * np.log2(np.maximum(val, 1e-12))).sum(1)
+            else:
+                imp = 1.0 - (val * val).sum(1)
+            n_samples[t, sl] = tot_h.sum(1)
+            impurity[t, sl] = imp
+            leaf_value[t, sl] = val
+        else:
+            bf_h, bb_h, ok_h, pw_h, pi_h, pv_h = got
+            gf = feats_all[t][np.minimum(bf_h.reshape(-1), F - 1)]
             n_samples[t, sl] = pw_h.reshape(-1)
             impurity[t, sl] = pi_h.reshape(-1)
-            leaf_value[t, sl] = pv_h.reshape(nodes_lvl, -1)
+            leaf_value[t, sl] = pv_h.reshape(nodes_sl, -1)
             okf = ok_h.reshape(-1)
             feature[t, sl] = np.where(okf, gf, -1)
             threshold[t, sl] = np.where(
@@ -430,6 +450,139 @@ def _deep_phase(
                 edges[gf, np.minimum(bb_h.reshape(-1), edges.shape[1] - 1)],
                 0.0,
             )
+
+
+@partial(jax.jit, static_argnames=("nw", "win"))
+def _window_occupancy(rel_t: jax.Array, nw: int, win: int) -> jax.Array:
+    """(nw,) bool: does any row's node id land in window w (ids
+    [w*win, (w+1)*win))?  Dead rows carry out-of-range sentinels and match
+    no window."""
+    wid = rel_t // win
+    return jax.vmap(lambda w: jnp.any(wid == w))(
+        jnp.arange(nw, dtype=rel_t.dtype)
+    )
+
+
+def _deep_phase_windowed(
+    rel: jax.Array,          # (T, n_pad) node ids AT bucket_level
+    bins_fm: jax.Array,
+    w_trees: jax.Array,
+    base_stats: jax.Array,   # (S, n_pad) unweighted stat rows
+    stats3: jax.Array,       # (3, n_pad) or None (classification)
+    edges: np.ndarray,
+    outputs,
+    rng: np.random.Generator,
+    *,
+    bucket_level: int,
+    max_depth: int,
+    n_bins: int,
+    kind: str,
+    s_dim: int,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+    interpret: bool = False,
+) -> None:
+    """Skew-immune deep growth: every level >= bucket_level is processed in
+    M_SLOTS//s_dim-node slot WINDOWS over the full (unsorted) row set — the
+    same node_histograms kernel as the shallow phase, with out-of-window
+    rows masked by the node-id shift.  Windows holding no rows are skipped
+    (one tiny occupancy fetch per level), which is what makes this the right
+    fallback when equal-cap bucketing bails out on skew: a skewed tree has
+    few live deep nodes, so almost all windows are dead.  Worst case
+    (perfectly bushy deep trees) streams the full row set once per live
+    window — the balanced case the bucketed phase exists for."""
+    T, n_pad = rel.shape
+    D = bins_fm.shape[0]
+    F = int(max_features)
+    f_pad = -(-max(F, 1) // _F_BLOCK) * _F_BLOCK
+    win = M_SLOTS // s_dim
+    feats_all = np.stack(
+        [rng.choice(D, F, replace=False).astype(np.int32) for _ in range(T)]
+    )
+    chunk = 16384 if n_pad % 16384 == 0 else _ROW_TILE
+    feat_valid = jnp.arange(f_pad) < F
+    pending = []
+    rel_t_list = [rel[t] for t in range(T)]
+    subs = [
+        gather_rows_matmul(
+            bins_fm, jnp.asarray(feats_all[t]), f_pad=f_pad, chunk=chunk
+        )
+        for t in range(T)
+    ]
+    # level-invariant per-tree stat rows, computed ONCE (the bucketed phase
+    # recomputes per level only because its sorted layout changes; this
+    # path's row order never does)
+    stats_trees = [base_stats * w_trees[t][None, :] for t in range(T)]
+    tot3_trees = (
+        [stats3 * w_trees[t][None, :] for t in range(T)]
+        if kind == "regression"
+        else [None] * T
+    )
+
+    for level in range(bucket_level, max_depth + 1):
+        nodes_lvl = 2**level
+        base = 2**level - 1
+        win_l = min(win, nodes_lvl)
+        nw = -(-nodes_lvl // win_l)
+        is_last = level == max_depth
+        occ_h = np.asarray(
+            jnp.stack(
+                [_window_occupancy(rel_t_list[t], nw, win_l) for t in range(T)]
+            )
+        )  # the one sync point of this level
+        for t in range(T):
+            rel_t = rel_t_list[t]
+            stats_t = stats_trees[t]
+            tot3_t = tot3_trees[t]
+            new_rel = None
+            for wi in range(nw):
+                if not occ_h[t, wi]:
+                    continue
+                w0 = wi * win_l
+                # the last window is clamped when win_l does not divide
+                # nodes_lvl (non-power-of-two s_dim): without the clamp its
+                # slice would spill into the next level's slot range and the
+                # dead-row sentinel (rel == nodes_lvl) would alias into it
+                win_eff = min(win_l, nodes_lvl - w0)
+                rel_sh = rel_t - w0
+                sl = slice(base + w0, base + w0 + win_eff)
+                node_tot = (
+                    _node_totals(rel_sh[None], tot3_t[None], win_eff)
+                    if kind == "regression"
+                    else None
+                )
+                if is_last:
+                    if kind == "regression":
+                        pending.append(("leaf_reg", t, sl, node_tot))
+                    else:
+                        cls_tot = _node_totals(rel_sh[None], stats_t[None], win_eff)
+                        pending.append(("leaf_cls", t, sl, cls_tot))
+                    continue
+                H = node_histograms(
+                    subs[t], rel_sh[None], stats_t, t_pack=1, nodes=win_eff,
+                    s_dim=s_dim, n_bins=n_bins, interpret=interpret,
+                )
+                bf, bb, ok, p_w, p_imp, p_val = _split_from_hist(
+                    H, node_tot, feat_valid, 1, win_eff, s_dim, kind,
+                    float(min_samples_leaf), float(min_impurity_decrease),
+                )
+                loc = _route(subs[t], rel_sh[None], bf, bb, ok)[0]
+                if new_rel is None:
+                    new_rel = jnp.full((n_pad,), 2 * nodes_lvl, jnp.int32)
+                # loc < 2*win_eff iff the row sits in THIS window under a
+                # node that kept splitting; +2*w0 restores the absolute
+                # child id
+                new_rel = jnp.where(loc < 2 * win_eff, loc + 2 * w0, new_rel)
+                pending.append(("split", t, sl, (bf, bb, ok, p_w, p_imp, p_val)))
+            if not is_last:
+                rel_t_list[t] = (
+                    new_rel
+                    if new_rel is not None
+                    else jnp.full((n_pad,), 2 * nodes_lvl, jnp.int32)
+                )
+
+    _drain_deep_pending(pending, feats_all, edges, outputs, kind, F)
 
 
 @partial(jax.jit, static_argnames=("n_buckets", "local", "cap"))
@@ -530,6 +683,15 @@ def grow_forest_mxu(
     f_pad = -(-max(F, 1) // _F_BLOCK) * _F_BLOCK
     rel = jnp.zeros((T, n_pad), jnp.int32)
 
+    # Host fetches are DEFERRED: every (level, group) appends its small
+    # result arrays here and one jax.device_get at the end of the phase
+    # collects them all.  A per-iteration device_get would block dispatch on
+    # a host<->device round-trip per group per level (hundreds of syncs for
+    # a deep forest — minutes of pure latency through a tunneled link);
+    # nothing on the host is needed inside the loop, since routing (rel)
+    # stays on device.
+    pending = []  # (tag, g0, g1, level_slice, feats_np, device_arrays)
+
     for level in range(shallow_top + 1):
         nodes = 2**level
         is_last = level == max_depth
@@ -555,25 +717,14 @@ def grow_forest_mxu(
                     )
             if is_last:
                 # leaf level: values/impurities only, no split search
-                if kind == "regression":
-                    tot_h = np.asarray(tot)
-                    w_n = np.maximum(tot_h[:, :, 0], 1e-12)
-                    val = (tot_h[:, :, 1] / w_n)[:, :, None]
-                    imp = np.maximum(
-                        tot_h[:, :, 2] / w_n - (tot_h[:, :, 1] / w_n) ** 2, 0.0
-                    )
-                else:
-                    cls_h = np.asarray(cls_tot)
-                    w_n = np.maximum(cls_h.sum(axis=2), 1e-12)
-                    val = cls_h / w_n[:, :, None]
-                    if kind == "entropy":
-                        imp = -(val * np.log2(np.maximum(val, 1e-12))).sum(2)
-                    else:
-                        imp = 1.0 - (val * val).sum(axis=2)
                 sl = slice(base, base + nodes)
-                n_samples[g0:g1, sl] = tot_h[:, :, 0] if kind == "regression" else cls_h.sum(2)
-                impurity[g0:g1, sl] = imp
-                leaf_value[g0:g1, sl] = val
+                pending.append(
+                    (
+                        "leaf_reg" if kind == "regression" else "leaf_cls",
+                        g0, g1, sl, None,
+                        tot if kind == "regression" else cls_tot,
+                    )
+                )
                 continue
 
             feats_np = rng.choice(D, F, replace=False).astype(np.int32)
@@ -592,11 +743,38 @@ def grow_forest_mxu(
             )
             new_rel = _route(sub, rel_g, bf, bb, ok)
             rel = rel.at[g0:g1].set(new_rel)
-            bf_h, bb_h, ok_h, pw_h, pi_h, pv_h = jax.device_get(
-                (bf, bb, ok, p_w, p_imp, p_val)
-            )
-            gf = feats_np[np.minimum(bf_h, F - 1)]
             sl = slice(base, base + nodes)
+            pending.append(
+                ("split", g0, g1, sl, feats_np, (bf, bb, ok, p_w, p_imp, p_val))
+            )
+
+    # single host fetch for the whole shallow phase
+    fetched = jax.device_get([p[5] for p in pending])
+    for (tag, g0, g1, sl, feats_np, _), got in zip(pending, fetched):
+        if tag == "leaf_reg":
+            tot_h = np.asarray(got)
+            w_n = np.maximum(tot_h[:, :, 0], 1e-12)
+            val = (tot_h[:, :, 1] / w_n)[:, :, None]
+            imp = np.maximum(
+                tot_h[:, :, 2] / w_n - (tot_h[:, :, 1] / w_n) ** 2, 0.0
+            )
+            n_samples[g0:g1, sl] = tot_h[:, :, 0]
+            impurity[g0:g1, sl] = imp
+            leaf_value[g0:g1, sl] = val
+        elif tag == "leaf_cls":
+            cls_h = np.asarray(got)
+            w_n = np.maximum(cls_h.sum(axis=2), 1e-12)
+            val = cls_h / w_n[:, :, None]
+            if kind == "entropy":
+                imp = -(val * np.log2(np.maximum(val, 1e-12))).sum(2)
+            else:
+                imp = 1.0 - (val * val).sum(axis=2)
+            n_samples[g0:g1, sl] = cls_h.sum(2)
+            impurity[g0:g1, sl] = imp
+            leaf_value[g0:g1, sl] = val
+        else:
+            bf_h, bb_h, ok_h, pw_h, pi_h, pv_h = got
+            gf = feats_np[np.minimum(bf_h, F - 1)]
             n_samples[g0:g1, sl] = pw_h
             impurity[g0:g1, sl] = pi_h
             leaf_value[g0:g1, sl] = pv_h
@@ -607,13 +785,28 @@ def grow_forest_mxu(
                 0.0,
             )
     if max_depth > l_s:
-        _deep_phase(
-            rel, bins_fm, w_trees, y_vals, edges,
-            (feature, threshold, leaf_value, n_samples, impurity), rng,
-            bucket_level=l_s + 1, max_depth=max_depth, n_bins=n_bins,
-            kind=kind, s_dim=S, max_features=F,
-            min_samples_leaf=float(min_samples_leaf),
-            min_impurity_decrease=float(min_impurity_decrease),
-            interpret=interpret,
-        )
+        try:
+            _deep_phase(
+                rel, bins_fm, w_trees, y_vals, edges,
+                (feature, threshold, leaf_value, n_samples, impurity), rng,
+                bucket_level=l_s + 1, max_depth=max_depth, n_bins=n_bins,
+                kind=kind, s_dim=S, max_features=F,
+                min_samples_leaf=float(min_samples_leaf),
+                min_impurity_decrease=float(min_impurity_decrease),
+                interpret=interpret,
+            )
+        except _DeepPhaseSkewError:
+            # skewed trees concentrate rows in few deep nodes — exactly the
+            # case where per-level slot windows over the unsorted rows are
+            # cheap (dead windows are skipped), while equal-cap bucketing
+            # would blow HBM.  Balanced forests stay on the bucketed path.
+            _deep_phase_windowed(
+                rel, bins_fm, w_trees, base_stats, stats3, edges,
+                (feature, threshold, leaf_value, n_samples, impurity), rng,
+                bucket_level=l_s + 1, max_depth=max_depth, n_bins=n_bins,
+                kind=kind, s_dim=S, max_features=F,
+                min_samples_leaf=float(min_samples_leaf),
+                min_impurity_decrease=float(min_impurity_decrease),
+                interpret=interpret,
+            )
     return feature, threshold, leaf_value, n_samples, impurity

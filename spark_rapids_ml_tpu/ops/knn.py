@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..parallel.mesh import DATA_AXIS, data_sharding
 
 
-def _grouped_topk(vals: jax.Array, k: int, group: int = 1024):
+def _grouped_topk_exact(vals: jax.Array, k: int, group: int = 1024):
     """Exact top-k over axis 1 via two-stage selection: top-k within
     `group`-wide column groups, then top-k over the ng*k survivors.
 
@@ -49,6 +49,49 @@ def _grouped_topk(vals: jax.Array, k: int, group: int = 1024):
     gidx = bi + (jnp.arange(ng, dtype=bi.dtype) * group)[None, :, None]
     fv, fi = jax.lax.top_k(bv.reshape(Qn, ng * k), k)
     return fv, jnp.take_along_axis(gidx.reshape(Qn, ng * k), fi, axis=1)
+
+
+def _topk_approx_verified(vals: jax.Array, k: int, group: int = 1024):
+    """approx_max_k + exactness verification: with t = the k-th returned
+    value, the returned VALUES are a true top-k multiset iff every entry
+    strictly above t was returned — i.e. per row,
+    #{vals > t} == #{returned > t}.  (Entries tied AT t are interchangeable:
+    any k-subset containing all strict ones is a correct top-k, the same
+    arbitrary tie-breaking every exact sort performs.)  A miss of a strict
+    entry leaves t below the true k-th value, breaking the equality.  The
+    check is one cheap VPU compare+sum pass over vals; batches that fail
+    fall back to the exact two-stage sort via lax.cond, so the result is
+    ALWAYS exact.  Tie-tolerance matters: a tie-sensitive check
+    (#{vals >= t} == k) would force the slow path for entire batches
+    whenever ANY row has duplicate distances at rank k — common with
+    duplicated items — or fewer than k finite candidates."""
+    av, ai = jax.lax.approx_max_k(vals, k, recall_target=0.99)
+    kth = av[:, -1]
+    strict_all = (vals > kth[:, None]).sum(axis=1)
+    strict_got = (av > kth[:, None]).sum(axis=1)
+    all_exact = jnp.all(strict_all == strict_got)
+
+    def exact(_):
+        return _grouped_topk_exact(vals, k, group)
+
+    def approx(_):
+        return av, ai
+
+    return jax.lax.cond(all_exact, approx, exact, None)
+
+
+def _grouped_topk(vals: jax.Array, k: int, group: int = 1024):
+    """Exact top-k, accelerated by the TPU's PartialReduce unit.
+
+    jax.lax.approx_max_k rides dedicated top-k hardware but only promises a
+    recall TARGET; _topk_approx_verified restores exactness with a
+    verification pass + exact fallback, so the common case pays ~hardware
+    top-k speed and the result is ALWAYS exact.  Narrow inputs and non-TPU
+    backends go straight to the exact two-stage sort."""
+    Qn, C = vals.shape
+    if C <= max(2048, 2 * k) or jax.default_backend() != "tpu":
+        return _grouped_topk_exact(vals, k, group)
+    return _topk_approx_verified(vals, k, group)
 
 
 # distance-tile budget (bytes of f32 tile per chunk) and the cap on the

@@ -229,12 +229,52 @@ def spectral_init(
     return emb
 
 
-@partial(jax.jit, static_argnames=("n_epochs", "negative_sample_rate"), donate_argnums=(0,))
-def optimize_layout(
-    embedding: jax.Array,   # (n, n_components) initial
-    heads: jax.Array,       # (E,) int32 edge sources
-    tails: jax.Array,       # (E,) int32 edge destinations
-    weights: jax.Array,     # (E,) membership strengths in [0, 1]
+def padded_head_layout(
+    heads: np.ndarray,
+    tails: np.ndarray,
+    weights: np.ndarray,
+    n: int,
+    cap: int = 48,
+):
+    """Static scatter-free edge layout for the SGD epochs: every undirected
+    edge becomes two directed edges, grouped by head and padded to a fixed
+    per-node degree `cap` (padding slots point at the node itself with
+    weight 0, so they fire never and their diff is zero).  Hub nodes beyond
+    `cap` keep their strongest edges — the truncation umap-learn's
+    epochs_per_sample schedule approximates anyway (weak edges of high-
+    degree nodes fire rarely).
+
+    Returns (tails_pad (n, P) int32, w_pad (n, P) f32)."""
+    h2 = np.concatenate([heads, tails]).astype(np.int64)
+    t2 = np.concatenate([tails, heads]).astype(np.int64)
+    w2 = np.concatenate([weights, weights]).astype(np.float32)
+    keep = w2 > 0
+    h2, t2, w2 = h2[keep], t2[keep], w2[keep]
+    # weight-descending within each head group so truncation drops the
+    # weakest edges
+    order = np.lexsort((-w2, h2))
+    h2, t2, w2 = h2[order], t2[order], w2[order]
+    counts = np.bincount(h2, minlength=n)
+    P = int(min(cap, max(1, counts.max())))
+    starts = np.cumsum(counts) - counts
+    pos = np.arange(h2.size) - np.repeat(starts, counts)
+    sel = pos < P
+    tails_pad = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, P))
+    w_pad = np.zeros((n, P), np.float32)
+    tails_pad[h2[sel], pos[sel]] = t2[sel].astype(np.int32)
+    w_pad[h2[sel], pos[sel]] = w2[sel]
+    return tails_pad, w_pad
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_epochs", "negative_sample_rate", "table_size"),
+    donate_argnums=(0,),
+)
+def optimize_layout_padded(
+    embedding: jax.Array,   # (n, c) initial
+    tails_pad: jax.Array,   # (n, P) int32 head-grouped directed edges
+    w_pad: jax.Array,       # (n, P) f32 membership strengths (0 = padding)
     a: float,
     b: float,
     n_epochs: int,
@@ -242,46 +282,51 @@ def optimize_layout(
     repulsion_strength: float,
     negative_sample_rate: int,
     seed: int,
+    table_size: int = 256,
 ) -> jax.Array:
-    """SGD layout: per epoch each edge fires with probability w (the
-    epochs_per_sample schedule as a bernoulli mask); attraction on (head,
-    tail) plus `negative_sample_rate` random repulsions per firing edge;
-    gradients clipped to [-4, 4] and scatter-added."""
-    n = embedding.shape[0]
-    E = heads.shape[0]
+    """Scatter-free SGD layout.  TPU scatter sustains ~10M updates/s, which
+    made the per-edge `.at[].add` epochs the UMAP bottleneck (round-1 bench:
+    0.26x floor).  Two reformulations remove every scatter:
+
+    - attraction runs in the padded head-grouped layout: the head side of
+      each edge is a free broadcast, per-edge gradients reduce onto their
+      head with a reshape-sum, and the symmetric tail update is the head
+      update of the reversed directed edge (the coefficient is symmetric in
+      d2, the difference antisymmetric).
+    - repulsion samples one shared `table_size` negative table per epoch
+      instead of S negatives per firing edge: every node repels the same
+      uniform table, scaled by its expected negative count
+      (S * fired_edges / M).  Same expectation as per-edge sampling, far
+      less variance in runtime: an (n, M, c) dense VPU computation replaces
+      an (E, S) gather + scatter.
+    """
+    n, c = embedding.shape
+    P = tails_pad.shape[1]
+    M = table_size
     key0 = jax.random.PRNGKey(seed)
+    flat_tails = tails_pad.reshape(-1)
 
     def epoch(e, emb):
         key = jax.random.fold_in(key0, e)
         k1, k2 = jax.random.split(key)
         alpha = learning_rate * (1.0 - e / n_epochs)
-        fire = jax.random.uniform(k1, (E,)) < weights
-        h = emb[heads]
-        t = emb[tails]
-        diff = h - t
-        d2 = (diff * diff).sum(axis=1)
-        # attraction gradient coefficient
+        t_emb = emb[flat_tails].reshape(n, P, c)
+        diff = emb[:, None, :] - t_emb
+        d2 = (diff * diff).sum(axis=2)
+        fire = jax.random.uniform(k1, (n, P)) < w_pad
         att = (-2.0 * a * b * d2 ** (b - 1.0)) / (1.0 + a * d2**b)
         att = jnp.where(d2 > 0, att, 0.0) * fire
-        g_att = jnp.clip(att[:, None] * diff, -4.0, 4.0)
-        upd = jnp.zeros_like(emb)
-        upd = upd.at[heads].add(g_att * alpha)
-        upd = upd.at[tails].add(-g_att * alpha)
+        upd = jnp.clip(att[:, :, None] * diff, -4.0, 4.0).sum(axis=1)
 
-        # negative samples: for each firing edge, S random points repel head
-        S = negative_sample_rate
-        neg = jax.random.randint(k2, (E, S), 0, n)
-        h_exp = h[:, None, :]
-        other = emb[neg]
-        diff_n = h_exp - other
+        tbl = emb[jax.random.randint(k2, (M,), 0, n)]
+        diff_n = emb[:, None, :] - tbl[None, :, :]
         d2n = (diff_n * diff_n).sum(axis=2)
         rep = (2.0 * repulsion_strength * b) / (
             (0.001 + d2n) * (1.0 + a * d2n**b)
         )
-        rep = rep * fire[:, None]
-        g_rep = jnp.clip(rep[:, :, None] * diff_n, -4.0, 4.0)
-        upd = upd.at[heads].add(g_rep.sum(axis=1) * alpha)
-        return emb + upd
+        g_rep = jnp.clip(rep[:, :, None] * diff_n, -4.0, 4.0).sum(axis=1)
+        scale = negative_sample_rate * fire.sum(axis=1).astype(emb.dtype) / M
+        return emb + alpha * (upd + scale[:, None] * g_rep)
 
     return jax.lax.fori_loop(0, n_epochs, epoch, embedding)
 
@@ -347,11 +392,11 @@ def umap_fit_embedding(
         # fuzzy graph, as umap-learn/cuml
         emb = spectral_init(knn_ids, W_graph, n_components, seed)
 
-    out = optimize_layout(
+    tails_pad, w_pad = padded_head_layout(heads, tails, weights, n)
+    out = optimize_layout_padded(
         jnp.asarray(emb),
-        jnp.asarray(heads),
-        jnp.asarray(tails),
-        jnp.asarray(weights),
+        jnp.asarray(tails_pad),
+        jnp.asarray(w_pad),
         a,
         b,
         int(n_epochs),
@@ -367,9 +412,8 @@ def umap_fit_embedding(
 def optimize_transform_layout(
     emb_q: jax.Array,      # (nq, c) query embedding (updated)
     ref_emb: jax.Array,    # (nr, c) training embedding (FIXED)
-    heads: jax.Array,      # (E,) int32 query indices
-    tails: jax.Array,      # (E,) int32 reference indices
-    weights: jax.Array,    # (E,) membership strengths in [0, 1]
+    tails: jax.Array,      # (nq, k) int32 reference neighbor indices
+    weights: jax.Array,    # (nq, k) membership strengths in [0, 1]
     a: float,
     b: float,
     n_epochs: int,
@@ -380,35 +424,33 @@ def optimize_transform_layout(
 ) -> jax.Array:
     """Refinement epochs of cuml/umap-learn transform: the query points run
     the same attract/repel SGD as fit, but only against the frozen training
-    embedding, and only the query side moves."""
+    embedding, and only the query side moves.  Each query's edge set IS its
+    k-neighbor row, so gradients reduce onto their query with a plain
+    axis-1 sum — scatter-free, like the padded fit layout."""
     nr = ref_emb.shape[0]
-    E = heads.shape[0]
+    nq, k = tails.shape
     key0 = jax.random.PRNGKey(seed)
 
     def epoch(e, emb):
         key = jax.random.fold_in(key0, e)
         k1, k2 = jax.random.split(key)
         alpha = learning_rate * (1.0 - e / n_epochs)
-        fire = jax.random.uniform(k1, (E,)) < weights
-        h = emb[heads]
-        t = ref_emb[tails]
-        diff = h - t
-        d2 = (diff * diff).sum(axis=1)
+        fire = jax.random.uniform(k1, (nq, k)) < weights
+        diff = emb[:, None, :] - ref_emb[tails]      # (nq, k, c)
+        d2 = (diff * diff).sum(axis=2)
         att = (-2.0 * a * b * d2 ** (b - 1.0)) / (1.0 + a * d2**b)
         att = jnp.where(d2 > 0, att, 0.0) * fire
-        g_att = jnp.clip(att[:, None] * diff, -4.0, 4.0)
-        upd = jnp.zeros_like(emb)
-        upd = upd.at[heads].add(g_att * alpha)
+        upd = jnp.clip(att[:, :, None] * diff, -4.0, 4.0).sum(axis=1)
 
         S = negative_sample_rate
-        neg = jax.random.randint(k2, (E, S), 0, nr)
-        diff_n = h[:, None, :] - ref_emb[neg]
-        d2n = (diff_n * diff_n).sum(axis=2)
+        neg = jax.random.randint(k2, (nq, k, S), 0, nr)
+        diff_n = emb[:, None, None, :] - ref_emb[neg]  # (nq, k, S, c)
+        d2n = (diff_n * diff_n).sum(axis=3)
         rep = (2.0 * repulsion_strength * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
-        rep = rep * fire[:, None]
-        g_rep = jnp.clip(rep[:, :, None] * diff_n, -4.0, 4.0)
-        upd = upd.at[heads].add(g_rep.sum(axis=1) * alpha)
-        return emb + upd
+        rep = rep * fire[:, :, None]
+        g_rep = jnp.clip(rep[:, :, :, None] * diff_n, -4.0, 4.0)
+        upd = upd + g_rep.sum(axis=(1, 2))
+        return emb + alpha * upd
 
     return jax.lax.fori_loop(0, n_epochs, epoch, emb_q)
 
@@ -464,18 +506,16 @@ def umap_transform_embedding(
         n_epochs = 100 if train_embedding.shape[0] <= 10_000 else 30
     else:
         n_epochs = max(int(n_epochs) // 3, 1)
-    heads = np.repeat(np.arange(bucket, dtype=np.int32), k)
-    tails = ids_p.astype(np.int32).reshape(-1)
+    tails = ids_p.astype(np.int32)              # (bucket, k)
     wmax = w[:nq].max() if nq else 1.0
     # padding rows get weight 0: their edges never fire
     w[nq:] = 0.0
-    weights = (w / max(wmax, 1e-12)).astype(np.float32).reshape(-1)
+    weights = (w / max(wmax, 1e-12)).astype(np.float32)  # (bucket, k)
     if train_embedding_dev is None:
         train_embedding_dev = jnp.asarray(train_embedding.astype(np.float32))
     out = optimize_transform_layout(
         jnp.asarray(init),
         train_embedding_dev,
-        jnp.asarray(heads),
         jnp.asarray(tails),
         jnp.asarray(weights),
         float(a),
