@@ -248,33 +248,51 @@ def main() -> None:
         n_query = int(os.environ.get("SRML_BENCH_QUERIES", min(rows, 8192)))
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.ops.knn import knn_block_kernel, prepare_items
+        from spark_rapids_ml_tpu.ops.knn import knn_block_kernel
 
-        # index + queries generated/staged on device: the metric is query
+        # index + queries GENERATED on device: the metric is query
         # throughput against a resident index (the reference's GPU arm also
-        # queries data already on the GPUs); results still cross the host
-        # link as part of serving
-        X_host = rng.standard_normal((rows, cols), dtype=np.float32)
-        ids = np.arange(rows, dtype=np.int64)
-        prepared = prepare_items(X_host, ids, mesh)
+        # queries data already on the GPUs), and a 4.9 GB index upload
+        # through the tunnel is untimed setup that can eat 30+ min when the
+        # link is congested.  Results still cross the host link as part of
+        # serving (the (Q, k) distance/position fetch inside fit()).
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+        n_dev = mesh.shape[DATA_AXIS]
+        n_pad = rows + (-rows) % n_dev
+        items_dev = jax.jit(
+            lambda s: jax.random.normal(
+                jax.random.PRNGKey(s), (n_pad, cols), jnp.float32
+            ),
+            out_shardings=data_sharding(mesh),
+        )(0)
+        norm_dev = jax.jit(lambda x: jnp.einsum("nd,nd->n", x, x))(items_dev)
+        pos_dev = jax.device_put(
+            np.arange(n_pad, dtype=np.int32), data_sharding(mesh)
+        )
+        valid_dev = jax.device_put(
+            np.arange(n_pad) < rows, data_sharding(mesh)
+        )
+        ids_host = np.arange(n_pad, dtype=np.int64)
         Q_dev = jax.jit(
             lambda s: jax.random.normal(
                 jax.random.PRNGKey(s), (n_query, cols), jnp.float32
             )
         )(7)
+        _sync(norm_dev.sum())
         _sync(Q_dev.sum())
 
         def fit():
             d, pos = knn_block_kernel(
-                prepared.items, prepared.norm, prepared.pos, prepared.valid,
-                Q_dev, mesh, k,
+                items_dev, norm_dev, pos_dev, valid_dev, Q_dev, mesh, k,
             )
-            ids_host = prepared.ids[np.asarray(pos)]
-            return float(np.asarray(d).ravel()[0]) + ids_host.shape[0] * 0.0
+            ids_out = ids_host[np.asarray(pos)]
+            return float(np.asarray(d).ravel()[0]) + ids_out.shape[0] * 0.0
 
         elapsed = _timed(fit)
+        n_items = rows
         rows = n_query  # throughput counts completed query rows
-        label = f"knn_query_throughput_n{X_host.shape[0]}_d{cols}_k{k}"
+        label = f"knn_query_throughput_n{n_items}_d{cols}_k{k}"
 
     elif algo in ("rf_clf", "rf_reg") and on_accel:
         # the reference's published regressor arm: 30 trees, bins=128,

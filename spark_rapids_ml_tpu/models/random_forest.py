@@ -151,20 +151,14 @@ def _maybe_grow_mxu(
         w_trees = w_pad[None, :] * bw
     else:
         w_trees = jnp.broadcast_to(w_pad[None, :], (n_trees, n_pad))
-    try:
-        return forest_mxu.grow_forest_mxu(
-            bins_fm, base_stats, w_trees, stats3, edges,
-            max_depth=max_depth, n_bins=n_bins, kind=kind,
-            max_features=int(max_features),
-            min_samples_leaf=min_samples_leaf,
-            min_impurity_decrease=min_impurity_decrease,
-            seed=seed, y_vals=y_vals,
-        )
-    except forest_mxu._DeepPhaseSkewError as e:
-        get_logger(_maybe_grow_mxu).info(
-            "MXU path declined (%s); falling back to scatter builder", e
-        )
-        return None
+    return forest_mxu.grow_forest_mxu(
+        bins_fm, base_stats, w_trees, stats3, edges,
+        max_depth=max_depth, n_bins=n_bins, kind=kind,
+        max_features=int(max_features),
+        min_samples_leaf=min_samples_leaf,
+        min_impurity_decrease=min_impurity_decrease,
+        seed=seed, y_vals=y_vals,
+    )
 
 
 class _RandomForestClass(_TpuParams):

@@ -213,11 +213,6 @@ def _route(
     return new_rel
 
 
-class _DeepPhaseSkewError(RuntimeError):
-    """Raised when equal-cap bucket padding would blow up memory; callers
-    fall back to the scatter builder."""
-
-
 @partial(jax.jit, static_argnames=("f_pad",))
 def _pack_rows(sub: jax.Array, f_pad: int) -> jax.Array:
     """(f_pad, N) int8 -> (f_pad//4, N) int32, 4 bin bytes per word, so the
@@ -234,6 +229,23 @@ def _unpack_rows(packed: jax.Array) -> jax.Array:
         [(p >> (8 * i)) & 0xFF for i in range(4)], axis=1
     )
     return parts.reshape(-1, packed.shape[1]).astype(jnp.int8)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _slice_segments(arr: jax.Array, seg_t: jax.Array, seg_start: jax.Array, cap: int):
+    """(T, n2) array -> (n_seg, cap): one contiguous window per segment via
+    batched dynamic_slice (XLA lowers the vmap to a block gather of
+    `cap`-wide contiguous runs — near-memcpy speed, unlike scalar gathers
+    on this backend)."""
+    return jax.vmap(
+        lambda t, s: jax.lax.dynamic_slice(arr[t], (s,), (cap,))
+    )(seg_t, seg_start)
+
+
+# stray-slot sentinel for bucket-local node ids: large enough that 2*x+1
+# growth across every deep level stays far outside any local node range and
+# far below int32 overflow (local <= 64, <= 7 deep levels -> < 2^27)
+_STRAY = 1 << 18
 
 
 def _deep_phase(
@@ -255,19 +267,35 @@ def _deep_phase(
     min_impurity_decrease: float,
     interpret: bool = False,
 ) -> None:
-    """Levels past the 128-slot budget: rows are grouped ONCE per tree by
-    their bucket-level ancestor via a batched payload sort (the only fast
-    data-movement primitive on this backend — XLA gather/scatter scalarize),
-    then every deeper level histograms each equal-padded bucket against its
-    own <= 128 local slots.  The per-tree deep feature subset rides the sort
-    as packed int32 payload; buckets never move again because routing keeps
-    rows inside their subtree."""
+    """Levels past the 128-slot budget, data-proportional in compute AND
+    memory regardless of tree skew:
+
+    1. Rows are grouped ONCE per tree by their bucket-level ancestor via a
+       batched payload sort (the only fast data-movement primitive on this
+       backend — XLA gather/scatter scalarize).  Tile-aligned filler rows
+       (weight 0) ride the sort so every bucket's region is a multiple of
+       _ROW_TILE_DEEP.
+    2. Every non-empty (tree, bucket) segment is assigned to a geometric
+       SIZE CLASS (capacity = next power-of-two tile multiple >= its padded
+       length, so padding overhead <= 2x).  A class batches segments from
+       ALL trees: each level then runs ONE histogram / split / route
+       dispatch per (class, segment-chunk) — a skewed forest (few giant
+       buckets + many dead ones) costs what its rows cost, where an
+       equal-capacity layout would pad every bucket to the largest (the
+       round-1 design's HBM blow-up) and per-bucket windows would stream
+       the full row set once per live window.
+    3. Buckets never move again: routing keeps rows inside their subtree,
+       so the class layout is built once and reused by every deeper level.
+
+    The per-tree deep feature subset rides the sort as packed int32
+    payload (4 bins/word)."""
     feature, threshold, leaf_value, n_samples, impurity = outputs
     T, n_pad = rel.shape
     D = bins_fm.shape[0]
     n_buckets = 2**bucket_level
     F = int(max_features)
     f_pad = -(-max(F, 4) // _F_BLOCK) * _F_BLOCK
+    TILE = _ROW_TILE_DEEP
 
     # one deep subset per tree, shared by its levels >= bucket_level (the
     # random-subspace compromise documented in the module header)
@@ -275,28 +303,47 @@ def _deep_phase(
         [rng.choice(D, F, replace=False).astype(np.int32) for _ in range(T)]
     )
 
-    # --- batched bucket sort with per-bucket equal padding ---------------
+    # --- batched bucket sort with per-bucket tile-aligned filler ----------
     keys = jnp.minimum(rel, n_buckets).astype(jnp.int32)
     sorted_keys = jnp.sort(keys, axis=1)
     bounds = jax.vmap(
         lambda sk: jnp.searchsorted(sk, jnp.arange(n_buckets + 1))
     )(sorted_keys)
     counts = np.asarray(bounds[:, 1:] - bounds[:, :-1])  # (T, n_buckets)
-    cap = int(-(-max(int(counts.max()), 1) // _ROW_TILE_DEEP) * _ROW_TILE_DEEP)
-    n2 = n_buckets * cap
-    if n2 > 3 * n_pad + n_buckets * _ROW_TILE_DEEP:
-        # equal-cap padding sizes every bucket to the LARGEST one; heavily
-        # skewed trees (one bucket holding most rows) would multiply the
-        # sort/histogram working set by up to n_buckets.  Bail out to the
-        # scatter builder rather than risk HBM exhaustion.
-        raise _DeepPhaseSkewError(
-            f"bucket skew: cap {cap} x {n_buckets} buckets vs {n_pad} rows"
-        )
-    # dummy rows fill every bucket to cap; key n_buckets = discard filler
-    dkeys = np.full((T, n2), n_buckets, np.int32)
+    aligned = -(-counts // TILE) * TILE                  # 0 stays 0
+    starts = np.concatenate(
+        [np.zeros((T, 1), np.int64), np.cumsum(aligned, axis=1)], axis=1
+    )[:, :n_buckets]
+
+    # size classes are decided from the counts BEFORE the sort so n2 can be
+    # sized to the largest class capacity (a clamped window must never run
+    # off the end)
+    classes: dict = {}
     for t in range(T):
-        reps = np.clip(cap - counts[t], 0, None)
-        dk = np.repeat(np.arange(n_buckets, dtype=np.int32), reps)
+        for b in range(n_buckets):
+            seg_cap = int(aligned[t, b])
+            if seg_cap == 0:
+                continue
+            cls_cap = TILE
+            while cls_cap < seg_cap:
+                cls_cap *= 2
+            classes.setdefault(cls_cap, []).append(
+                (t, b, int(starts[t, b]), seg_cap)
+            )
+
+    # sorted width: every tree needs room for its live rows + its filler
+    # (aligned padding) + its DEAD rows (shallow-leafed, key == n_buckets —
+    # they sort past every bucket but still occupy columns), and the
+    # largest class window must fit entirely
+    pad_t = aligned.sum(axis=1) - counts.sum(axis=1)  # filler per tree
+    n2 = n_pad + int(pad_t.max()) + TILE
+    if classes:
+        n2 = max(n2, max(classes) + TILE)
+    dkeys = np.full((T, n2 - n_pad), n_buckets, np.int32)
+    for t in range(T):
+        dk = np.repeat(
+            np.arange(n_buckets, dtype=np.int32), aligned[t] - counts[t]
+        )
         dkeys[t, : dk.size] = dk
     P = f_pad // 4
     g_chunk = 16384 if n_pad % 16384 == 0 else _ROW_TILE
@@ -310,7 +357,7 @@ def _deep_phase(
             for t in range(T)
         ]
     )  # (T, P, n_pad)
-    zeros_d = jnp.zeros((T, n2), jnp.int32)
+    zeros_d = jnp.zeros((T, n2 - n_pad), jnp.int32)
     operands = [jnp.concatenate([keys, jnp.asarray(dkeys)], axis=1)]
     for p in range(P):
         operands.append(jnp.concatenate([packed[:, p, :], zeros_d], axis=1))
@@ -325,278 +372,175 @@ def _deep_phase(
     )
     sorted_ops = jax.lax.sort(tuple(operands), num_keys=1, dimension=1)
     del packed, operands
-    keys_s = sorted_ops[0][:, :n2]
-    packed_s = [o[:, :n2] for o in sorted_ops[1 : 1 + P]]
-    w_s = sorted_ops[1 + P][:, :n2]
-    y_s = sorted_ops[2 + P][:, :n2]
+    packed_sorted = list(sorted_ops[1 : 1 + P])  # P x (T, n2)
+    w_sorted = sorted_ops[1 + P]
+    y_sorted = sorted_ops[2 + P]
     del sorted_ops
 
-    # local node id within the bucket subtree; dummies carry local 0 with
-    # weight 0 (they never contribute)
-    rel_loc = jnp.zeros((T, n2), jnp.int32)
-    bucket_of = jnp.arange(n2, dtype=jnp.int32) // cap
+    # --- build each class's concatenated layout ONCE ----------------------
+    class_state: dict = {}
+    for cls_cap, segs in sorted(classes.items()):
+        seg_t = jnp.asarray([s[0] for s in segs], jnp.int32)
+        # clamp so the cap-wide window stays in bounds; the offset mask
+        # recovers the true segment rows
+        sl_start = np.array(
+            [min(s[2], n2 - cls_cap) for s in segs], np.int64
+        )
+        off = np.array([s[2] for s in segs], np.int64) - sl_start
+        seg_len = np.array([s[3] for s in segs], np.int64)
+        sl_start_d = jnp.asarray(sl_start, jnp.int32)
+        j = np.arange(cls_cap)
+        in_seg = jnp.asarray(
+            (j[None, :] >= off[:, None]) & (j[None, :] < (off + seg_len)[:, None])
+        )  # (n_seg, cap): True on the segment's own (real + filler) rows
+        pk = jnp.stack(
+            [
+                _slice_segments(packed_sorted[p], seg_t, sl_start_d, cls_cap)
+                for p in range(P)
+            ]
+        )  # (P, n_seg, cap)
+        sub_c = _unpack_rows(pk.reshape(P, -1))  # (f_pad, n_seg*cap)
+        w_c = (
+            _slice_segments(w_sorted, seg_t, sl_start_d, cls_cap) * in_seg
+        ).reshape(-1)
+        y_c = _slice_segments(y_sorted, seg_t, sl_start_d, cls_cap).reshape(-1)
+        rel_c = jnp.where(in_seg, 0, _STRAY).astype(jnp.int32).reshape(-1)
+        class_state[cls_cap] = {
+            "segs": segs, "sub": sub_c, "w": w_c, "y": y_c, "rel": rel_c,
+        }
+    del packed_sorted, w_sorted, y_sorted
 
-    # deferred host fetches, same rationale as the shallow phase: a
-    # device_get per (tree, level) would serialize T x levels round-trips
-    pending = []  # (tag, t, level_slice, device_arrays)
+    # --- levels: one histogram/split/route dispatch per (class, chunk) ----
+    # deferred host fetches: one device_get at the end (a sync per
+    # dispatch would serialize hundreds of tunnel round-trips)
+    pending = []  # (tag, seg_sublist, level, device_arrays)
 
     for level in range(bucket_level, max_depth + 1):
         local = 2 ** (level - bucket_level)
-        nodes_lvl = n_buckets * local
         base = 2**level - 1
         is_last = level == max_depth
-        for t in range(T):
-            sub_t = _unpack_rows(jnp.stack(
-                [p[t] for p in packed_s]
-            ))  # (f_pad, n2)
-            if kind == "regression":
-                stats_t = jnp.stack([w_s[t], w_s[t] * y_s[t]])
-                tot3 = jnp.stack(
-                    [w_s[t], w_s[t] * y_s[t], w_s[t] * y_s[t] * y_s[t]]
-                )
-            else:
-                cls = jnp.arange(s_dim, dtype=jnp.float32)
-                stats_t = w_s[t][None, :] * (
-                    y_s[t][None, :] == cls[:, None]
-                ).astype(jnp.float32)
-                tot3 = None
-            if kind == "regression":
-                node_tot = _node_totals_bucketed(
-                    rel_loc[t], tot3, bucket_of, n_buckets, local, cap
-                )
-            else:
-                node_tot = None
-            if is_last and kind == "regression":
-                # regression leaves need only the (w, wy, wy2) node totals —
-                # no histogram at all
-                H = None
-            else:
-                if is_last:
-                    # classification leaf: totals only -> one feature block
-                    sub_t = sub_t[:_F_BLOCK]
-                H = node_histograms_bucketed(
-                    sub_t, rel_loc[t][None, :], stats_t,
-                    n_buckets=n_buckets, nodes=local, s_dim=s_dim,
-                    n_bins=n_bins, interpret=interpret,
-                )  # (n_buckets, f_pad, slots_pad, B)
-            if is_last:
-                # leaf level: totals only (fetch deferred)
-                sl = slice(base, base + nodes_lvl)
+        for cls_cap, st in class_state.items():
+            segs = st["segs"]
+            n_seg = len(segs)
+            # chunk segments so the split-search intermediate
+            # (chunk, S, local, f_pad, B) stays ~<=64 MB
+            seg_chunk = max(
+                1, (64 << 20) // max(1, local * s_dim * f_pad * n_bins * 4)
+            )
+            for c0 in range(0, n_seg, seg_chunk):
+                c1 = min(c0 + seg_chunk, n_seg)
+                rs = slice(c0 * cls_cap, c1 * cls_cap)
+                nseg_c = c1 - c0
+                sub_k = st["sub"][:, rs]
+                rel_k = st["rel"][rs]
+                w_k = st["w"][rs]
+                y_k = st["y"][rs]
                 if kind == "regression":
-                    pending.append(("leaf_reg", t, sl, node_tot))
+                    tot3 = jnp.stack([w_k, w_k * y_k, w_k * y_k * y_k])
+                    node_tot = _node_totals_bucketed(
+                        rel_k, tot3, nseg_c, local, cls_cap
+                    )
                 else:
-                    hist0 = (
-                        H[:, 0, : local * s_dim, :]
-                        .reshape(n_buckets * local, s_dim, n_bins)
-                        .sum(-1)
-                    )  # (nodes_lvl, S) class sums
-                    pending.append(("leaf_cls", t, sl, hist0))
-                continue
-            Hf = jnp.transpose(
-                H[:, :, : local * s_dim, :], (1, 0, 2, 3)
-            ).reshape(f_pad, n_buckets * local * s_dim, n_bins)
-            feat_valid = jnp.arange(f_pad) < F
-            bf, bb, ok, p_w, p_imp, p_val = _split_from_hist(
-                Hf, node_tot, feat_valid, n_buckets, local, s_dim, kind,
-                float(min_samples_leaf), float(min_impurity_decrease),
-            )  # leading (n_buckets, local)
-            new_loc = _route_bucketed(
-                sub_t, rel_loc[t], bucket_of, bf, bb, ok, cap
-            )
-            rel_loc = rel_loc.at[t].set(new_loc)
-            sl = slice(base, base + nodes_lvl)
-            pending.append(("split", t, sl, (bf, bb, ok, p_w, p_imp, p_val)))
-
-    # single host fetch for the whole deep phase
-    _drain_deep_pending(pending, feats_all, edges, outputs, kind, F)
-
-
-def _drain_deep_pending(pending, feats_all, edges, outputs, kind, F):
-    """One host fetch + numpy writes for all deferred deep-phase results
-    (shared by the bucketed and windowed deep phases)."""
-    feature, threshold, leaf_value, n_samples, impurity = outputs
-    fetched = jax.device_get([p[3] for p in pending])
-    for (tag, t, sl, _), got in zip(pending, fetched):
-        nodes_sl = sl.stop - sl.start
-        if tag == "leaf_reg":
-            th = np.asarray(got).reshape(nodes_sl, 3)
-            w_n = np.maximum(th[:, 0], 1e-12)
-            n_samples[t, sl] = th[:, 0]
-            impurity[t, sl] = np.maximum(
-                th[:, 2] / w_n - (th[:, 1] / w_n) ** 2, 0.0
-            )
-            leaf_value[t, sl] = (th[:, 1] / w_n)[:, None]
-        elif tag == "leaf_cls":
-            tot_h = np.asarray(got).reshape(nodes_sl, -1)
-            w_n = np.maximum(tot_h.sum(1), 1e-12)
-            val = tot_h / w_n[:, None]
-            if kind == "entropy":
-                imp = -(val * np.log2(np.maximum(val, 1e-12))).sum(1)
-            else:
-                imp = 1.0 - (val * val).sum(1)
-            n_samples[t, sl] = tot_h.sum(1)
-            impurity[t, sl] = imp
-            leaf_value[t, sl] = val
-        else:
-            bf_h, bb_h, ok_h, pw_h, pi_h, pv_h = got
-            gf = feats_all[t][np.minimum(bf_h.reshape(-1), F - 1)]
-            n_samples[t, sl] = pw_h.reshape(-1)
-            impurity[t, sl] = pi_h.reshape(-1)
-            leaf_value[t, sl] = pv_h.reshape(nodes_sl, -1)
-            okf = ok_h.reshape(-1)
-            feature[t, sl] = np.where(okf, gf, -1)
-            threshold[t, sl] = np.where(
-                okf,
-                edges[gf, np.minimum(bb_h.reshape(-1), edges.shape[1] - 1)],
-                0.0,
-            )
-
-
-@partial(jax.jit, static_argnames=("nw", "win"))
-def _window_occupancy(rel_t: jax.Array, nw: int, win: int) -> jax.Array:
-    """(nw,) bool: does any row's node id land in window w (ids
-    [w*win, (w+1)*win))?  Dead rows carry out-of-range sentinels and match
-    no window."""
-    wid = rel_t // win
-    return jax.vmap(lambda w: jnp.any(wid == w))(
-        jnp.arange(nw, dtype=rel_t.dtype)
-    )
-
-
-def _deep_phase_windowed(
-    rel: jax.Array,          # (T, n_pad) node ids AT bucket_level
-    bins_fm: jax.Array,
-    w_trees: jax.Array,
-    base_stats: jax.Array,   # (S, n_pad) unweighted stat rows
-    stats3: jax.Array,       # (3, n_pad) or None (classification)
-    edges: np.ndarray,
-    outputs,
-    rng: np.random.Generator,
-    *,
-    bucket_level: int,
-    max_depth: int,
-    n_bins: int,
-    kind: str,
-    s_dim: int,
-    max_features: int,
-    min_samples_leaf: float,
-    min_impurity_decrease: float,
-    interpret: bool = False,
-) -> None:
-    """Skew-immune deep growth: every level >= bucket_level is processed in
-    M_SLOTS//s_dim-node slot WINDOWS over the full (unsorted) row set — the
-    same node_histograms kernel as the shallow phase, with out-of-window
-    rows masked by the node-id shift.  Windows holding no rows are skipped
-    (one tiny occupancy fetch per level), which is what makes this the right
-    fallback when equal-cap bucketing bails out on skew: a skewed tree has
-    few live deep nodes, so almost all windows are dead.  Worst case
-    (perfectly bushy deep trees) streams the full row set once per live
-    window — the balanced case the bucketed phase exists for."""
-    T, n_pad = rel.shape
-    D = bins_fm.shape[0]
-    F = int(max_features)
-    f_pad = -(-max(F, 1) // _F_BLOCK) * _F_BLOCK
-    win = M_SLOTS // s_dim
-    feats_all = np.stack(
-        [rng.choice(D, F, replace=False).astype(np.int32) for _ in range(T)]
-    )
-    chunk = 16384 if n_pad % 16384 == 0 else _ROW_TILE
-    feat_valid = jnp.arange(f_pad) < F
-    pending = []
-    rel_t_list = [rel[t] for t in range(T)]
-    subs = [
-        gather_rows_matmul(
-            bins_fm, jnp.asarray(feats_all[t]), f_pad=f_pad, chunk=chunk
-        )
-        for t in range(T)
-    ]
-    # level-invariant per-tree stat rows, computed ONCE (the bucketed phase
-    # recomputes per level only because its sorted layout changes; this
-    # path's row order never does)
-    stats_trees = [base_stats * w_trees[t][None, :] for t in range(T)]
-    tot3_trees = (
-        [stats3 * w_trees[t][None, :] for t in range(T)]
-        if kind == "regression"
-        else [None] * T
-    )
-
-    for level in range(bucket_level, max_depth + 1):
-        nodes_lvl = 2**level
-        base = 2**level - 1
-        win_l = min(win, nodes_lvl)
-        nw = -(-nodes_lvl // win_l)
-        is_last = level == max_depth
-        occ_h = np.asarray(
-            jnp.stack(
-                [_window_occupancy(rel_t_list[t], nw, win_l) for t in range(T)]
-            )
-        )  # the one sync point of this level
-        for t in range(T):
-            rel_t = rel_t_list[t]
-            stats_t = stats_trees[t]
-            tot3_t = tot3_trees[t]
-            new_rel = None
-            for wi in range(nw):
-                if not occ_h[t, wi]:
-                    continue
-                w0 = wi * win_l
-                # the last window is clamped when win_l does not divide
-                # nodes_lvl (non-power-of-two s_dim): without the clamp its
-                # slice would spill into the next level's slot range and the
-                # dead-row sentinel (rel == nodes_lvl) would alias into it
-                win_eff = min(win_l, nodes_lvl - w0)
-                rel_sh = rel_t - w0
-                sl = slice(base + w0, base + w0 + win_eff)
-                node_tot = (
-                    _node_totals(rel_sh[None], tot3_t[None], win_eff)
-                    if kind == "regression"
-                    else None
-                )
+                    cls_iota = jnp.arange(s_dim, dtype=jnp.float32)
+                    stats_k = w_k[None, :] * (
+                        y_k[None, :] == cls_iota[:, None]
+                    ).astype(jnp.float32)
+                    node_tot = None
                 if is_last:
                     if kind == "regression":
-                        pending.append(("leaf_reg", t, sl, node_tot))
+                        pending.append(
+                            ("leaf_reg", segs[c0:c1], level, node_tot)
+                        )
                     else:
-                        cls_tot = _node_totals(rel_sh[None], stats_t[None], win_eff)
-                        pending.append(("leaf_cls", t, sl, cls_tot))
+                        cls_tot = _node_totals_bucketed(
+                            rel_k, stats_k, nseg_c, local, cls_cap
+                        )
+                        pending.append(
+                            ("leaf_cls", segs[c0:c1], level, cls_tot)
+                        )
                     continue
-                H = node_histograms(
-                    subs[t], rel_sh[None], stats_t, t_pack=1, nodes=win_eff,
-                    s_dim=s_dim, n_bins=n_bins, interpret=interpret,
-                )
+                if kind == "regression":
+                    stats_k = jnp.stack([w_k, w_k * y_k])
+                H = node_histograms_bucketed(
+                    sub_k, rel_k[None, :], stats_k,
+                    n_buckets=nseg_c, nodes=local, s_dim=s_dim,
+                    n_bins=n_bins, interpret=interpret,
+                )  # (nseg_c, f_pad, slots_pad, B)
+                Hf = jnp.transpose(
+                    H[:, :, : local * s_dim, :], (1, 0, 2, 3)
+                ).reshape(f_pad, nseg_c * local * s_dim, n_bins)
+                feat_valid = jnp.arange(f_pad) < F
                 bf, bb, ok, p_w, p_imp, p_val = _split_from_hist(
-                    H, node_tot, feat_valid, 1, win_eff, s_dim, kind,
+                    Hf, node_tot, feat_valid, nseg_c, local, s_dim, kind,
                     float(min_samples_leaf), float(min_impurity_decrease),
+                )  # leading (nseg_c, local)
+                new_rel = _route_bucketed(
+                    sub_k, rel_k, bf, bb, ok, cls_cap
                 )
-                loc = _route(subs[t], rel_sh[None], bf, bb, ok)[0]
-                if new_rel is None:
-                    new_rel = jnp.full((n_pad,), 2 * nodes_lvl, jnp.int32)
-                # loc < 2*win_eff iff the row sits in THIS window under a
-                # node that kept splitting; +2*w0 restores the absolute
-                # child id
-                new_rel = jnp.where(loc < 2 * win_eff, loc + 2 * w0, new_rel)
-                pending.append(("split", t, sl, (bf, bb, ok, p_w, p_imp, p_val)))
-            if not is_last:
-                rel_t_list[t] = (
-                    new_rel
-                    if new_rel is not None
-                    else jnp.full((n_pad,), 2 * nodes_lvl, jnp.int32)
+                st["rel"] = st["rel"].at[rs].set(new_rel)
+                pending.append(
+                    ("split", segs[c0:c1], level, (bf, bb, ok, p_w, p_imp, p_val))
                 )
 
-    _drain_deep_pending(pending, feats_all, edges, outputs, kind, F)
+    # --- single host fetch + per-segment numpy writes ----------------------
+    fetched = jax.device_get([p[3] for p in pending])
+    for (tag, segs_c, level, _), got in zip(pending, fetched):
+        local = 2 ** (level - bucket_level)
+        base = 2**level - 1
+        if tag == "leaf_reg":
+            th = np.asarray(got)  # (nseg, local, 3)
+            w_n = np.maximum(th[:, :, 0], 1e-12)
+            val = (th[:, :, 1] / w_n)[:, :, None]
+            imp = np.maximum(th[:, :, 2] / w_n - (th[:, :, 1] / w_n) ** 2, 0.0)
+            cnt = th[:, :, 0]
+            for i, (t, b, _, _) in enumerate(segs_c):
+                sl = slice(base + b * local, base + (b + 1) * local)
+                n_samples[t, sl] = cnt[i]
+                impurity[t, sl] = imp[i]
+                leaf_value[t, sl] = val[i]
+        elif tag == "leaf_cls":
+            tot_h = np.asarray(got)  # (nseg, local, S)
+            w_n = np.maximum(tot_h.sum(2), 1e-12)
+            val = tot_h / w_n[:, :, None]
+            if kind == "entropy":
+                imp = -(val * np.log2(np.maximum(val, 1e-12))).sum(2)
+            else:
+                imp = 1.0 - (val * val).sum(2)
+            cnt = tot_h.sum(2)
+            for i, (t, b, _, _) in enumerate(segs_c):
+                sl = slice(base + b * local, base + (b + 1) * local)
+                n_samples[t, sl] = cnt[i]
+                impurity[t, sl] = imp[i]
+                leaf_value[t, sl] = val[i]
+        else:
+            bf_h, bb_h, ok_h, pw_h, pi_h, pv_h = got  # leading (nseg, local)
+            for i, (t, b, _, _) in enumerate(segs_c):
+                sl = slice(base + b * local, base + (b + 1) * local)
+                gf = feats_all[t][np.minimum(bf_h[i], F - 1)]
+                n_samples[t, sl] = pw_h[i]
+                impurity[t, sl] = pi_h[i]
+                leaf_value[t, sl] = pv_h[i]
+                feature[t, sl] = np.where(ok_h[i], gf, -1)
+                threshold[t, sl] = np.where(
+                    ok_h[i],
+                    edges[gf, np.minimum(bb_h[i], edges.shape[1] - 1)],
+                    0.0,
+                )
 
 
 @partial(jax.jit, static_argnames=("n_buckets", "local", "cap"))
 def _node_totals_bucketed(
     rel_loc: jax.Array,   # (n2,)
-    stats3: jax.Array,    # (3, n2)
-    bucket_of: jax.Array, # (n2,)
+    stats3: jax.Array,    # (S, n2)
     n_buckets: int,
     local: int,
     cap: int,
 ):
-    """(n_buckets, local, 3) per-node stat sums via bucket-blocked one-hot
-    contraction (cap rows per bucket are contiguous)."""
-    st = stats3.reshape(3, n_buckets, cap)
+    """(n_buckets, local, S) per-node stat sums via bucket-blocked one-hot
+    contraction (cap rows per bucket are contiguous); S = stats3.shape[0]
+    (3 impurity stats for regression, n_classes for classification leaf
+    totals)."""
+    st = stats3.reshape(stats3.shape[0], n_buckets, cap)
     rl = rel_loc.reshape(n_buckets, cap)
     on = (
         rl[:, None, :] == jnp.arange(local, dtype=rl.dtype)[None, :, None]
@@ -610,7 +554,6 @@ def _node_totals_bucketed(
 def _route_bucketed(
     sub: jax.Array,       # (f_pad, n2)
     rel_loc: jax.Array,   # (n2,)
-    bucket_of: jax.Array, # (n2,)
     bf: jax.Array,        # (n_buckets, local)
     bb: jax.Array,
     ok: jax.Array,
@@ -785,28 +728,13 @@ def grow_forest_mxu(
                 0.0,
             )
     if max_depth > l_s:
-        try:
-            _deep_phase(
-                rel, bins_fm, w_trees, y_vals, edges,
-                (feature, threshold, leaf_value, n_samples, impurity), rng,
-                bucket_level=l_s + 1, max_depth=max_depth, n_bins=n_bins,
-                kind=kind, s_dim=S, max_features=F,
-                min_samples_leaf=float(min_samples_leaf),
-                min_impurity_decrease=float(min_impurity_decrease),
-                interpret=interpret,
-            )
-        except _DeepPhaseSkewError:
-            # skewed trees concentrate rows in few deep nodes — exactly the
-            # case where per-level slot windows over the unsorted rows are
-            # cheap (dead windows are skipped), while equal-cap bucketing
-            # would blow HBM.  Balanced forests stay on the bucketed path.
-            _deep_phase_windowed(
-                rel, bins_fm, w_trees, base_stats, stats3, edges,
-                (feature, threshold, leaf_value, n_samples, impurity), rng,
-                bucket_level=l_s + 1, max_depth=max_depth, n_bins=n_bins,
-                kind=kind, s_dim=S, max_features=F,
-                min_samples_leaf=float(min_samples_leaf),
-                min_impurity_decrease=float(min_impurity_decrease),
-                interpret=interpret,
-            )
+        _deep_phase(
+            rel, bins_fm, w_trees, y_vals, edges,
+            (feature, threshold, leaf_value, n_samples, impurity), rng,
+            bucket_level=l_s + 1, max_depth=max_depth, n_bins=n_bins,
+            kind=kind, s_dim=S, max_features=F,
+            min_samples_leaf=float(min_samples_leaf),
+            min_impurity_decrease=float(min_impurity_decrease),
+            interpret=interpret,
+        )
     return feature, threshold, leaf_value, n_samples, impurity

@@ -168,7 +168,17 @@ def knn_block_kernel(
             d2 = q_norm[:, None] - 2.0 * cross + nb[None, :]
             d2 = jnp.where(vb[None, :], d2, jnp.inf)
             neg_top, idx = _grouped_topk(-d2, kk)
-            return neg_top, idb[idx]
+            # item_pos is arange(N_pad) by construction (prepare_items), and
+            # row sharding + chunk slicing keep it contiguous, so the
+            # chunk's positions are idb[0] + idx — a broadcast add replacing
+            # an O(Q*k) scalar gather (~30M elem/s on this backend: ~1.3 s
+            # of the round-1 per-block cost was this one line).  idx is
+            # clamped: the grouped top-k's group padding can return
+            # past-the-chunk indices for -inf (invalid) slots, which the
+            # old gather silently clamped; their distances are inf, so the
+            # host maps them to the -1 id sentinel either way
+            idx = jnp.minimum(idx, chunk - 1)
+            return neg_top, idx.astype(idb.dtype) + idb[0]
 
         # Merge strategy: COLLECT all per-chunk candidates and do one
         # grouped merge (removes the serialized per-chunk (Q, 2k) top_k,
