@@ -145,33 +145,32 @@ def categorical_simplicial_set_intersection(
     return W2 / jnp.maximum(W2.max(axis=1, keepdims=True), 1e-12)
 
 
-@partial(jax.jit, static_argnames=("n", "c", "n_iter"))
+@partial(jax.jit, static_argnames=("c", "n_iter"))
 def _laplacian_eigenmap_kernel(
-    ii: jax.Array,   # (E,) int32 undirected edge endpoints (deduped)
-    jj: jax.Array,   # (E,)
-    ww: jax.Array,   # (E,) symmetric weights
+    tails_pad: jax.Array,  # (n, P) int32 head-grouped directed neighbors
+    w_pad: jax.Array,      # (n, P) symmetric weights (0 = padding)
     key: jax.Array,
-    n: int,
     c: int,
     n_iter: int = 50,
 ) -> jax.Array:
     """Top non-trivial eigenvectors of the normalized adjacency
     A_hat = D^-1/2 W D^-1/2 by deflated subspace iteration (equivalently the
     bottom eigenvectors of the normalized Laplacian — the spectral embedding
-    umap-learn/cuml use for init).  SpMV is two scatter-adds over the edge
-    list; the trivial eigenvector D^1/2*1 is projected out each iteration."""
-    deg = jnp.zeros(n).at[ii].add(ww).at[jj].add(ww)
+    umap-learn/cuml use for init).  SpMV runs in the padded head-grouped
+    layout (gather + axis sum) — the edge-list scatter-add formulation this
+    replaces cost ~120M scalar scatter updates for a 50k x 15 graph at 50
+    iterations, the single slowest phase of the round-2 UMAP fit.  The
+    trivial eigenvector D^1/2*1 is projected out each iteration."""
+    n, P = tails_pad.shape
+    deg = w_pad.sum(axis=1)
     dinv = 1.0 / jnp.sqrt(jnp.maximum(deg, 1e-12))
-    wn = ww * dinv[ii] * dinv[jj]
+    wn = w_pad * dinv[:, None] * dinv[tails_pad]
     # trivial top eigenvector of A_hat (unit-normalized)
     v0 = jnp.sqrt(jnp.maximum(deg, 0.0))
     v0 = v0 / jnp.linalg.norm(v0)
 
     def spmv(x):  # (n, c)
-        y = jnp.zeros_like(x)
-        y = y.at[ii].add(wn[:, None] * x[jj])
-        y = y.at[jj].add(wn[:, None] * x[ii])
-        return y
+        return (wn[:, :, None] * x[tails_pad]).sum(axis=1)
 
     def orthonormalize(y):
         y = y - v0[:, None] * (v0 @ y)[None, :]
@@ -195,7 +194,8 @@ def spectral_init(
     knn_ids: np.ndarray, W: np.ndarray, n_components: int, seed: int
 ) -> np.ndarray:
     """Spectral embedding of the fuzzy graph: dedupe the directed (n, k)
-    adjacency into an undirected edge list on the host, then run the jitted
+    adjacency into an undirected edge list on the host, lay it out in the
+    same padded head-grouped form the SGD epochs use, then run the jitted
     deflated subspace iteration.  Returns (n, c) scaled to the same 10-box
     umap-learn uses."""
     n, k = knn_ids.shape
@@ -211,13 +211,12 @@ def spectral_init(
     ii = lo[first].astype(np.int32)
     jj = hi[first].astype(np.int32)
     ww = w[first]
+    tails_pad, w_pad = padded_head_layout(ii, jj, ww, n)
     emb = np.asarray(
         _laplacian_eigenmap_kernel(
-            jnp.asarray(ii),
-            jnp.asarray(jj),
-            jnp.asarray(ww),
+            jnp.asarray(tails_pad),
+            jnp.asarray(w_pad),
             jax.random.PRNGKey(seed),
-            n=n,
             c=int(n_components),
         )
     )
