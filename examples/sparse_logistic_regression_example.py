@@ -1,0 +1,21 @@
+# Sparse (CSR) multinomial logistic regression — the BASELINE "1B x 100
+# sparse" repro config shape, scaled down.  The CSR input is never
+# densified: DataFrame.from_numpy keeps per-partition CSR blocks and the
+# fit runs the ELL kernels (ops/sparse.py).
+import numpy as np
+import scipy.sparse as sp
+
+from spark_rapids_ml_tpu import LogisticRegression
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+rng = np.random.default_rng(0)
+n, d, n_classes = 200_000, 100, 4
+X = sp.random(n, d, density=0.01, format="csr", random_state=rng, dtype=np.float64)
+W = rng.normal(size=(d, n_classes))
+y = np.asarray((X @ W)).argmax(axis=1).astype(np.float64)
+
+df = DataFrame.from_numpy(X, y=y, num_partitions=8)
+model = LogisticRegression(regParam=1e-5, maxIter=100).fit(df)
+pred = model.transform(df).toPandas()["prediction"].to_numpy()
+print(f"train accuracy: {(pred == y).mean():.3f}")
+print(f"coefficients shape: {np.asarray(model.coefficientMatrix).shape}")

@@ -222,6 +222,11 @@ def knn_block_kernel(
             (best_d, best_ids), _ = jax.lax.scan(
                 body, init, jnp.arange(n_chunks, dtype=jnp.int32)
             )
+        if mesh.shape[DATA_AXIS] == 1:
+            # single shard: the local result IS the global top-k (already
+            # sorted); the gather + re-sort below would be a pure no-op
+            # costing a full (Q, k) sort
+            return best_d, best_ids
         # (n_dev, Q, k) candidates — the only cross-shard traffic
         all_d = jax.lax.all_gather(best_d, DATA_AXIS)
         all_ids = jax.lax.all_gather(best_ids, DATA_AXIS)
