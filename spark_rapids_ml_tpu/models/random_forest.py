@@ -61,18 +61,26 @@ _MAX_SUPPORTED_DEPTH = 16  # dense tree layout: 2^(d+1)-1 node slots
 
 # binning subsample cap (compute_bin_edges subsamples to 100k anyway; this
 # bound also caps the device->host transfer that feeds it)
-_BINNING_SAMPLE_ROWS = 50_000
+_BINNING_SAMPLE_ROWS = 16_384
+# cap the sample FETCH, not just its row count: the sample crosses the
+# host link, and on a congested tunnel a 100 MB fetch costs minutes while
+# edge quality needs only ~100 samples per bin (measured: a 50k-row sample
+# at 200k x 500 put ~200 s of pure transfer inside every estimator fit)
+_BINNING_SAMPLE_BYTES = 32 << 20
 
 
 def _binning_sample(X_dev: jax.Array, valid: np.ndarray) -> np.ndarray:
     """Bounded strided row sample of the device-resident features for
-    quantile binning.  Fetches at most _BINNING_SAMPLE_ROWS valid rows
-    instead of round-tripping the full dataset to the host."""
+    quantile binning.  Fetches at most min(_BINNING_SAMPLE_ROWS,
+    _BINNING_SAMPLE_BYTES worth) of valid rows instead of round-tripping
+    the full dataset to the host."""
     idx = np.flatnonzero(valid)
-    if idx.size > _BINNING_SAMPLE_ROWS:
+    row_bytes = max(1, X_dev.shape[1] * X_dev.dtype.itemsize)
+    max_rows = max(2048, min(_BINNING_SAMPLE_ROWS, _BINNING_SAMPLE_BYTES // row_bytes))
+    if idx.size > max_rows:
         # ceil stride spans the FULL row range (floor would truncate to a
         # leading prefix — badly biased edges on label/time-sorted data)
-        step = -(-idx.size // _BINNING_SAMPLE_ROWS)
+        step = -(-idx.size // max_rows)
         idx = idx[::step]
     return np.asarray(X_dev[jnp.asarray(idx)])
 
