@@ -134,6 +134,70 @@ class FitInputs:
     dtype: np.dtype
     row_id: Optional[np.ndarray] = None   # original row numbers (host, unpadded)
     extra_cols: Dict[str, np.ndarray] = field(default_factory=dict)
+    # multi-controller context: which rank this process is, how many ranks
+    # cooperate, and the string control plane they share (None single-
+    # controller).  Fit functions that need host-side views of the inputs
+    # must go through the local-shard helpers below + a control-plane
+    # gather instead of np.asarray on the global arrays (which raises on
+    # arrays spanning non-addressable devices).
+    rank: int = 0
+    nranks: int = 1
+    control_plane: Any = None
+
+
+def _aligned_shard_objs(*arrays: jax.Array):
+    """Device-aligned tuples of addressable Shard objects of row-aligned
+    global arrays, ordered by global row offset.  In single-controller mode
+    this walks every shard (covering the whole array); in multi-process mode
+    it only ever touches this process's addressable shards.  Shard .data
+    stays on device — callers choose what (if anything) to fetch."""
+    primary = sorted(
+        arrays[0].addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    others = [{s.device: s for s in a.addressable_shards} for a in arrays[1:]]
+    for s in primary:
+        yield (s,) + tuple(o[s.device] for o in others)
+
+
+def _row_aligned_shards(*arrays: jax.Array):
+    """Host-numpy view of _aligned_shard_objs (fetches every local shard)."""
+    for shards in _aligned_shard_objs(*arrays):
+        yield tuple(np.asarray(s.data) for s in shards)
+
+
+def discover_label_classes(
+    inputs: FitInputs, cast: Optional[Any] = None
+) -> np.ndarray:
+    """Globally-sorted unique label values: per-rank np.unique over the
+    rank's LOCAL shards (masked by weight > 0), unioned across ranks through
+    the control plane — the reference's per-worker label discovery merged
+    over the barrier allGather (classification.py:936-1001).  Safe in
+    multi-process fits: never touches a non-addressable shard."""
+    assert inputs.y is not None
+    # the no-cast target is y's own dtype so every rank returns the same
+    # dtype even when some rank holds zero valid rows
+    target = np.dtype(cast) if cast is not None else np.dtype(inputs.y.dtype)
+    locs = []
+    for y_loc, w_loc in _row_aligned_shards(inputs.y, inputs.weight):
+        vals = y_loc[w_loc > 0]
+        if cast is not None:
+            vals = vals.astype(target)
+        if vals.size:
+            locs.append(np.unique(vals))
+    local = (
+        np.unique(np.concatenate(locs)) if locs else np.zeros(0, dtype=target)
+    )
+    if inputs.nranks > 1 and inputs.control_plane is not None:
+        from .parallel.runner import allgather_ndarray
+
+        merged = [
+            m
+            for m in allgather_ndarray(inputs.control_plane, inputs.rank, local)
+            if m.size
+        ]
+        if merged:
+            local = np.unique(np.concatenate([m.astype(target) for m in merged]))
+    return local.astype(target, copy=False)
 
 
 # fit function: (inputs, params-dict) -> model attribute dict (or list of

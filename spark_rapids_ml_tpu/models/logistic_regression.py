@@ -205,9 +205,12 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
     """Distributed logistic regression on a TPU mesh via fully-jitted
     L-BFGS/OWL-QN with psum'd loss/grad (ops/lbfgs.py, ops/logistic.py)."""
 
-    # host-side class discovery (np.unique on fetched labels) blocks
-    # multi-process fits until it moves on device
-    _supports_multicontroller_fit = False
+    # class discovery runs per-rank on local shards + control-plane union
+    # (core.discover_label_classes) and the encode is a jitted kernel over
+    # the row-sharded labels (ops/labels.py), so the whole fit is safe on a
+    # multi-process mesh — distributed-capability parity with the
+    # reference's LogisticRegressionMG (classification.py:915-1001)
+    _supports_multicontroller_fit = True
 
     def __init__(self, **kwargs: Any) -> None:
         if not kwargs.get("float32_inputs", True):
@@ -283,17 +286,19 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
             }
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
+            from ..core import discover_label_classes
+            from ..ops.labels import encode_labels_kernel
+
             assert inputs.y is not None
-            y_np = np.asarray(inputs.y)
-            valid = np.asarray(inputs.weight) > 0
-            classes = np.unique(y_np[valid])
+            classes = discover_label_classes(inputs)
             if len(classes) < 2:
                 raise RuntimeError(
                     "LogisticRegression requires at least two distinct labels"
                 )
-            # encode labels as class indices (padded rows -> 0; masked by w)
-            y_enc = jnp.asarray(
-                np.searchsorted(classes, np.where(valid, y_np, classes[0]))
+            # encode labels as class indices on device, preserving the row
+            # sharding (padded rows clamp into range; masked by w)
+            y_enc = encode_labels_kernel(
+                inputs.y, jnp.asarray(classes.astype(inputs.y.dtype))
             )
             if extra_params:
                 results = []

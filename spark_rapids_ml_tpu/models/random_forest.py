@@ -69,20 +69,82 @@ _BINNING_SAMPLE_ROWS = 16_384
 _BINNING_SAMPLE_BYTES = 32 << 20
 
 
-def _binning_sample(X_dev: jax.Array, valid: np.ndarray) -> np.ndarray:
+def _binning_sample(inputs: FitInputs) -> np.ndarray:
     """Bounded strided row sample of the device-resident features for
-    quantile binning.  Fetches at most min(_BINNING_SAMPLE_ROWS,
-    _BINNING_SAMPLE_BYTES worth) of valid rows instead of round-tripping
-    the full dataset to the host."""
-    idx = np.flatnonzero(valid)
-    row_bytes = max(1, X_dev.shape[1] * X_dev.dtype.itemsize)
-    max_rows = max(2048, min(_BINNING_SAMPLE_ROWS, _BINNING_SAMPLE_BYTES // row_bytes))
-    if idx.size > max_rows:
-        # ceil stride spans the FULL row range (floor would truncate to a
-        # leading prefix — badly biased edges on label/time-sorted data)
-        step = -(-idx.size // max_rows)
-        idx = idx[::step]
-    return np.asarray(X_dev[jnp.asarray(idx)])
+    quantile binning: per-shard strided gathers of valid rows (at most
+    min(_BINNING_SAMPLE_ROWS, _BINNING_SAMPLE_BYTES worth) across the whole
+    job), gathered across ranks through the control plane so every rank
+    computes IDENTICAL bin edges — the per-rank-sample + gather the
+    reference's byte-capped binning would do under its barrier allGather.
+    Never round-trips the full dataset to the host and never touches a
+    non-addressable shard, so it is safe in multi-process fits."""
+    from ..core import _aligned_shard_objs
+
+    X, w = inputs.X, inputs.weight
+    row_bytes = max(1, X.shape[1] * X.dtype.itemsize)
+    budget = max(
+        2048, min(_BINNING_SAMPLE_ROWS, _BINNING_SAMPLE_BYTES // row_bytes)
+    )
+    shard_pairs = list(_aligned_shard_objs(X, w))
+    # per-shard quota sized by the GLOBAL shard count, so a 2-process x
+    # 4-device fit samples exactly like a 1-process x 8-device fit over the
+    # same global row layout (identical edges either way).  The floor sits
+    # on the TOTAL (the `budget` max above), not per shard — a per-shard
+    # floor times a big mesh would overshoot the byte cap this sample
+    # exists to enforce.
+    n_shards_global = max(1, inputs.nranks) * max(1, len(shard_pairs))
+    quota = max(1, budget // n_shards_global)
+    parts = []
+    for sx, sw in shard_pairs:
+        wv = np.asarray(sw.data)
+        idx = np.flatnonzero(wv > 0)
+        if idx.size > quota:
+            # ceil stride spans the FULL row range (floor would truncate to
+            # a leading prefix — badly biased edges on label/time-sorted
+            # data)
+            step = -(-idx.size // quota)
+            idx = idx[::step]
+        if idx.size:
+            parts.append(np.asarray(sx.data[jnp.asarray(idx)]))
+    local = (
+        np.concatenate(parts)
+        if parts
+        else np.zeros((0, X.shape[1]), dtype=X.dtype)
+    )
+    if inputs.nranks > 1 and inputs.control_plane is not None:
+        from ..parallel.runner import allgather_ndarray
+
+        # the gathered total stays ~budget rows (the per-shard quota divides
+        # by nranks), so each rank posts ~budget/nranks rows worth of
+        # message — bounded by _BINNING_SAMPLE_BYTES across the whole job
+        local = np.concatenate(
+            allgather_ndarray(inputs.control_plane, inputs.rank, local)
+        ).astype(X.dtype, copy=False)
+    return local
+
+
+@partial(jax.jit, static_argnames=("n_trees", "bootstrap"))
+def _per_tree_stats(stats, weight, key, n_trees, bootstrap):
+    """(T, N, S) per-tree bootstrap-weighted stats.  Jitted so the Poisson
+    draw is generated SHARDED alongside the row-sharded weight (an eager
+    jax.random.poisson would materialize the full (T, N) matrix replicated
+    on every device — and is not expressible at all on a multi-process
+    mesh)."""
+    if bootstrap:
+        bw = jax.random.poisson(key, 1.0, (n_trees, weight.shape[0])).astype(
+            weight.dtype
+        )
+        w_t = weight[None, :] * bw
+    else:
+        w_t = jnp.broadcast_to(weight[None, :], (n_trees, weight.shape[0]))
+    return stats[None, :, :] * w_t[:, :, None]
+
+
+@jax.jit
+def _bootstrap_row_weights(weight, key):
+    """One tree's Poisson bootstrap weights, sharded like the weight row."""
+    bw = jax.random.poisson(key, 1.0, weight.shape).astype(weight.dtype)
+    return weight * bw
 
 
 def _str_or_numerical(value: str) -> Union[str, float, int]:
@@ -323,8 +385,14 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
         self._initialize_tpu_params()
         self._set_params(**kwargs)
 
-    # binning sample + label-stat encoding host-fetch the sharded inputs
-    _supports_multicontroller_fit = False
+    # binning samples per-rank local shards + control-plane gather
+    # (_binning_sample) and label stats encode on device (ops/labels.py +
+    # jax.nn.one_hot over the sharded labels), so the whole fit runs on a
+    # multi-process mesh — and unlike the reference's per-worker tree
+    # subsets over per-worker data shards (tree.py:256-267,292-397), every
+    # tree here trains on the FULL global dataset with Poisson bootstrap
+    # weights under GSPMD
+    _supports_multicontroller_fit = True
 
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         return True
@@ -418,17 +486,10 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
             )
             stats_bytes = n_trees * inputs.X.shape[0] * stats.shape[1] * 4
             if subset_bytes <= (512 << 20) and stats_bytes <= (2 << 30):
-                if bootstrap:
-                    key, kt = jax.random.split(key)
-                    bw = jax.random.poisson(
-                        kt, 1.0, (n_trees, inputs.X.shape[0])
-                    ).astype(inputs.X.dtype)
-                    w_t = inputs.weight[None, :] * bw
-                else:
-                    w_t = jnp.broadcast_to(
-                        inputs.weight[None, :], (n_trees, inputs.X.shape[0])
-                    )
-                stats_t = stats[None, :, :] * w_t[:, :, None]
+                key, kt = jax.random.split(key)
+                stats_t = _per_tree_stats(
+                    stats, inputs.weight, kt, n_trees, bootstrap
+                )
                 features, thresholds, leaf_values, node_counts, impurities = (
                     grow_forest(Xb, stats_t, edges, seed=seed, **grow_kwargs)
                 )
@@ -437,10 +498,7 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
                 for t in range(n_trees):
                     key, kt = jax.random.split(key)
                     if bootstrap:
-                        bw = jax.random.poisson(
-                            kt, 1.0, (inputs.X.shape[0],)
-                        ).astype(inputs.X.dtype)
-                        w_t = inputs.weight * bw
+                        w_t = _bootstrap_row_weights(inputs.weight, kt)
                     else:
                         w_t = inputs.weight
                     trees.append(
@@ -473,15 +531,15 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
             assert inputs.y is not None
-            valid = np.asarray(inputs.weight) > 0
             n_bins = int(params["n_bins"])
             # quantile edges from a bounded strided row sample fetched from
-            # device (a full np.asarray(inputs.X) round-trips the whole
-            # dataset over the host link — 4.8 GB at the benchmark shape)
-            X_host = _binning_sample(inputs.X, valid)
+            # the local device shards (a full np.asarray(inputs.X)
+            # round-trips the whole dataset over the host link — 4.8 GB at
+            # the benchmark shape — and raises outright multi-process)
+            X_host = _binning_sample(inputs)
             edges = compute_bin_edges(X_host, n_bins)
             Xb = bin_features(inputs.X, jnp.asarray(edges))
-            stats, extra_attrs = self._label_stats(inputs, valid)
+            stats, extra_attrs = self._label_stats(inputs)
             if extra_params:
                 results = []
                 for override in extra_params:
@@ -498,7 +556,7 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
 
         return _fit
 
-    def _label_stats(self, inputs: FitInputs, valid: np.ndarray):
+    def _label_stats(self, inputs: FitInputs):
         raise NotImplementedError
 
 
@@ -665,14 +723,18 @@ class RandomForestClassifier(_RandomForestEstimator):
         mapping["split_criterion"] = lambda x: {"gini": "gini", "entropy": "entropy"}.get(x)
         return mapping
 
-    def _label_stats(self, inputs: FitInputs, valid: np.ndarray):
-        # int32 label cast parity (classification.py:483-496)
-        y_np = np.asarray(inputs.y)
-        classes = np.unique(y_np[valid].astype(np.int32))
-        y_idx = np.searchsorted(classes, np.where(valid, y_np.astype(np.int32), classes[0]))
-        onehot = jax.nn.one_hot(
-            jnp.asarray(y_idx), len(classes), dtype=inputs.X.dtype
+    def _label_stats(self, inputs: FitInputs):
+        from ..core import discover_label_classes
+        from ..ops.labels import encode_labels_kernel
+
+        # int32 label cast parity (classification.py:483-496); discovery is
+        # per-rank local + control-plane union, encode + one-hot stay on
+        # device preserving the row sharding (multi-process safe)
+        classes = discover_label_classes(inputs, cast=np.int32)
+        y_idx = encode_labels_kernel(
+            inputs.y.astype(jnp.int32), jnp.asarray(classes)
         )
+        onehot = jax.nn.one_hot(y_idx, len(classes), dtype=inputs.X.dtype)
         return onehot, {"classes_": classes.astype(np.float64), "num_classes": len(classes)}
 
     def _create_model(self, result: Dict[str, Any]) -> "RandomForestClassificationModel":
@@ -803,7 +865,7 @@ class RandomForestRegressor(_RandomForestEstimator):
         mapping["split_criterion"] = lambda x: {"variance": "variance", "mse": "variance"}.get(x)
         return mapping
 
-    def _label_stats(self, inputs: FitInputs, valid: np.ndarray):
+    def _label_stats(self, inputs: FitInputs):
         y = inputs.y
         stats = jnp.stack([jnp.ones_like(y), y, y * y], axis=1)
         return stats, {}

@@ -1,0 +1,37 @@
+#
+# Device-side label encoding shared by the supervised classifiers.
+#
+# The class-index encode is the on-device half of the reference's label
+# handling (classification.py:936-1001 discovers the label set per worker
+# and lets cuML encode on device); here the class set is discovered via
+# core.discover_label_classes (local unique + control-plane union) and the
+# encode runs as a jitted kernel over the row-sharded labels, so no step
+# ever host-fetches a non-addressable shard — the prerequisite for
+# multi-process fits.
+#
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def encode_labels_kernel(y: jax.Array, classes: jax.Array) -> jax.Array:
+    """Class index per row: the count of classes strictly below y — exact
+    searchsorted('left') semantics on the sorted class set for y values
+    drawn from it.  Computed as a compare-accumulate over the (small) class
+    set instead of searchsorted, whose binary search lowers to per-element
+    gather chains on TPU (see ops/forest.bin_features for the same trick).
+
+    Preserves y's row sharding (elementwise over y), so it is safe on
+    global arrays in multi-process fits.  Rows whose value is outside the
+    class set (zero-padded rows, masked by weight) clamp into range."""
+
+    def body(c, acc):
+        return acc + (y > classes[c]).astype(jnp.int32)
+
+    idx = jax.lax.fori_loop(
+        0, classes.shape[0], body, jnp.zeros(y.shape, jnp.int32)
+    )
+    return jnp.minimum(idx, classes.shape[0] - 1)

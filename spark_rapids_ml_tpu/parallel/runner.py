@@ -148,6 +148,23 @@ def encode_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
     return {k: _encode_value(v) for k, v in attrs.items()}
 
 
+def allgather_ndarray(
+    control_plane: Any, rank: int, arr: np.ndarray
+) -> List[np.ndarray]:
+    """Rank-ordered allGather of one ndarray over the string control plane,
+    riding the same base64 codec as the model-attribute transport (the
+    reference ships whole serialized models through its barrier allGather
+    the same way, tree.py:316-363).  Every rank receives the identical
+    rank-ordered list, so derived quantities (bin edges, class sets) are
+    bitwise-consistent across ranks."""
+    msg = json.dumps({"rank": rank, "v": _encode_value(np.asarray(arr))})
+    blocks = sorted(
+        (json.loads(m) for m in control_plane.allGather(msg)),
+        key=lambda g: g["rank"],
+    )
+    return [_decode_value(g["v"]) for g in blocks]
+
+
 def decode_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
     return {k: _decode_value(v) for k, v in attrs.items()}
 
@@ -259,6 +276,9 @@ class DistributedFitSession:
             mesh=self.mesh,
             pdesc=pdesc,
             dtype=dtype,
+            rank=self.rank,
+            nranks=self.nranks,
+            control_plane=self.control_plane,
         )
 
     def fit(

@@ -27,8 +27,13 @@ def main() -> None:
 
     shard = np.load(os.path.join(root, f"shard_{rank}.npz"))
     part = pd.DataFrame({"features": list(shard["X"])})
-    if "y" in shard.files:
-        part["label"] = shard["y"]
+    for key in shard.files:
+        if key == "X":
+            continue
+        # "y" keeps its historical mapping to the default labelCol; any
+        # other array rides under its own name (extra label columns for
+        # the classification estimators)
+        part["label" if key == "y" else key] = shard[key]
 
     with open(os.path.join(root, "estimators.json")) as f:
         names = json.load(f)

@@ -23,7 +23,14 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from spark_rapids_ml_tpu import KMeans, LinearRegression, PCA  # noqa: E402
+from spark_rapids_ml_tpu import (  # noqa: E402
+    KMeans,
+    LinearRegression,
+    LogisticRegression,
+    PCA,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
 from spark_rapids_ml_tpu.dataframe import DataFrame  # noqa: E402
 
 NRANKS = 2
@@ -41,7 +48,15 @@ def _make_data():
     X[: N // 2] += 3.0  # two lumps so KMeans has structure
     true_w = rng.standard_normal(D).astype(np.float32)
     y = (X @ true_w + 0.1 * rng.standard_normal(N)).astype(np.float32)
-    return X, y
+    # classification labels over the same features: binary by the margin
+    # sign, 3-class by margin terciles (deliberately NOT contiguous from 0
+    # to exercise class discovery, reference classification.py:936-1001)
+    margin = X @ true_w
+    y_bin = (margin > 0).astype(np.float32)
+    y_multi = (
+        np.digitize(margin, np.quantile(margin, [1 / 3, 2 / 3])) * 2.0 + 1.0
+    ).astype(np.float32)
+    return X, y, y_bin, y_multi
 
 
 def _estimators():
@@ -50,6 +65,23 @@ def _estimators():
         "pca": PCA(k=3),
         "linreg": LinearRegression(),
         "ridge": LinearRegression(regParam=0.05),
+        # round-3 additions: the two families whose fits previously gated
+        # multi-process training (VERDICT round 2, item 1).  Both logreg
+        # arms are L2-regularized: y_bin is perfectly separable, so the
+        # unregularized optimum is at infinity and the coefficient norm
+        # would depend on the stopping point, not the data
+        "logreg_bin": LogisticRegression(
+            maxIter=60, regParam=0.01, labelCol="y_bin"
+        ),
+        "logreg_multi": LogisticRegression(
+            maxIter=60, regParam=0.01, labelCol="y_multi"
+        ),
+        "rf_clf": RandomForestClassifier(
+            numTrees=8, maxDepth=4, maxBins=16, seed=3, labelCol="y_multi"
+        ),
+        "rf_reg": RandomForestRegressor(
+            numTrees=8, maxDepth=4, maxBins=16, seed=3
+        ),
     }
 
 
@@ -66,10 +98,13 @@ def multicontroller_attrs(tmp_path_factory):
     """Stage data + estimators, run the 2-process fit once, return its
     attrs alongside the single-controller baselines."""
     root = str(tmp_path_factory.mktemp("mcjob"))
-    X, y = _make_data()
+    X, y, y_bin, y_multi = _make_data()
     halves = np.array_split(np.arange(N), NRANKS)
     for r, idx in enumerate(halves):
-        np.savez(os.path.join(root, f"shard_{r}.npz"), X=X[idx], y=y[idx])
+        np.savez(
+            os.path.join(root, f"shard_{r}.npz"),
+            X=X[idx], y=y[idx], y_bin=y_bin[idx], y_multi=y_multi[idx],
+        )
 
     ests = _estimators()
     with open(os.path.join(root, "estimators.json"), "w") as f:
@@ -104,7 +139,12 @@ def multicontroller_attrs(tmp_path_factory):
 
     # single-controller baseline on the identical global dataset (the main
     # pytest process runs an 8-device CPU mesh via conftest)
-    df = DataFrame.from_numpy(X, y)
+    import pandas as pd
+
+    pdf = pd.DataFrame(
+        {"features": list(X), "label": y, "y_bin": y_bin, "y_multi": y_multi}
+    )
+    df = DataFrame.from_pandas(pdf, num_partitions=NRANKS)
     baselines = {name: est.fit(df) for name, est in _estimators().items()}
     return payload, baselines
 
@@ -166,6 +206,61 @@ def test_linear_regression_matches_single_controller(multicontroller_attrs, name
     )
 
 
+@pytest.mark.parametrize("name", ["logreg_bin", "logreg_multi"])
+def test_logistic_regression_matches_single_controller(
+    multicontroller_attrs, name
+):
+    """LogReg across 2 OS processes (round-3 capability: VERDICT item 1).
+    Class discovery runs per-rank + control-plane union; the L-BFGS loop
+    accumulates cross-process reduction-order noise over its iterations,
+    hence looser tolerances than the closed-form solvers."""
+    payload, baselines = multicontroller_attrs
+    attrs = _decoded(payload, name)
+    b = baselines[name]
+    np.testing.assert_array_equal(attrs["classes_"], np.asarray(b.classes_))
+    np.testing.assert_allclose(
+        attrs["coef_"], np.asarray(b.coef_), rtol=5e-3, atol=5e-3
+    )
+    np.testing.assert_allclose(
+        attrs["intercept_"], np.asarray(b.intercept_), rtol=5e-3, atol=5e-3
+    )
+
+
+def test_rf_classifier_matches_single_controller(multicontroller_attrs):
+    """RandomForestClassifier across 2 OS processes: identical bin edges by
+    construction (per-shard strided sample + rank-ordered gather); split
+    decisions may flip only on float-tie reduction noise, so agreement is
+    asserted at the prediction level."""
+    payload, baselines = multicontroller_attrs
+    est = RandomForestClassifier(
+        numTrees=8, maxDepth=4, maxBins=16, seed=3, labelCol="y_multi"
+    )
+    model = est._create_model(_decoded(payload, "rf_clf"))
+    est._copyValues(model)
+    b = baselines["rf_clf"]
+    np.testing.assert_array_equal(model.classes_, b.classes_)
+    X, _, _, y_multi = _make_data()
+    df = DataFrame.from_numpy(X)
+    p_mc = model.transform(df).toPandas()["prediction"].to_numpy(np.float64)
+    p_sc = b.transform(df).toPandas()["prediction"].to_numpy(np.float64)
+    assert (p_mc == p_sc).mean() >= 0.98
+    assert (p_mc == y_multi).mean() >= 0.70  # and the model is actually good
+
+
+def test_rf_regressor_matches_single_controller(multicontroller_attrs):
+    payload, baselines = multicontroller_attrs
+    est = RandomForestRegressor(numTrees=8, maxDepth=4, maxBins=16, seed=3)
+    model = est._create_model(_decoded(payload, "rf_reg"))
+    est._copyValues(model)
+    b = baselines["rf_reg"]
+    X, y, _, _ = _make_data()
+    df = DataFrame.from_numpy(X)
+    p_mc = model.transform(df).toPandas()["prediction"].to_numpy(np.float64)
+    p_sc = b.transform(df).toPandas()["prediction"].to_numpy(np.float64)
+    resid = p_mc - p_sc
+    assert float(np.sqrt((resid**2).mean())) < 0.05 * float(p_sc.std())
+
+
 def test_model_rebuilt_from_barrier_attrs_transforms(multicontroller_attrs):
     """Driver-side model construction from the gathered attrs (what
     barrier_fit_estimator hands to _create_model) predicts sensibly."""
@@ -174,7 +269,7 @@ def test_model_rebuilt_from_barrier_attrs_transforms(multicontroller_attrs):
     est = LinearRegression()
     model = est._create_model(attrs)
     est._copyValues(model)
-    X, y = _make_data()
+    X, y, _, _ = _make_data()
     preds = model.transform(DataFrame.from_numpy(X)).toPandas()["prediction"]
     resid = np.asarray(preds, dtype=np.float64) - y
     assert float(np.sqrt((resid**2).mean())) < 0.2
