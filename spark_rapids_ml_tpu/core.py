@@ -49,6 +49,16 @@ def _is_pyspark_dataframe(dataset: Any) -> bool:
     return (type(dataset).__module__ or "").startswith("pyspark.sql")
 
 
+def _use_executor_path(dataset: Any) -> bool:
+    """Whether a dataset should run on the Spark executors (barrier fit /
+    mapInPandas transform) rather than driver-local: a live pyspark
+    DataFrame, unless SRML_SPARK_COLLECT=1 forces the old collect path
+    (single TPU-VM notebooks where the driver owns the chips)."""
+    return _is_pyspark_dataframe(dataset) and os.environ.get(
+        "SRML_SPARK_COLLECT", "0"
+    ) != "1"
+
+
 def _maybe_x64(dtype: Any):
     """jax x64 scope for float64 fits; a no-op for float32."""
     import contextlib
@@ -403,9 +413,7 @@ class _TpuCaller(_TpuParams):
         mesh — the dataset is never collected to the driver.  Set
         SRML_SPARK_COLLECT=1 to force the old driver-local collect path
         (single TPU-VM notebooks where the driver owns the chips)."""
-        if _is_pyspark_dataframe(dataset) and os.environ.get(
-            "SRML_SPARK_COLLECT", "0"
-        ) != "1":
+        if _use_executor_path(dataset):
             from .spark.adapter import barrier_fit_estimator
 
             # driver-side input-column check BEFORE launching the barrier
@@ -627,7 +635,17 @@ class _TpuModel(_TpuParams):
     def transform(self, dataset: Any) -> DataFrame:
         """Column-appending inference (reference _CumlModelWithColumns._transform
         core.py:1277-1361): original columns are preserved, output columns
-        named by the *Col params are appended."""
+        named by the *Col params are appended.
+
+        A live pyspark DataFrame runs partition-wise ON THE EXECUTORS via
+        mapInPandas with the model riding the closure — the dataset is never
+        collected to the driver (reference core.py:1277-1361; UMAP's
+        distributed inference, umap.py:1147-1224).  SRML_SPARK_COLLECT=1
+        forces the old driver-local collect path."""
+        if _use_executor_path(dataset):
+            from .spark.adapter import executor_transform
+
+            return executor_transform(self, dataset)
         df = as_dataframe(dataset)
         input_col, input_cols = self._get_input_columns()
         dtype = self._transform_dtype(self._model_attributes.get("dtype"))
@@ -684,6 +702,24 @@ class _TpuModel(_TpuParams):
             if self.hasParam(p) and self.isDefined(p):
                 cols.append(self.getOrDefault(p))
         return cols
+
+    _OUT_COLUMN_DDL = {
+        "predictionCol": "double",
+        "probabilityCol": "array<double>",
+        "rawPredictionCol": "array<double>",
+        "outputCol": "array<double>",
+    }
+
+    def _out_schema_fields(self) -> List[Tuple[str, str]]:
+        """(column name, Spark DDL type) per appended output column — the
+        executor-transform mapInPandas schema (the reference's typed
+        prediction columns, core.py:1294-1361).  Models whose outputs
+        deviate from the defaults override _OUT_COLUMN_DDL."""
+        return [
+            (self.getOrDefault(p), self._OUT_COLUMN_DDL[p])
+            for p in ("predictionCol", "probabilityCol", "rawPredictionCol", "outputCol")
+            if self.hasParam(p) and self.isDefined(p)
+        ]
 
     # -- abstract ----------------------------------------------------------
     @abstractmethod

@@ -103,14 +103,31 @@ class MulticlassMetrics:
             ll,
         )
 
+    def to_row(self, model_index: int) -> dict:
+        """JSON-safe partial tagged with its model index; inverse of
+        _from_rows (the executor-side evaluate ships partials this way,
+        reference core.py:1159-1176)."""
+        return {
+            "model_index": model_index,
+            "tp": self._tp,
+            "fp": self._fp,
+            "label_count_by_class": self._label_count_by_class,
+            "label_count": self._label_count,
+            "log_loss": self._log_loss,
+        }
+
     @classmethod
     def _from_rows(cls, num_models: int, rows: List[dict]) -> List["MulticlassMetrics"]:
+        def _fkeys(d: dict) -> dict:
+            # JSON stringifies the float class keys; coerce them back
+            return {float(k): v for k, v in d.items()}
+
         out: List[MulticlassMetrics] = [None] * num_models  # type: ignore[list-item]
         for row in rows:
             metric = cls(
-                tp=row["tp"],
-                fp=row["fp"],
-                label=row["label_count_by_class"],
+                tp=_fkeys(row["tp"]),
+                fp=_fkeys(row["fp"]),
+                label=_fkeys(row["label_count_by_class"]),
                 label_count=row["label_count"],
                 log_loss=row.get("log_loss", -1.0),
             )

@@ -111,6 +111,20 @@ class RegressionMetrics:
     def from_arrays(cls, labels: np.ndarray, preds: np.ndarray) -> "RegressionMetrics":
         return cls(_SummarizerBuffer.from_arrays(labels, preds))
 
+    def to_row(self, model_index: int) -> dict:
+        """JSON-safe partial tagged with its model index; inverse of
+        _from_rows (the executor-side evaluate ships partials this way,
+        reference RegressionMetrics.py:175-195)."""
+        s = self._summary
+        return {
+            "model_index": model_index,
+            "mean": s.mean_.tolist(),
+            "m2n": s.m2n_.tolist(),
+            "m2": s.m2_.tolist(),
+            "l1": s.l1_.tolist(),
+            "total_count": s.count,
+        }
+
     @classmethod
     def _from_rows(cls, num_models: int, rows: List[dict]) -> List["RegressionMetrics"]:
         """Merge per-partition metric rows tagged with model_index (reference

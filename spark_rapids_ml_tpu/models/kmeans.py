@@ -197,6 +197,11 @@ class KMeans(_KMeansParams, _TpuEstimator):
 
 
 class KMeansModel(_KMeansParams, _TpuModelWithPredictionCol):
+    # cluster ids are integral (Spark KMeansModel emits IntegerType)
+    _OUT_COLUMN_DDL = {
+        **_TpuModelWithPredictionCol._OUT_COLUMN_DDL, "predictionCol": "int"
+    }
+
     def __init__(
         self,
         cluster_centers_: np.ndarray,

@@ -56,31 +56,53 @@ class _RegressionModelEvaluationMixIn:
     """Single-pass transform+evaluate shared by LinearRegressionModel and
     RandomForestRegressionModel (reference regression.py:85-168)."""
 
+    def _partition_metrics(
+        self, part: Any, evaluator: Any, num_models: int, predict_all=None
+    ) -> List[RegressionMetrics]:
+        """One partition's per-model mergeable metric partials — shared by
+        the local evaluate loop and the Spark executor UDF.  Callers looping
+        over partitions pass a hoisted predict_all so the model arrays are
+        device-staged once per evaluate, not once per partition."""
+        from ..core import extract_partition_features
+
+        input_col, input_cols = self._get_input_columns()
+        dtype = self._transform_dtype(self._model_attributes.get("dtype"))
+        feats = extract_partition_features(part, input_col, input_cols, dtype)
+        labels = part[self.getOrDefault("labelCol")].to_numpy()
+        if predict_all is None:
+            predict_all = self._get_eval_predict_func()
+        preds = predict_all(feats)  # (num_models, n)
+        return [
+            RegressionMetrics.from_arrays(labels, preds[i])
+            for i in range(num_models)
+        ]
+
     def _transform_evaluate(
         self, dataset: Any, evaluator: Any, num_models: int
     ) -> List[float]:
+        from ..core import _use_executor_path
         from ..evaluation import RegressionEvaluator
 
         if not isinstance(evaluator, RegressionEvaluator):
             raise NotImplementedError(f"{evaluator} is unsupported yet.")
+        if _use_executor_path(dataset):
+            from ..spark.adapter import executor_transform_evaluate
+
+            return executor_transform_evaluate(
+                self, dataset, evaluator, num_models
+            )
         df = as_dataframe(dataset)
         label_col = self.getOrDefault("labelCol")
         if label_col not in df.columns:
             raise RuntimeError("Label column is not existing.")
         predict_all = self._get_eval_predict_func()
-        input_col, input_cols = self._get_input_columns()
-        dtype = self._transform_dtype(self._model_attributes.get("dtype"))
         metrics: List[Optional[RegressionMetrics]] = [None] * num_models
         for part in df.partitions:
             if len(part) == 0:
                 continue
-            from ..core import extract_partition_features
-
-            feats = extract_partition_features(part, input_col, input_cols, dtype)
-            labels = part[label_col].to_numpy()
-            preds = predict_all(feats)  # (num_models, n)
-            for i in range(num_models):
-                m = RegressionMetrics.from_arrays(labels, preds[i])
+            for i, m in enumerate(
+                self._partition_metrics(part, evaluator, num_models, predict_all)
+            ):
                 metrics[i] = m if metrics[i] is None else metrics[i].merge(m)
         return [m.evaluate(evaluator) for m in metrics]  # type: ignore[union-attr]
 

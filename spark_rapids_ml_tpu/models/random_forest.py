@@ -32,6 +32,8 @@ import jax.numpy as jnp
 
 from ..core import FitInputs, _TpuEstimatorSupervised, _TpuModelWithPredictionCol
 from ..dataframe import DataFrame
+from .linear_regression import _RegressionModelEvaluationMixIn
+from .logistic_regression import _ClassificationModelEvaluationMixIn
 from ..params import (
     HasFeaturesCol,
     HasFeaturesCols,
@@ -742,7 +744,10 @@ class RandomForestClassifier(_RandomForestEstimator):
 
 
 class RandomForestClassificationModel(
-    HasProbabilityCol, HasRawPredictionCol, _RandomForestModelBase
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    _ClassificationModelEvaluationMixIn,
+    _RandomForestModelBase,
 ):
     def __init__(
         self,
@@ -832,11 +837,7 @@ class RandomForestClassificationModel(
         return probs / max(probs.sum(), 1e-12)
 
     def _transformEvaluate(self, dataset: Any, evaluator: Any, params=None) -> List[float]:
-        from .logistic_regression import _ClassificationModelEvaluationMixIn
-
-        return _ClassificationModelEvaluationMixIn._transform_evaluate(
-            self, dataset, evaluator, self._num_models
-        )
+        return self._transform_evaluate(dataset, evaluator, self._num_models)
 
     def cpu(self):
         """Convert to pyspark.ml RandomForestClassificationModel via py4j
@@ -874,7 +875,9 @@ class RandomForestRegressor(_RandomForestEstimator):
         return RandomForestRegressionModel(**result)
 
 
-class RandomForestRegressionModel(_RandomForestModelBase):
+class RandomForestRegressionModel(
+    _RegressionModelEvaluationMixIn, _RandomForestModelBase
+):
     def __init__(
         self,
         features_: np.ndarray,
@@ -926,11 +929,7 @@ class RandomForestRegressionModel(_RandomForestModelBase):
         return float(self._predict_values(np.asarray(value)[None, :])[0, 0])
 
     def _transformEvaluate(self, dataset: Any, evaluator: Any, params=None) -> List[float]:
-        from .linear_regression import _RegressionModelEvaluationMixIn
-
-        return _RegressionModelEvaluationMixIn._transform_evaluate(
-            self, dataset, evaluator, self._num_models
-        )
+        return self._transform_evaluate(dataset, evaluator, self._num_models)
 
     def cpu(self):
         """Convert to pyspark.ml RandomForestRegressionModel via py4j tree

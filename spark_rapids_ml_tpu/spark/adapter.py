@@ -183,6 +183,164 @@ def infer_spark_num_workers(estimator: Any, spark: Any) -> int:
     return 1
 
 
+# -- executor-side inference -------------------------------------------------
+# model.transform / _transformEvaluate on a live pyspark DataFrame run as
+# mapInPandas on the executors with the model riding the task closure —
+# the dataset is NEVER collected to the driver (reference executor-side
+# transform core.py:1277-1361 and UMAP's distributed inference
+# umap.py:1147-1224).
+
+
+def serialize_model(model: Any) -> Dict[str, Any]:
+    """JSON-safe {metadata, attrs} payload (the npz-persistence split of
+    core._TpuModelWriter, with arrays base64-encoded by the runner codec) —
+    compact enough for Spark closure capture / broadcast."""
+    from ..core import _params_metadata
+    from ..parallel.runner import encode_attrs
+
+    return {
+        "metadata": _params_metadata(model),
+        "attrs": encode_attrs(model._get_model_attributes()),
+    }
+
+
+def deserialize_model(payload: Dict[str, Any]) -> Any:
+    from ..core import _apply_params_metadata, _resolve_class
+    from ..parallel.runner import decode_attrs
+
+    cls = _resolve_class(payload["metadata"]["class"])
+    model = cls(**decode_attrs(payload["attrs"]))
+    _apply_params_metadata(payload["metadata"], model)
+    return model
+
+
+def transform_output_ddl(model: Any, sdf: Any) -> str:
+    """mapInPandas output schema: every input field plus the model's output
+    columns (the reference appends typed prediction columns the same way,
+    core.py:1294-1361).  Built as a DDL string from simpleString() so only
+    the sdf's own schema objects are touched (no pyspark type imports)."""
+    out_fields = dict(model._out_schema_fields())
+    # an input column sharing an output column's name is REPLACED, type
+    # included (pyspark withColumn semantics — and the UDF overwrites the
+    # values, so the schema must declare the output's type)
+    fields = [
+        f"`{f.name}` {out_fields.get(f.name, f.dataType.simpleString())}"
+        for f in sdf.schema.fields
+    ]
+    existing = {f.name for f in sdf.schema.fields}
+    for name, ddl in out_fields.items():
+        if name not in existing:
+            fields.append(f"`{name}` {ddl}")
+    return ", ".join(fields)
+
+
+def _prepare_features_for_arrow(model: Any, sdf: Any) -> Any:
+    """Cast a VectorUDT features column to array<double> so Arrow can ship
+    it to the executors (the reference's _pre_process_data does the same
+    vector_to_array cast, core.py:1043-1124)."""
+    input_col, _ = model._get_input_columns()
+    if input_col is None:
+        return sdf
+    for f in sdf.schema.fields:
+        if f.name == input_col and f.dataType.simpleString() == "vector":
+            from pyspark.ml.functions import vector_to_array
+            from pyspark.sql.functions import col
+
+            return sdf.withColumn(input_col, vector_to_array(col(input_col)))
+    return sdf
+
+
+def executor_transform(model: Any, sdf: Any) -> Any:
+    """model.transform(pyspark_df) partition-wise on the executors.  Returns
+    a pyspark DataFrame with the output columns appended; lazy like any
+    mapInPandas — nothing runs until an action."""
+    sdf = _prepare_features_for_arrow(model, sdf)
+    payload = serialize_model(model)
+    schema = transform_output_ddl(model, sdf)
+    out_fields = model._out_schema_fields()
+
+    def _predict_udf(iterator):
+        from ..core import extract_partition_features
+
+        m = deserialize_model(payload)
+        fn = m._get_tpu_transform_func(None)
+        input_col, input_cols = m._get_input_columns()
+        dtype = m._transform_dtype(m._model_attributes.get("dtype"))
+        casts = dict(out_fields)
+        for pdf in iterator:
+            out = pdf.copy()
+            if len(pdf) == 0:
+                for name, _t in out_fields:
+                    out[name] = []
+                yield out
+                continue
+            feats = extract_partition_features(
+                pdf, input_col, input_cols, dtype,
+                densify_sparse=not m._supports_sparse_input,
+            )
+            for name, values in fn(feats).items():
+                if isinstance(values, np.ndarray) and values.ndim == 2:
+                    out[name] = list(values)
+                elif casts.get(name) == "int":
+                    out[name] = np.asarray(values, dtype=np.int32)
+                else:
+                    out[name] = np.asarray(values, dtype=np.float64)
+            yield out
+
+    return sdf.mapInPandas(_predict_udf, schema=schema)
+
+
+def executor_transform_evaluate(
+    model: Any, sdf: Any, evaluator: Any, num_models: int
+) -> List[float]:
+    """_transformEvaluate on a live pyspark DataFrame: per-partition
+    mergeable metric partials computed executor-side (one JSON row per
+    partition per model, tagged model_index), merged and scored on the
+    driver — the reference's single-pass transform-evaluate
+    (core.py:1126-1178).  Only metric rows ever reach the driver."""
+    import json
+
+    from ..evaluation import (
+        MulticlassClassificationEvaluator,
+        RegressionEvaluator,
+    )
+    from ..metrics.multiclass import MulticlassMetrics
+    from ..metrics.regression import RegressionMetrics
+
+    if isinstance(evaluator, MulticlassClassificationEvaluator):
+        metrics_cls: Any = MulticlassMetrics
+    elif isinstance(evaluator, RegressionEvaluator):
+        metrics_cls = RegressionMetrics
+    else:
+        raise NotImplementedError(f"{evaluator} is unsupported yet.")
+    label_col = model.getOrDefault("labelCol")
+    if label_col not in sdf.columns:
+        raise RuntimeError("Label column is not existing.")
+    sdf = _prepare_features_for_arrow(model, sdf)
+    payload = serialize_model(model)
+
+    def _metrics_udf(iterator):
+        m = deserialize_model(payload)
+        predict_all = m._get_eval_predict_func()  # staged once per task
+        for pdf in iterator:
+            if len(pdf) == 0:
+                continue
+            rows = [
+                json.dumps(metric.to_row(i))
+                for i, metric in enumerate(
+                    m._partition_metrics(pdf, evaluator, num_models, predict_all)
+                )
+            ]
+            yield pd.DataFrame({"metrics_json": rows})
+
+    rows = [
+        json.loads(r["metrics_json"])
+        for r in sdf.mapInPandas(_metrics_udf, schema="metrics_json string").collect()
+    ]
+    metrics = metrics_cls._from_rows(num_models, rows)
+    return [m.evaluate(evaluator) for m in metrics]
+
+
 def barrier_fit_estimator(
     estimator: Any,
     sdf: Any,
