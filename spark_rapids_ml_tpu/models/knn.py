@@ -31,7 +31,6 @@ from ..params import (
     _TpuParams,
 )
 from ..parallel.mesh import get_mesh
-from ..ops.knn import knn_search
 
 
 class NearestNeighborsClass(_TpuParams):
@@ -119,55 +118,88 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         super().__init__()
         self._item_df = item_df
 
-    def _extract_features(self, df: DataFrame, dtype) -> np.ndarray:
+    def _iter_item_blocks(self, id_col: str, dtype, mesh):
+        """(features, ids) stream over the item partitions — the host never
+        holds more than one partition before the device-block packer."""
         from ..core import extract_partition_features
+        from ..ops.knn import iter_prepared_item_blocks
 
         input_col, input_cols = self._get_input_columns()
-        parts = []
-        for part in df.partitions:
-            if len(part) == 0:
-                continue
-            # block-aware: sparse CSR partitions densify here (kNN's brute
-            # distance kernel is dense)
-            parts.append(
-                extract_partition_features(part, input_col, input_cols, dtype)
-            )
-        if not parts:
-            return np.zeros((0, 0), dtype=dtype)
-        return np.concatenate(parts, axis=0)
+
+        def _parts():
+            for part in self._item_df.partitions:
+                if len(part) == 0:
+                    continue
+                yield (
+                    extract_partition_features(part, input_col, input_cols, dtype),
+                    np.asarray(part[id_col].to_numpy(), np.int64),
+                )
+
+        return iter_prepared_item_blocks(_parts(), mesh, dtype)
 
     def kneighbors(
         self, query_df: Any
     ) -> Tuple[DataFrame, DataFrame, DataFrame]:
         """Exact k nearest item neighbors for every query row; float32
-        euclidean (the reference converts all input to float32, knn.py:425)."""
+        euclidean (the reference converts all input to float32, knn.py:425).
+
+        Partition-streamed on BOTH sides (the reference keeps partitions on
+        the workers and exchanges p2p, knn.py:452-560): item partitions pack
+        into device-resident blocks one at a time, each query partition's
+        candidates merge on the host, and the result frame keeps the query
+        partitioning.  Peak driver memory is O(one item block + one query
+        partition + k * n_query) — never the concatenated item set."""
         assert self._item_df is not None, "fit() must be called before kneighbors"
+        from ..core import extract_partition_features
+        from ..ops.knn import knn_search_streamed
+
         qdf = as_dataframe(query_df)
         id_col = self.getIdCol()
         if id_col not in qdf.columns:
             qdf = qdf.with_row_id(id_col)
         dtype = np.float32
-        items = self._extract_features(self._item_df, dtype)
-        queries = self._extract_features(qdf, dtype)
-        if queries.shape[0] == 0:
+        input_col, input_cols = self._get_input_columns()
+        q_parts = list(qdf.partitions)  # ALL partitions: the result frame
+        # must align partition-for-partition with the query frame
+        if not any(len(p) > 0 for p in q_parts):
             empty = pd.DataFrame(
                 {f"query_{id_col}": [], "indices": [], "distances": []}
             )
-            return self._item_df, qdf, DataFrame.from_pandas(empty, 1)
-        item_ids = self._item_df.toPandas()[id_col].to_numpy()
-        query_ids = qdf.toPandas()[id_col].to_numpy()
-        k = min(self.getK(), items.shape[0])
+            return (
+                self._item_df,
+                qdf,
+                DataFrame([empty.copy() for _ in range(max(1, len(q_parts)))]),
+            )
+
+        def _query_feats(p: int) -> np.ndarray:
+            if len(q_parts[p]) == 0:
+                return np.zeros((0, 0), dtype=dtype)
+            return extract_partition_features(
+                q_parts[p], input_col, input_cols, dtype
+            )
+
         mesh = get_mesh(self.num_workers)
-        dists, ids = knn_search(items, item_ids.astype(np.int64), queries, k, mesh)
-        knn_pdf = pd.DataFrame(
-            {
-                f"query_{id_col}": query_ids,
-                "indices": list(ids.astype(item_ids.dtype)),
-                "distances": list(dists.astype(np.float32)),
-            }
+        per_part = knn_search_streamed(
+            self._iter_item_blocks(id_col, dtype, mesh),
+            _query_feats,
+            len(q_parts),
+            self.getK(),
+            mesh,
         )
-        knn_df = DataFrame.from_pandas(knn_pdf, qdf.num_partitions)
-        return self._item_df, qdf, knn_df
+        out_parts = []
+        for part, (dists, ids) in zip(q_parts, per_part):
+            out_parts.append(
+                pd.DataFrame(
+                    {
+                        f"query_{id_col}": part[id_col].to_numpy()
+                        if len(part)
+                        else np.zeros(0, np.int64),
+                        "indices": list(ids),
+                        "distances": list(dists.astype(np.float32)),
+                    }
+                )
+            )
+        return self._item_df, qdf, DataFrame(out_parts)
 
     def exactNearestNeighborsJoin(
         self, query_df: Any, distCol: str = "distCol"

@@ -310,6 +310,35 @@ def prepare_items(
 _DEFAULT_HBM_BUDGET = 8 << 30
 
 
+def _hbm_budget_bytes() -> int:
+    import os
+
+    return int(os.environ.get("SRML_KNN_HBM_BUDGET", _DEFAULT_HBM_BUDGET))
+
+
+def _item_block_rows(n_cols: int, itemsize: int, n_dev: int) -> int:
+    """Rows per streamed item block under the per-replica HBM budget,
+    rounded to a device multiple so blocks row-shard without pad waste."""
+    rows = max(
+        n_dev, (_hbm_budget_bytes() * n_dev) // max(n_cols * itemsize, 1)
+    )
+    rows -= rows % n_dev
+    return max(rows, n_dev)
+
+
+def _pad_topk_to_k(d: np.ndarray, i: np.ndarray, k: int):
+    """Pad a candidate list out to k columns (a block smaller than k returns
+    fewer) so running merges always keep k candidates — merging at a
+    narrower width would silently drop neighbors from later blocks."""
+    if d.shape[1] >= k:
+        return d[:, :k], i[:, :k]
+    pad = k - d.shape[1]
+    return (
+        np.pad(d, ((0, 0), (0, pad)), constant_values=np.inf),
+        np.pad(i, ((0, 0), (0, pad)), constant_values=-1),
+    )
+
+
 def knn_search(
     items: np.ndarray,
     item_ids: np.ndarray,
@@ -323,19 +352,13 @@ def knn_search(
     jitted kernel (block sizes are power-of-two buckets so the number of
     compiled shapes is bounded; partial blocks padded).  Item sets too large
     for HBM take the out-of-core route (knn_search_out_of_core)."""
-    import os
-
     items = np.asarray(items, dtype=dtype)
-    budget = int(os.environ.get("SRML_KNN_HBM_BUDGET", _DEFAULT_HBM_BUDGET))
     n_dev = mesh.shape[DATA_AXIS]
     # items are row-sharded, so the per-replica residency is nbytes / n_dev
-    if items.nbytes > budget * n_dev:
-        block_rows = max(
-            n_dev, (budget * n_dev) // max(items.shape[1] * items.itemsize, 1)
-        )
-        block_rows -= block_rows % n_dev
+    if items.nbytes > _hbm_budget_bytes() * n_dev:
+        block_rows = _item_block_rows(items.shape[1], items.itemsize, n_dev)
         return knn_search_out_of_core(
-            items, item_ids, queries, k, mesh, max(block_rows, n_dev), query_block, dtype
+            items, item_ids, queries, k, mesh, block_rows, query_block, dtype
         )
     prepared = prepare_items(items, item_ids, mesh, dtype)
     return knn_search_prepared(prepared, queries, k, mesh, query_block, dtype)
@@ -367,26 +390,104 @@ def knn_search_out_of_core(
         stop = min(start + item_block, n_items)
         prepared = prepare_items(items[start:stop], item_ids[start:stop], mesh, dtype)
         d, i = knn_search_prepared(prepared, queries, k, mesh, query_block, dtype)
-        # pad every block's candidate list out to k columns (a block smaller
-        # than k returns fewer) so the running merge always keeps k
-        # candidates — merging at a narrower width would silently drop
-        # neighbors contributed by later blocks
-        def _pad(dd, ii):
-            if dd.shape[1] >= k:
-                return dd[:, :k], ii[:, :k]
-            pad = k - dd.shape[1]
-            return (
-                np.pad(dd, ((0, 0), (0, pad)), constant_values=np.inf),
-                np.pad(ii, ((0, 0), (0, pad)), constant_values=-1),
-            )
-
-        d, i = _pad(d, i)
+        d, i = _pad_topk_to_k(d, i, k)
         if best_d is None:
             best_d, best_i = d, i
         else:
             best_d, best_i = native.topk_merge(best_d, best_i, d, i)
     k_eff = min(k, n_items)
     return best_d[:, :k_eff], best_i[:, :k_eff]
+
+
+def iter_prepared_item_blocks(part_iter, mesh: Mesh, dtype=np.float32):
+    """Pack a stream of (features, ids) partition chunks into device-prepared
+    item blocks bounded by the per-replica HBM budget.  The host only ever
+    holds ONE block's features (plus the incoming partition) — the full item
+    set is never concatenated driver-side, which is what lets kneighbors run
+    with item frames far larger than one partition (reference keeps item
+    partitions executor-resident the same way, knn.py:452-560)."""
+    n_dev = mesh.shape[DATA_AXIS]
+    block_bytes = _hbm_budget_bytes() * n_dev
+    buf_f: list = []
+    buf_i: list = []
+    nbytes = 0
+
+    def _flush():
+        feats = np.concatenate(buf_f) if len(buf_f) > 1 else buf_f[0]
+        ids = np.concatenate(buf_i) if len(buf_i) > 1 else buf_i[0]
+        buf_f.clear()
+        buf_i.clear()
+        return prepare_items(feats, np.asarray(ids, np.int64), mesh, dtype)
+
+    for feats, ids in part_iter:
+        feats = np.asarray(feats, dtype=dtype)
+        if feats.shape[0] == 0:
+            continue
+        # split partitions that alone exceed the block budget
+        rows_per_block = _item_block_rows(feats.shape[1], feats.itemsize, n_dev)
+        for s in range(0, feats.shape[0], rows_per_block):
+            fb = feats[s : s + rows_per_block]
+            ib = np.asarray(ids)[s : s + rows_per_block]
+            if nbytes + fb.nbytes > block_bytes and buf_f:
+                yield _flush()
+                nbytes = 0
+            buf_f.append(fb)
+            buf_i.append(ib)
+            nbytes += fb.nbytes
+    if buf_f:
+        yield _flush()
+
+
+def knn_search_streamed(
+    item_block_iter,
+    query_feats_fn,
+    n_query_parts: int,
+    k: int,
+    mesh: Mesh,
+    query_block: int = 8192,
+    dtype=np.float32,
+):
+    """Exact kNN with BOTH sides streamed: item blocks visit the device once
+    (outer loop); each query partition's features are produced on demand by
+    `query_feats_fn(p)` (inner loop) and its running best-k merges on the
+    host via the native runtime.  Host state: one item block + one query
+    partition + the (n_query, k) running merges — never the full item set.
+
+    Returns per-query-partition lists (dists, ids) trimmed to
+    min(k, total items)."""
+    from .. import native
+
+    if n_query_parts == 0:
+        # nothing to search for — never consume (and device-stage) the
+        # item stream
+        return []
+    best: list = [None] * n_query_parts
+    total_items = 0
+    for prepared in item_block_iter:
+        total_items += prepared.n_items
+        for p in range(n_query_parts):
+            q = query_feats_fn(p)
+            if q.shape[0] == 0:
+                continue
+            d, i = knn_search_prepared(prepared, q, k, mesh, query_block, dtype)
+            d, i = _pad_topk_to_k(d, i, k)
+            if best[p] is None:
+                best[p] = (d, i)
+            else:
+                best[p] = native.topk_merge(best[p][0], best[p][1], d, i)
+    k_eff = min(k, total_items) if total_items else 0
+    out = []
+    for p in range(n_query_parts):
+        if best[p] is None:
+            # empty partition — or an empty ITEM set, where every partition
+            # gets (its row count, 0) so result assembly keeps row alignment
+            rows = query_feats_fn(p).shape[0]
+            out.append(
+                (np.zeros((rows, k_eff), dtype), np.zeros((rows, k_eff), np.int64))
+            )
+        else:
+            out.append((best[p][0][:, :k_eff], best[p][1][:, :k_eff]))
+    return out
 
 
 def knn_search_prepared(

@@ -182,3 +182,60 @@ def test_topk_approx_verified_ties():
     # indices must address entries carrying the returned values
     got_vals = np.take_along_axis(base, np.asarray(ai), axis=1)
     np.testing.assert_allclose(np.sort(got_vals, 1), np.sort(av, 1))
+
+
+def test_kneighbors_streams_item_partitions(monkeypatch):
+    """kneighbors with item data >> one partition must stream bounded item
+    blocks to the device — never concatenate the full item set on the driver
+    (VERDICT round 2, item 3; reference keeps partitions worker-resident,
+    knn.py:452-560) — and must keep the query partitioning in the result."""
+    import spark_rapids_ml_tpu.ops.knn as knn_mod
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    rng = np.random.default_rng(11)
+    n_items, n_query, d, k = 3000, 200, 16, 7
+    X = rng.standard_normal((n_items, d)).astype(np.float32)
+    Q = rng.standard_normal((n_query, d)).astype(np.float32)
+
+    # tiny HBM budget: with 8 virtual devices, block bytes = budget * 8;
+    # pick it so the item set splits into several blocks
+    block_rows_target = 512
+    monkeypatch.setenv(
+        "SRML_KNN_HBM_BUDGET", str(block_rows_target * d * 4 // 8)
+    )
+    seen_blocks = []
+    real_prepare = knn_mod.prepare_items
+
+    def spy_prepare(items, ids, mesh, dtype=np.float32):
+        seen_blocks.append(items.shape[0])
+        return real_prepare(items, ids, mesh, dtype)
+
+    monkeypatch.setattr(knn_mod, "prepare_items", spy_prepare)
+
+    item_df = DataFrame.from_pandas(
+        pd.DataFrame({"features": list(X)}), num_partitions=6
+    )
+    query_df = DataFrame.from_pandas(
+        pd.DataFrame({"features": list(Q)}), num_partitions=3
+    )
+    # an EMPTY query partition must survive with an empty result partition
+    # (partition-for-partition alignment with the query frame)
+    query_df.partitions.insert(1, query_df.partitions[0].iloc[:0].copy())
+    model = NearestNeighbors(k=k).fit(item_df)
+    _, qdf_withid, knn_df = model.kneighbors(query_df)
+
+    # streaming happened: multiple bounded blocks, never the full item set
+    assert len(seen_blocks) >= 4
+    assert max(seen_blocks) < n_items
+    # result keeps the query partitioning, empty partition included
+    assert knn_df.num_partitions == query_df.num_partitions == 4
+    assert len(knn_df.partitions[1]) == 0
+    # and the streamed result is exact
+    knn_pdf = knn_df.toPandas()
+    order = np.argsort(knn_pdf["query_unique_id"].to_numpy())
+    got_ids = np.stack(knn_pdf["indices"].to_numpy()[order])
+    got_d = np.stack(knn_pdf["distances"].to_numpy()[order])
+    sk_d, sk_i = SkNN(n_neighbors=k).fit(X).kneighbors(Q)
+    np.testing.assert_allclose(got_d, sk_d, rtol=1e-4, atol=1e-4)
+    # ids may differ on exact distance ties; compare distances + majority ids
+    assert (got_ids == sk_i).mean() > 0.99
