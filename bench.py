@@ -274,7 +274,10 @@ def build_arm(algo: str, overrides):
         n_query = int(_ov("SRML_BENCH_QUERIES", min(rows, 8192)))
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.ops.knn import knn_block_kernel
+        from spark_rapids_ml_tpu.ops.knn import (
+            knn_block_adaptive,
+            knn_block_kernel,
+        )
 
         # index + queries GENERATED on device: the metric is query
         # throughput against a resident index (the reference's GPU arm also
@@ -308,11 +311,34 @@ def build_arm(algo: str, overrides):
         _sync(norm_dev.sum())
         _sync(Q_dev.sum())
 
+        # mirror the production gate (ops/knn.py knn_search_prepared): the
+        # adaptive kernel needs a full chunk per SHARD and its k bound
+        from spark_rapids_ml_tpu.ops.knn import (
+            _ADAPTIVE_CHUNK,
+            _ADAPTIVE_MIN_LOCAL,
+        )
+
+        n_loc_bench = n_pad // max(1, n_dev)
+        on_tpu_wide = (
+            jax.default_backend() == "tpu"
+            and n_loc_bench >= max(_ADAPTIVE_MIN_LOCAL, _ADAPTIVE_CHUNK)
+            and k <= _ADAPTIVE_CHUNK // 8
+        )
+
         def fit():
-            d, pos = knn_block_kernel(
-                items_dev, norm_dev, pos_dev, valid_dev, Q_dev, mesh, k,
-            )
-            ids_out = ids_host[np.asarray(pos)]
+            if on_tpu_wide:
+                # adaptive exact path (ops/knn.py knn_block_adaptive):
+                # raw hardware approx + global count-verify + per-row
+                # exact fallback — the production route for this shape
+                d, pos = knn_block_adaptive(
+                    items_dev, norm_dev, pos_dev, valid_dev, Q_dev, mesh, k,
+                )
+            else:
+                d, pos = knn_block_kernel(
+                    items_dev, norm_dev, pos_dev, valid_dev, Q_dev, mesh, k,
+                )
+                d, pos = np.asarray(d), np.asarray(pos)
+            ids_out = ids_host[pos]
             return float(np.asarray(d).ravel()[0]) + ids_out.shape[0] * 0.0
 
         # throughput counts completed query rows

@@ -172,8 +172,6 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
             )
 
         def _query_feats(p: int) -> np.ndarray:
-            if len(q_parts[p]) == 0:
-                return np.zeros((0, 0), dtype=dtype)
             return extract_partition_features(
                 q_parts[p], input_col, input_cols, dtype
             )
@@ -182,7 +180,7 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         per_part = knn_search_streamed(
             self._iter_item_blocks(id_col, dtype, mesh),
             _query_feats,
-            len(q_parts),
+            [len(p) for p in q_parts],
             self.getK(),
             mesh,
         )

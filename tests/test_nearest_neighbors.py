@@ -239,3 +239,80 @@ def test_kneighbors_streams_item_partitions(monkeypatch):
     np.testing.assert_allclose(got_d, sk_d, rtol=1e-4, atol=1e-4)
     # ids may differ on exact distance ties; compare distances + majority ids
     assert (got_ids == sk_i).mean() > 0.99
+
+
+def test_knn_block_adaptive_exact_small_mesh():
+    """Adaptive approx-verify-fallback block search (ops/knn.py) must be
+    exact on the multi-device CPU mesh, ragged chunk tails included (the
+    prototype bug class: items past the last full chunk silently skipped by
+    BOTH the candidate and the verification scan)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.knn import knn_block_adaptive, prepare_items
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    rng = np.random.default_rng(4)
+    n, d, q_n, k = 1000, 24, 96, 9
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Q = rng.standard_normal((q_n, d)).astype(np.float32)
+    mesh = get_mesh()
+    prepared = prepare_items(X, np.arange(n, dtype=np.int64), mesh)
+    # chunk=64 with 1000/n_dev rows per shard -> ragged tail exercised
+    d_out, p_out = knn_block_adaptive(
+        prepared.items, prepared.norm, prepared.pos, prepared.valid,
+        Q, mesh, k, chunk=64,
+    )
+    sk_d, sk_i = SkNN(n_neighbors=k).fit(X).kneighbors(Q)
+    np.testing.assert_allclose(d_out, sk_d, rtol=1e-4, atol=1e-4)
+    ids = prepared.ids[p_out]
+    assert (ids == sk_i).mean() > 0.99  # ties only
+
+
+def test_knn_block_adaptive_fallback_rescues_corrupted_merge(monkeypatch):
+    """Force a merge 'miss': corrupt one row's merged candidate list.  The
+    global count-verification must flag exactly that row and the exact
+    fallback must restore the correct answer."""
+    import jax.numpy as jnp
+
+    import spark_rapids_ml_tpu.ops.knn as knn_mod
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    rng = np.random.default_rng(5)
+    n, d, q_n, k = 768, 16, 64, 7
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Q = rng.standard_normal((q_n, d)).astype(np.float32)
+    mesh = get_mesh()
+    prepared = knn_mod.prepare_items(X, np.arange(n, dtype=np.int64), mesh)
+
+    real_merge = knn_mod._adaptive_merge
+    flagged = {}
+
+    def corrupt_merge(cand_v, cand_i, kk):
+        fv, fpos, td, sg = real_merge(cand_v, cand_i, kk)
+        fv, fpos = np.array(fv), np.array(fpos)
+        # drop row 3's best entry: shift in its (k+1)-th best via a worse
+        # duplicate of the 2nd entry — row 3 is now WRONG and its returned
+        # list no longer accounts for every entry above the threshold
+        fv[3, 0] = fv[3, -1] - 1.0
+        fpos[3, 0] = fpos[3, 1]
+        fv = np.sort(fv, axis=1)[:, ::-1].copy()
+        t = fv[:, -1]
+        td = t - (np.abs(t) * 5e-7 + 1e-30)
+        sg = (fv > td[:, None]).sum(axis=1)
+        flagged["called"] = True
+        return (
+            jnp.asarray(fv), jnp.asarray(fpos),
+            jnp.asarray(td), jnp.asarray(sg),
+        )
+
+    monkeypatch.setattr(knn_mod, "_adaptive_merge", corrupt_merge)
+    d_out, p_out = knn_mod.knn_block_adaptive(
+        prepared.items, prepared.norm, prepared.pos, prepared.valid,
+        Q, mesh, k, chunk=64,
+    )
+    assert flagged.get("called")
+    sk_d, _ = SkNN(n_neighbors=k).fit(X).kneighbors(Q)
+    np.testing.assert_allclose(d_out, sk_d, rtol=1e-4, atol=1e-4)
