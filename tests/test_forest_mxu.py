@@ -72,7 +72,10 @@ def test_depth_support():
     assert not mxu_depth_supported(10, 8)
 
 
-@pytest.mark.parametrize("kind", ["regression", "gini"])
+@pytest.mark.parametrize(
+    "kind",
+    [pytest.param("regression", marks=pytest.mark.slow), "gini"],
+)
 def test_mxu_builder_matches_scatter_builder(kind):
     """No bootstrap + all features: both builders are deterministic on the
     same binned data and must grow IDENTICAL trees."""
@@ -141,6 +144,7 @@ def test_mxu_builder_matches_scatter_builder(kind):
     assert abs(e1 - e2) < 0.02, (e1, e2)
 
 
+@pytest.mark.slow
 def test_mxu_builder_feature_subsets_and_bootstrap_quality():
     """With max_features < D and Poisson bootstrap the forests can't be
     compared structurally; check learning quality instead."""
@@ -172,6 +176,7 @@ def test_mxu_builder_feature_subsets_and_bootstrap_quality():
     assert r2 > 0.75, r2
 
 
+@pytest.mark.slow
 def test_mxu_deep_phase_matches_scatter_builder():
     """Depth past the slot budget triggers the bucket-sort deep phase;
     tree structure and quality must track the scatter builder."""
@@ -222,6 +227,7 @@ def test_mxu_deep_phase_matches_scatter_builder():
     assert abs(a1 - a2) < 0.02, (a1, a2)
 
 
+@pytest.mark.slow
 def test_mxu_deep_phase_skewed_trees():
     """Heavily skewed label distribution concentrates rows in few deep
     buckets — the size-class layout must stay data-proportional and match
@@ -261,6 +267,7 @@ def test_mxu_deep_phase_skewed_trees():
     assert np.isfinite(np.asarray(imp)).all()
 
 
+@pytest.mark.slow
 def test_mxu_deep_phase_three_classes():
     """s_dim=3: deep slots are 3 per node — non-power-of-two slot packing
     through the size-class deep phase (and the generic stat axis of the
@@ -293,6 +300,7 @@ def test_mxu_deep_phase_three_classes():
     assert np.isfinite(np.asarray(imp)).all()
 
 
+@pytest.mark.slow
 def test_mxu_deep_phase_mostly_dead_rows():
     """60% of rows sit in a pure node that leafs at a shallow level, so
     thousands of DEAD rows reach the deep phase — the sorted-layout width

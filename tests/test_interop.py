@@ -50,7 +50,9 @@ def _fit_forest(classification):
     return est.fit(df)
 
 
-@pytest.mark.parametrize("impurity", ["gini", "variance"])
+@pytest.mark.parametrize(
+    "impurity", [pytest.param("gini", marks=pytest.mark.slow), "variance"]
+)
 def test_build_java_tree_structure(impurity):
     model = _fit_forest(classification=(impurity == "gini"))
     sc = _mock_sc()

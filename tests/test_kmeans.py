@@ -92,6 +92,7 @@ def test_kmeans_vs_sklearn_quality():
     assert model.inertia_ <= 1.1 * sk.inertia_
 
 
+@pytest.mark.slow
 def test_kmeans_mesh_invariance():
     X, _, _ = _blobs(n=256, d=5)
     df = DataFrame.from_numpy(X, num_partitions=4)

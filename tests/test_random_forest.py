@@ -58,6 +58,7 @@ def test_param_mapping_and_unsupported():
     assert "minInfoGain" not in rf.tpu_params
 
 
+@pytest.mark.slow
 def test_classifier_accuracy():
     X, y = _cls_data()
     df = DataFrame.from_numpy(X, y=y, num_partitions=4)
@@ -74,6 +75,7 @@ def test_classifier_accuracy():
     assert model.getNumTrees == 30
 
 
+@pytest.mark.slow
 def test_classifier_vs_sklearn_holdout():
     from sklearn.ensemble import RandomForestClassifier as SkRF
     from sklearn.model_selection import train_test_split
@@ -106,6 +108,7 @@ def test_regressor_quality():
     assert r2 >= r2_sk - 0.15, (r2, r2_sk)
 
 
+@pytest.mark.slow
 def test_binary_classification():
     X, y = _cls_data(k=2)
     df = DataFrame.from_numpy(X, y=y, num_partitions=3)
@@ -138,6 +141,7 @@ def test_min_instances_per_node():
     assert leaf_counts.min() >= 50
 
 
+@pytest.mark.slow
 def test_transform_evaluate():
     X, y = _cls_data(n=300)
     df = DataFrame.from_numpy(X, y=y, num_partitions=3)
@@ -156,6 +160,7 @@ def test_transform_evaluate():
     assert abs(scores[0] - direct) < 1e-9
 
 
+@pytest.mark.slow
 def test_persistence(tmp_path):
     X, y = _cls_data(n=200)
     df = DataFrame.from_numpy(X, y=y, num_partitions=2)
@@ -196,6 +201,7 @@ def test_max_depth_limit():
         RandomForestRegressor(maxDepth=20).fit(DataFrame.from_numpy(X, y=y))
 
 
+@pytest.mark.slow
 def test_fit_multiple():
     X, y = _cls_data(n=250)
     df = DataFrame.from_numpy(X, y=y, num_partitions=2)
@@ -209,6 +215,7 @@ def test_fit_multiple():
     assert models[1].getNumTrees == 10
 
 
+@pytest.mark.slow
 def test_wide_level_kernel_matches_node_chunked():
     # the deep-level one-pass kernel (level_split_kernel_wide) must grow the
     # same tree as the node-chunked kernel; force it by shrinking node_batch

@@ -110,6 +110,7 @@ def test_cv_model_persistence(tmp_path):
     np.testing.assert_allclose(p1, p2, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_cv_random_forest_classifier_single_pass():
     from spark_rapids_ml_tpu import RandomForestClassifier
 
@@ -137,6 +138,7 @@ def test_cv_random_forest_classifier_single_pass():
     assert cv_model.bestModel.getOrDefault("maxDepth") == 6
 
 
+@pytest.mark.slow
 def test_cv_random_forest_regressor_single_pass():
     from spark_rapids_ml_tpu import RandomForestRegressor
 
