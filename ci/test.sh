@@ -22,8 +22,8 @@ python -m pytest tests/ -x -q
 echo "CI budget: default suite took $((SECONDS - t0))s"
 if [ "${SRML_CI_FULL:-0}" = "1" ]; then
     t1=$SECONDS
-    python -m pytest tests/ -x -q --runslow
-    echo "CI budget: full --runslow suite took $((SECONDS - t1))s"
+    python -m pytest tests/ -x -q --runslow -m slow
+    echo "CI budget: slow-marked remainder took $((SECONDS - t1))s"
 fi
 
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)

@@ -1,0 +1,133 @@
+#
+# Parallel ahead-of-time kernel compilation.
+#
+# A cold estimator fit dispatches dozens of jit geometries (the MXU forest
+# builder's level/class/chunk variants are the extreme case: ~480 XLA
+# compilations, 300-500 s serialized at the 200k x 500 depth-10 shape the
+# round-2 verdict measured).  XLA compilation for this backend is serviced
+# outside the Python interpreter (measured: three concurrent 7 s compiles
+# finish in 7.9 s wall from a single-core host), so a fit that knows its
+# kernel geometries up front can turn the SUM of compile times into a MAX by
+# lowering+compiling every geometry on a thread pool and dispatching through
+# the resulting AOT executables.
+#
+# The reference hides the analogous cost inside cuML's precompiled fatbins
+# (its kernels ship compiled; only tiny JIT specializations happen at run
+# time) — on XLA the compile is unavoidable, but it does not have to be
+# serial.
+#
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger("spark_rapids_ml_tpu.precompile")
+
+_POOL_WORKERS = 16
+
+
+def aval(shape: Tuple[int, ...], dtype: Any) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+
+
+class _Job:
+    """A one-shot future: holds either the compiled executable or the
+    compile-time exception."""
+
+    __slots__ = ("done", "result", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+    def wait(self):
+        self.done.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class Precompiler:
+    """Submit jit lowerings for background compilation; `call` dispatches
+    through the compiled executable (waiting for it if needed) and falls
+    back to the plain jit call when COMPILATION failed.  Runtime errors from
+    the compiled executable propagate unchanged — a device OOM must surface
+    at its true site, not be retried on the jit path minutes later.
+
+    Workers are daemon threads: an interrupted fit never blocks interpreter
+    exit on a half-finished kernel compile (XLA compiles cannot be
+    cancelled, only abandoned).  Compiled executables are cached per
+    (fn, key) for the life of the instance, so repeated fits at one
+    geometry skip compilation the same way jax's own jit cache would; the
+    cache is bounded by the number of distinct fit geometries a process
+    sees, the same growth jax's jit cache has."""
+
+    def __init__(self, max_workers: int = _POOL_WORKERS):
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._jobs: Dict[Hashable, _Job] = {}
+        self._lock = threading.Lock()
+        self._workers = []
+        for i in range(max_workers):
+            t = threading.Thread(
+                target=self._worker, name=f"srml-precompile-{i}", daemon=True
+            )
+            t.start()
+            self._workers.append(t)
+
+    def _worker(self):
+        while True:
+            job, fn, avals, static_kwargs = self._q.get()
+            try:
+                job.result = fn.lower(*avals, **static_kwargs).compile()
+            except BaseException as exc:  # noqa: BLE001 - relayed to waiter
+                job.error = exc
+            finally:
+                job.done.set()
+
+    def submit(self, key: Hashable, fn, *avals, **static_kwargs) -> None:
+        """Queue `fn.lower(*avals, **static_kwargs).compile()` if this key
+        has not been queued already.  avals are ShapeDtypeStructs (or
+        concrete arrays) matching the future call EXACTLY."""
+        with self._lock:
+            if key in self._jobs:
+                return
+            job = _Job()
+            self._jobs[key] = job
+        self._q.put((job, fn, avals, static_kwargs))
+
+    def call(self, key: Hashable, fn, *args, **static_kwargs):
+        """Run the precompiled executable for `key` (blocking on its
+        compilation if still in flight).  Unsubmitted keys and COMPILE
+        failures fall back to the plain jit call — correctness never
+        depends on the precompiler.  Errors raised while RUNNING the
+        executable propagate to the caller."""
+        with self._lock:
+            job = self._jobs.get(key)
+        if job is None:
+            return fn(*args, **static_kwargs)
+        try:
+            compiled = job.wait()
+        except Exception as exc:
+            logger.warning("AOT compile for %r failed (%s); jit fallback", key, exc)
+            with self._lock:
+                self._jobs.pop(key, None)
+            return fn(*args, **static_kwargs)
+        return compiled(*args)
+
+
+_global: Optional[Precompiler] = None
+
+
+def global_precompiler() -> Precompiler:
+    """Process-wide instance: compiled geometries persist across fits."""
+    global _global
+    if _global is None:
+        _global = Precompiler()
+    return _global

@@ -176,6 +176,56 @@ def test_mxu_builder_feature_subsets_and_bootstrap_quality():
     assert r2 > 0.75, r2
 
 
+def test_mxu_deep_phase_smoke_fast():
+    """Fast deep-phase gate for default CI: 4 classes shrink the slot
+    budget (l_s=4), so depth 6 already exercises the bucket sort, the
+    class layout and the clamped chunk windows in ~10 s.  The heavyweight
+    depth-9+ equivalence sweeps stay behind --runslow."""
+    rng = np.random.default_rng(11)
+    N, D, B, T, depth, C = _ROW_TILE, 8, 8, 2, 6, 4
+    X = rng.standard_normal((N, D)).astype(np.float32)
+    logits = X @ rng.standard_normal((D, C))
+    y = logits.argmax(1).astype(np.float32)
+    edges = compute_bin_edges(X, B)
+    Xb = np.asarray(bin_features(jnp.asarray(X), jnp.asarray(edges)))
+    bins_fm = Xb.T.astype(np.int8)
+    w_trees = np.ones((T, N), np.float32)
+    base = np.stack([(y == c) for c in range(C)]).astype(np.float32)
+
+    f, t, v, ns, imp = grow_forest_mxu(
+        jnp.asarray(bins_fm), jnp.asarray(base), jnp.asarray(w_trees), None,
+        edges, max_depth=depth, n_bins=B, kind="gini", max_features=D,
+        min_samples_leaf=1.0, min_impurity_decrease=0.0, seed=3,
+        y_vals=jnp.asarray(y), interpret=True,
+    )
+    stats_t = jnp.broadcast_to(jnp.asarray(base.T)[None], (T, N, C))
+    f2, t2, v2, _, _ = grow_forest(
+        jnp.asarray(Xb), stats_t, edges, max_depth=depth, n_bins=B,
+        kind="gini", max_features=D, min_samples_leaf=1.0,
+        min_impurity_decrease=0.0, seed=3,
+    )
+    f2_h = np.asarray(f2)
+    # shallow levels must agree exactly; deep levels tolerate bf16 tie flips
+    shallow = slice(0, 2**5 - 1)
+    assert (f[:, shallow] == f2_h[:, shallow]).mean() > 0.97
+    assert (f == f2_h).mean() > 0.85, (f == f2_h).mean()
+    p1 = np.asarray(
+        forest_predict_kernel(
+            jnp.asarray(X), jnp.asarray(f), jnp.asarray(t), jnp.asarray(v),
+            max_depth=depth,
+        )
+    )
+    p2 = np.asarray(
+        forest_predict_kernel(
+            jnp.asarray(X), jnp.asarray(f2), jnp.asarray(t2),
+            jnp.asarray(v2), max_depth=depth,
+        )
+    )
+    a1 = (p1.argmax(1) == y).mean()
+    a2 = (p2.argmax(1) == y).mean()
+    assert abs(a1 - a2) < 0.03, (a1, a2)
+
+
 @pytest.mark.slow
 def test_mxu_deep_phase_matches_scatter_builder():
     """Depth past the slot budget triggers the bucket-sort deep phase;
