@@ -247,34 +247,74 @@ def knn_block_kernel(
 
 
 # ---------------------------------------------------------------------------
-# Adaptive exact block search (TPU): raw hardware approx + global
-# count-verification + per-row exact fallback.
+# Adaptive exact block search (TPU): grouped max-selection candidates +
+# global count-verification + per-row exact fallback.
 #
-# Measured on hardware (400k x 3000, Q=8192, k=200): the one-jit
-# "verified approx" path costs 3.5 s/block because XLA REWRITES
-# approx_top_k into an exact sort whenever its output is consumed by
-# verification ops in the same computation — the PartialReduce fast path
-# (0.48 s for the same scan) only survives when the approx scan shares its
-# jit with nothing else.  So the phases are deliberately SEPARATE jits:
+# Measured on hardware (400k x 3000, Q=8192, k=200): EVERY sort-shaped
+# top-k over a (Q, chunk) tile costs ~0.5 s — lax.top_k 0.57 s,
+# approx_max_k 0.51 s (its PartialReduce still pays the aggregation sort),
+# approx with aggregate_to_topk=False decomposes outright (13-92 s).  At 25
+# chunks per scan that is ~13 s of pure top-k per query block.  So the
+# candidate scan sorts NOTHING: each chunk is split into G-wide column
+# groups and the top m per group is taken by m iterated (argmax, max, mask)
+# passes — pure VPU reductions that fuse with the distance tile.  m is
+# sized from the hypergeometric tail of "top-k members landing in one
+# G-group" (items are SHUFFLED once at prepare time, so the bound holds for
+# ANY data order, clustered or sorted); the merged pool of n_chunks*(C/G)*m
+# candidates gets one exact top-k.  Phases stay SEPARATE jits:
 #
-#   1. candidates:  chunked d2 scan + raw approx_max_k per chunk (fast path)
-#   2. merge:       approx top-k over the gathered candidates -> t = kth value
+#   1. candidates:  chunked d2 scan + per-group iterated-max selection
+#   2. merge:       exact top-k over the gathered pool -> t = kth value
 #   3. count:       second d2 scan counting #{-d2 > t - delta} per row
 #                   (fuses like a plain matmul epilogue: ~matmul cost)
 #   4. fallback:    rows where the count disagrees with the returned list
-#                   rerun through the exact kernel (a few % of rows: real
-#                   approx misses + near-ties inside the delta sliver)
+#                   rerun through the exact kernel (near-zero by the m
+#                   bound: real overflow misses + ties inside delta)
 #
 # Tie-tolerant exactness: the check passes iff every entry strictly better
-# than t - delta is in the returned list; entries tied at the threshold are
-# interchangeable (the same arbitrary tie-breaking any exact sort performs).
-# delta covers float32 rounding differences between the two d2 scans in the
-# SAFE direction (a borderline entry can only cause a spurious fallback,
-# never a silent miss).
+# than t + delta is in the returned list; entries inside the delta sliver of
+# the kth value are computational ties — the f32 exact kernel orders them
+# arbitrarily too — so they are interchangeable.  delta (~8 ulps of t)
+# covers float32 rounding differences between the two d2 scans; anything
+# missing by more than a tie's width breaks the count equality and takes
+# the per-row exact fallback.
 # ---------------------------------------------------------------------------
 
 _ADAPTIVE_CHUNK = 16384
-_ADAPTIVE_MIN_LOCAL = 1 << 16  # below this the exact path is already cheap
+_ADAPTIVE_MIN_LOCAL = 1 << 15  # below this the exact path is already cheap
+_GROUP_WIDTH = 1024
+
+
+def _select_m(k: int, G: int, n_loc: int) -> int:
+    """Per-group candidate count: mean + 6 sigma of the Binomial(k, G/n_loc)
+    occupancy of one group (a safe envelope of the post-shuffle
+    hypergeometric), +4 slack.  Expected verification failures per block
+    stay ~1e-4 even at Q=8192 x hundreds of groups."""
+    lam = k * G / max(n_loc, 1)
+    return max(4, int(np.ceil(lam + 6.0 * np.sqrt(lam) + 4.0)))
+
+
+def _group_topm(neg_d2: jax.Array, m: int, G: int, base) -> Tuple[jax.Array, jax.Array]:
+    """Top-m per G-wide column group of (Q, C) via m iterated
+    (argmax, max, position-mask) passes.  No sort anywhere: each pass is
+    two VPU reductions + one masked write over the tile.  Returns
+    ((Q, (C//G)*m) values, positions offset by `base`).  Position-masking
+    (not value-masking) keeps duplicate values as distinct candidates, so
+    the selected multiset is exact."""
+    Qn, C = neg_d2.shape
+    ng = C // G
+    v = neg_d2.reshape(Qn, ng, G)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (Qn, ng, G), 2)
+    vals, idxs = [], []
+    for _ in range(m):
+        a = jnp.argmax(v, axis=2).astype(jnp.int32)
+        vals.append(v.max(axis=2))
+        idxs.append(a)
+        v = jnp.where(iota == a[:, :, None], -jnp.inf, v)
+    V = jnp.stack(vals, axis=2).reshape(Qn, ng * m)
+    gbase = (jnp.arange(ng, dtype=jnp.int32) * G)[None, :, None]
+    I = (jnp.stack(idxs, axis=2) + gbase).reshape(Qn, ng * m) + base
+    return V, I
 
 
 def _chunk_d2(items_loc, x_norm, valid_loc, q, qn, i, chunk):
@@ -301,12 +341,13 @@ def _candidates_scan(items_loc, x_norm, pos_loc, valid_loc, q, k, chunk):
     qn = (q * q).sum(axis=1)
     n_loc = items_loc.shape[0]
     n_chunks = -(-n_loc // chunk)
+    G = _GROUP_WIDTH if chunk % _GROUP_WIDTH == 0 else chunk
+    m = _select_m(k, G, n_loc)
 
     def body(c, i):
         d2, start = _chunk_d2(items_loc, x_norm, valid_loc, q, qn, i, chunk)
-        v, idx = jax.lax.approx_max_k(-d2, k, recall_target=0.99)
-        idx = jnp.minimum(idx, chunk - 1)
-        return c, (v, idx.astype(pos_loc.dtype) + start + pos_loc[0])
+        v, idx = _group_topm(-d2, m, G, start + pos_loc[0])
+        return c, (v, idx.astype(pos_loc.dtype))
 
     _, (vs, idxs) = jax.lax.scan(body, 0, jnp.arange(n_chunks, dtype=jnp.int32))
     Q = q.shape[0]
@@ -363,18 +404,34 @@ def _adaptive_candidates(items, item_norm, item_pos, valid, queries, mesh, k, ch
 
 @partial(jax.jit, static_argnames=("k",))
 def _adaptive_merge(cand_v, cand_i, k):
-    """Phase 2: approx top-k over the candidate pool (its own misses are
-    caught by the global count check downstream).  Also emits the margined
-    verification threshold and the returned-list count so the host only
-    round-trips the final arrays once."""
-    fv, fi = jax.lax.approx_max_k(cand_v, k, recall_target=0.99)
+    """Phase 2: EXACT top-k over the candidate pool (the pool is
+    n_chunks*(chunk/G)*m wide — a few thousand columns, two orders of
+    magnitude narrower than the scan, so one grouped exact top-k is cheap).
+    Also emits the margined verification threshold and the returned-list
+    count so the host only round-trips the final arrays once."""
+    fv, fi = _grouped_topk_exact(cand_v, min(k, cand_v.shape[1]))
     fpos = jnp.take_along_axis(cand_i, fi, axis=1)
+    if fv.shape[1] < k:
+        # keep the k-column output contract when the pool is narrower than
+        # k (tiny shards); -inf slots surface as inf distances, which the
+        # callers' -1 id sentinel logic already handles
+        pad = k - fv.shape[1]
+        fv = jnp.pad(fv, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+        fpos = jnp.pad(fpos, ((0, 0), (0, pad)))
     t = fv[:, -1]
-    # 4-ulp-scale margin, SAFE direction: only widens the must-be-present
-    # set, so scan-to-scan rounding can cause spurious fallbacks, not misses
-    td = t - (jnp.abs(t) * 5e-7 + 1e-30)
-    sg = (fv > td[:, None]).sum(axis=1)
-    return fv, fpos, td, sg
+    # The verification threshold sits a ~8-ulp margin ABOVE the kth value:
+    # entries within the sliver of t are computational ties (the f32 exact
+    # kernel orders them arbitrarily too) and are excluded from the
+    # must-be-present set.  A margin BELOW t would instead demand rank k+1
+    # be distinguishable from rank k — at 400k-item density the (k+1)-th
+    # distance falls inside the sliver for ~1.6% of rows, each a spurious
+    # exact-fallback.  Any candidate missing by MORE than the sliver still
+    # breaks the count equality and falls back; the margin covers scan-to-
+    # scan f32 rounding (expected <=1-2 ulp) with headroom.
+    delta = jnp.abs(t) * 1e-6 + 1e-30
+    tu = jnp.where(jnp.isfinite(t), t + delta, t)
+    sg = (fv > tu[:, None]).sum(axis=1)
+    return fv, fpos, tu, sg
 
 
 @partial(jax.jit, static_argnames=("mesh", "chunk"))
@@ -410,21 +467,31 @@ def _adaptive_count(items, item_norm, valid, queries, thresh, mesh, chunk):
     )(items, item_norm, valid, queries, thresh)
 
 
-def knn_block_adaptive(
-    items, item_norm, item_pos, valid, queries, mesh, k,
+def knn_block_adaptive_dispatch(
+    items, item_norm, item_pos, valid, qd, mesh, k,
     chunk: int = _ADAPTIVE_CHUNK,
 ):
-    """Exact k nearest items for a query block via the adaptive scheme
-    (header above).  Host-orchestrated: returns host (distances (Q, k)
-    ascending euclidean, positions (Q, k)).  Rows failing verification
-    rerun through knn_block_kernel (pow2-padded so compiled fallback shapes
-    stay bounded)."""
-    qd = jnp.asarray(queries)
+    """Dispatch the three device phases of the adaptive block search WITHOUT
+    any host synchronization; returns device arrays (fv, fpos, sg, sa).
+    Splitting dispatch from collection lets callers pipeline many query
+    blocks — the per-block host round-trips (3 tunnel syncs each) were the
+    dominant graph-build cost for small item sets like UMAP's 50k
+    self-join."""
     cv, ci = _adaptive_candidates(
         items, item_norm, item_pos, valid, qd, mesh, k, chunk
     )
-    fv, fpos, td, sg = _adaptive_merge(cv, ci, k)
-    sa = _adaptive_count(items, item_norm, valid, qd, td, mesh, chunk)
+    fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
+    sa = _adaptive_count(items, item_norm, valid, qd, tu, mesh, chunk)
+    return fv, fpos, sg, sa
+
+
+def knn_block_adaptive_collect(
+    handles, items, item_norm, item_pos, valid, qd, mesh, k
+):
+    """Fetch a dispatched block's results and rerun the (near-empty) set of
+    verification-failing rows through the exact kernel (pow2-padded so
+    compiled fallback shapes stay bounded)."""
+    fv, fpos, sg, sa = handles
     fail = np.flatnonzero(np.asarray(sa) != np.asarray(sg))
     fv_h, fpos_h = np.array(fv), np.array(fpos)
     d_out = np.sqrt(np.maximum(-fv_h, 0))
@@ -441,6 +508,22 @@ def knn_block_adaptive(
         d_out[fail] = np.asarray(d_f)[: fail.size]
         p_out[fail] = np.asarray(p_f)[: fail.size]
     return d_out, p_out
+
+
+def knn_block_adaptive(
+    items, item_norm, item_pos, valid, queries, mesh, k,
+    chunk: int = _ADAPTIVE_CHUNK,
+):
+    """Exact k nearest items for a query block via the adaptive scheme
+    (header above).  Host-orchestrated: returns host (distances (Q, k)
+    ascending euclidean, positions (Q, k))."""
+    qd = jnp.asarray(queries)
+    handles = knn_block_adaptive_dispatch(
+        items, item_norm, item_pos, valid, qd, mesh, k, chunk
+    )
+    return knn_block_adaptive_collect(
+        handles, items, item_norm, item_pos, valid, qd, mesh, k
+    )
 
 
 class PreparedItems:
@@ -469,13 +552,26 @@ class PreparedItems:
 
 
 def prepare_items(
-    items: np.ndarray, item_ids: np.ndarray, mesh: Mesh, dtype=np.float32
+    items: np.ndarray,
+    item_ids: np.ndarray,
+    mesh: Mesh,
+    dtype=np.float32,
+    shuffle: bool = True,
 ) -> PreparedItems:
     from ..utils import pad_rows
 
     n_dev = mesh.shape[DATA_AXIS]
     items = np.asarray(items, dtype=dtype)
     n_items = items.shape[0]
+    if shuffle and n_items > 1:
+        # One deterministic row shuffle per prepared block: the adaptive
+        # scan's per-group candidate bound (_select_m) models group
+        # occupancy as uniform sampling, which a sorted/clustered item
+        # order would break (a query's whole top-k landing in one group).
+        # Ids travel with their rows, so callers see no difference.
+        perm = np.random.default_rng(0x5EED).permutation(n_items)
+        items = items[perm]
+        item_ids = np.asarray(item_ids)[perm]
     items_pad = pad_rows(items, n_dev)
     n_pad = items_pad.shape[0]
     ids_pad = np.full(n_pad, -1, np.int64)
@@ -724,11 +820,13 @@ def knn_search_prepared(
     block = 64
     while block < min(query_block, q.shape[0]):
         block *= 2
-    # TPU + a large resident shard: the adaptive approx-verify-fallback
-    # path (knn_block_adaptive) — ~3x the exact chunk-scan's throughput at
-    # the 400k x 3000 k=200 benchmark shape, still always exact.  It
-    # synchronizes per block (the host reads the per-row verification
-    # outcome), so it runs sequentially without the dispatch window.
+    # TPU + a large resident shard: the adaptive grouped-select path
+    # (knn_block_adaptive_*) — ~3x the exact chunk-scan's throughput at the
+    # 400k x 3000 k=200 benchmark shape, still always exact.  All blocks'
+    # device phases dispatch ahead through a bounded window; the host then
+    # collects verification outcomes in order, so the 3 tunnel round-trips
+    # per block overlap with later blocks' compute instead of serializing
+    # (the serialized form made UMAP's 50k-item graph build sync-bound).
     n_loc = prepared.items.shape[0] // max(1, mesh.shape[DATA_AXIS])
     if (
         jax.default_backend() == "tpu"
@@ -737,22 +835,69 @@ def knn_search_prepared(
         and n_loc >= _ADAPTIVE_CHUNK
     ):
         out_d, out_i = [], []
-        for start in range(0, q.shape[0], block):
+        pending: list = []
+        window = 4
+        fallback_q: list = []  # (block_index, row_indices) deferred reruns
+
+        def _dispatch_a(start):
             qb = q[start : start + block]
             n_q = qb.shape[0]
             if n_q < block:
                 qb = np.concatenate(
                     [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)]
                 )
-            d_host, pos_host = knn_block_adaptive(
+            qd_b = jnp.asarray(qb)
+            handles = knn_block_adaptive_dispatch(
                 prepared.items, prepared.norm, prepared.pos, prepared.valid,
-                qb, mesh, k,
+                qd_b, mesh, k,
             )
-            d_host = d_host[:n_q]
-            ids_host = prepared.ids[pos_host[:n_q]]
+            pending.append((handles, n_q))
+
+        def _collect_a():
+            handles, n_q = pending.pop(0)
+            # ONE batched fetch per block (4 separate np.asarray calls would
+            # pay 4 tunnel round-trips); failing rows are only QUEUED here —
+            # running each block's rerun inline would serialize the pipeline
+            fv_h, fpos_h, sg_h, sa_h = jax.device_get(handles)
+            d_host = np.sqrt(np.maximum(-fv_h[:n_q], 0))
+            ids_host = prepared.ids[fpos_h[:n_q]]
             ids_host[np.isinf(d_host)] = -1
+            fail = np.flatnonzero(sa_h[:n_q] != sg_h[:n_q])
+            if fail.size:
+                fallback_q.append((len(out_d), fail))
             out_d.append(d_host)
             out_i.append(ids_host)
+
+        for start in range(0, q.shape[0], block):
+            _dispatch_a(start)
+            if len(pending) > window:
+                _collect_a()
+        while pending:
+            _collect_a()
+
+        if fallback_q:
+            # one exact rerun for EVERY verification-failing row of the
+            # whole search (a handful by the _select_m bound)
+            rows = np.concatenate(
+                [bi * block + fr for bi, fr in fallback_q]
+            )
+            b = 64
+            while b < rows.size:
+                b *= 2
+            qf = np.zeros((b, q.shape[1]), dtype=dtype)
+            qf[: rows.size] = q[rows]
+            d_f, p_f = knn_block_kernel(
+                prepared.items, prepared.norm, prepared.pos, prepared.valid,
+                jnp.asarray(qf), mesh, k,
+            )
+            d_f = np.asarray(d_f)[: rows.size]
+            ids_f = prepared.ids[np.asarray(p_f)[: rows.size]]
+            ids_f[np.isinf(d_f)] = -1
+            at = 0
+            for bi, fr in fallback_q:
+                out_d[bi][fr] = d_f[at : at + fr.size]
+                out_i[bi][fr] = ids_f[at : at + fr.size]
+                at += fr.size
         return np.concatenate(out_d)[:, :k_eff], np.concatenate(out_i)[:, :k_eff]
 
     # overlap compute with host transfers via a BOUNDED in-flight window

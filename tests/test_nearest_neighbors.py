@@ -300,7 +300,7 @@ def test_knn_block_adaptive_fallback_rescues_corrupted_merge(monkeypatch):
         fpos[3, 0] = fpos[3, 1]
         fv = np.sort(fv, axis=1)[:, ::-1].copy()
         t = fv[:, -1]
-        td = t - (np.abs(t) * 5e-7 + 1e-30)
+        td = t + (np.abs(t) * 1e-6 + 1e-30)
         sg = (fv > td[:, None]).sum(axis=1)
         flagged["called"] = True
         return (
