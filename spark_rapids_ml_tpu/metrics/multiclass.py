@@ -21,9 +21,12 @@ def log_loss(labels: np.ndarray, probs: np.ndarray, eps: float) -> float:
     labels = np.asarray(labels)
     probs = np.asarray(probs)
     if np.any(labels < 0) or np.any(labels > probs.shape[1] - 1):
-        raise ValueError(f"labels must be in the range [0,{probs.shape[1]-1}]")
+        raise ValueError(
+            f"found a label outside the class index range "
+            f"[0, {probs.shape[1] - 1}]"
+        )
     if np.any(probs < 0) or np.any(probs > 1.0):
-        raise ValueError("probs must be in the range [0.0, 1.0]")
+        raise ValueError("every probability must lie within [0.0, 1.0]")
     p = probs[np.arange(probs.shape[0]), labels.astype(np.int64)]
     return float(-np.log(np.maximum(p, eps)).sum())
 

@@ -81,9 +81,20 @@ class NearestNeighbors(_NearestNeighborsParams, _TpuEstimatorSupervised):
         self._set_params(**kwargs)
 
     def _fit(self, dataset: Any) -> "NearestNeighborsModel":
-        df = as_dataframe(dataset)
-        if not self.isDefined("idCol"):
-            df = df.with_row_id("unique_id")
+        from ..core import _use_executor_path
+
+        if _use_executor_path(dataset):
+            # live pyspark input: hold the DataFrame itself — item partitions
+            # stay on the executors until kneighbors runs its barrier stage
+            # (reference fit just captures the frame too, knn.py:297-317).
+            # Nothing is collected to the driver here or later.
+            from ..spark.adapter import ensure_id_col
+
+            df = ensure_id_col(dataset, self.getIdCol())
+        else:
+            df = as_dataframe(dataset)
+            if not self.isDefined("idCol"):
+                df = df.with_row_id("unique_id")
         model = NearestNeighborsModel(item_df=df)
         self._copyValues(model)
         model._tpu_params.update(self._tpu_params)
@@ -142,6 +153,10 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
     ) -> Tuple[DataFrame, DataFrame, DataFrame]:
         """Exact k nearest item neighbors for every query row; float32
         euclidean (the reference converts all input to float32, knn.py:425).
+        On TPU hardware the large-shard fast path is exact up to ~1e-6-
+        relative ties at the kth distance — candidates inside that float32
+        sliver are interchangeable, ordered as arbitrarily as any exact f32
+        sort orders true ties (ops/knn.knn_block_adaptive).
 
         Partition-streamed on BOTH sides (the reference keeps partitions on
         the workers and exchanges p2p, knn.py:452-560): item partitions pack
@@ -150,8 +165,41 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         partitioning.  Peak driver memory is O(one item block + one query
         partition + k * n_query) — never the concatenated item set."""
         assert self._item_df is not None, "fit() must be called before kneighbors"
-        from ..core import extract_partition_features
+        from ..core import _is_pyspark_dataframe, extract_partition_features
         from ..ops.knn import knn_search_streamed
+
+        if _is_pyspark_dataframe(self._item_df):
+            # executor-side path: the barrier stage exchanges query blocks
+            # and candidate lists between tasks; item partitions never leave
+            # their executors and nothing is collected to the driver
+            # (reference knn.py:452-560)
+            if not _is_pyspark_dataframe(query_df):
+                raise TypeError(
+                    "the fitted item dataframe is a live pyspark DataFrame; "
+                    "kneighbors requires a pyspark query DataFrame too"
+                )
+            from ..spark.adapter import (
+                ensure_id_col,
+                infer_spark_num_workers,
+                run_barrier_kneighbors,
+            )
+
+            id_col = self.getIdCol()
+            qdf_spark = ensure_id_col(query_df, id_col)
+            input_col, input_cols = self._get_input_columns()
+            num_workers = infer_spark_num_workers(
+                self, query_df.sparkSession
+            )
+            knn_df = run_barrier_kneighbors(
+                self._item_df,
+                qdf_spark,
+                self.getK(),
+                id_col,
+                input_col,
+                input_cols,
+                num_workers,
+            )
+            return self._item_df, qdf_spark, knn_df
 
         qdf = as_dataframe(query_df)
         id_col = self.getIdCol()
@@ -206,6 +254,23 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         (reference knn.py:604-672; structs here are dicts of the source
         rows)."""
         id_col = self.getIdCol()
+        from ..core import _is_pyspark_dataframe
+
+        if _is_pyspark_dataframe(self._item_df):
+            # executor-side join: explode the knn pairs partition-wise and
+            # run two real Spark equi-joins (reference knn.py:604-672) —
+            # neither frame is ever collected to the driver
+            from ..spark.adapter import spark_knn_join
+
+            item_df, query_df_withid, knn_df = self.kneighbors(query_df)
+            return spark_knn_join(
+                item_df,
+                query_df_withid,
+                knn_df,
+                id_col,
+                distCol,
+                drop_generated_id=not self.isDefined("idCol"),
+            )
         # sparse-built DataFrames carry a placeholder features column (row
         # positions, not vectors; see DataFrame.from_numpy) — building join
         # structs from it would silently emit indices as "features"

@@ -143,8 +143,12 @@ class UMAP(_UMAPParams, _TpuEstimator):
     fuzzy-set calibration, one-jit SGD layout."""
 
     # single-node fit by design (reference umap.py:831-850 coalesces to one
-    # partition); the fit func host-fetches the whole dataset
+    # partition); the fit func host-fetches the whole dataset.  On a >1-worker
+    # Spark cluster the adapter degrades to the reference semantics — sample
+    # with Spark, fit in a single barrier task, keep inference distributed —
+    # instead of erroring (spark/adapter.barrier_fit_estimator).
     _supports_multicontroller_fit = False
+    _cluster_fit_single_task = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__()

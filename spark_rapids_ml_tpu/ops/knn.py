@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, data_sharding
+from ..parallel.mesh import DATA_AXIS, data_sharding, get_mesh
 
 
 def _grouped_topk_exact(vals: jax.Array, k: int, group: int = 1024):
@@ -283,6 +283,27 @@ def knn_block_kernel(
 _ADAPTIVE_CHUNK = 16384
 _ADAPTIVE_MIN_LOCAL = 1 << 15  # below this the exact path is already cheap
 _GROUP_WIDTH = 1024
+# per-group candidate cap: each of the m selection passes unrolls an
+# (argmax, max, mask) sweep over the tile, so a large-k/small-n_loc corner
+# (k=2048 at n_loc=32k needs m~116) would pay ~116 unrolled passes per
+# chunk — a compile-time and runtime cliff where the plain exact kernel is
+# faster.  Shapes whose _select_m bound exceeds this cap take the exact
+# chunk-scan path instead.
+_ADAPTIVE_MAX_M = 32
+
+
+def _adaptive_eligible(k: int, n_loc: int) -> bool:
+    """Whether the grouped-select adaptive path is profitable for this
+    (k, local item count) — includes the _select_m unroll cap above."""
+    if not (
+        n_loc >= _ADAPTIVE_MIN_LOCAL
+        and k <= _ADAPTIVE_CHUNK // 8
+        and n_loc >= _ADAPTIVE_CHUNK
+    ):
+        return False
+    chunk = _ADAPTIVE_CHUNK
+    G = _GROUP_WIDTH if chunk % _GROUP_WIDTH == 0 else chunk
+    return _select_m(k, G, n_loc) <= _ADAPTIVE_MAX_M
 
 
 def _select_m(k: int, G: int, n_loc: int) -> int:
@@ -514,9 +535,14 @@ def knn_block_adaptive(
     items, item_norm, item_pos, valid, queries, mesh, k,
     chunk: int = _ADAPTIVE_CHUNK,
 ):
-    """Exact k nearest items for a query block via the adaptive scheme
-    (header above).  Host-orchestrated: returns host (distances (Q, k)
-    ascending euclidean, positions (Q, k))."""
+    """k nearest items for a query block via the adaptive scheme (header
+    above), exact up to COMPUTATIONAL TIES at the kth distance: every
+    neighbor strictly closer than the kth distance by more than ~1e-6
+    relative is guaranteed present (the count check catches its absence and
+    reruns the row exactly); candidates whose squared distances agree with
+    the kth within that sliver are interchangeable — the same arbitrary
+    ordering any f32 exact sort gives such ties.  Host-orchestrated:
+    returns host (distances (Q, k) ascending euclidean, positions (Q, k))."""
     qd = jnp.asarray(queries)
     handles = knn_block_adaptive_dispatch(
         items, item_norm, item_pos, valid, qd, mesh, k, chunk
@@ -796,6 +822,170 @@ def knn_search_streamed(
     return out
 
 
+# control-plane transports cap per-message size (Spark's allGather rides the
+# RPC channel, spark.rpc.message.maxSize default 128 MiB) — large payloads
+# are split into bounded chunks sent over as many rounds as the widest rank
+# needs.  8 MiB keeps each frame far under the limit with base64 overhead.
+_ALLGATHER_CHUNK = 8 << 20
+
+
+def _allgather_large(control_plane, payload: str, chunk: int = _ALLGATHER_CHUNK):
+    """allGather of arbitrarily large strings over a frame-limited control
+    plane: one small round agrees on the per-rank chunk counts, then
+    max(counts) rounds ship the chunks.  Every rank must call this the same
+    number of times (it is a collective, like allGather itself)."""
+    chunks = [payload[i : i + chunk] for i in range(0, len(payload), chunk)]
+    if not chunks:
+        chunks = [""]
+    counts = [int(c) for c in control_plane.allGather(str(len(chunks)))]
+    parts: list = [[] for _ in counts]
+    for r in range(max(counts)):
+        got = control_plane.allGather(chunks[r] if r < len(chunks) else "")
+        for i, g in enumerate(got):
+            if r < counts[i]:
+                parts[i].append(g)
+    return ["".join(p) for p in parts]
+
+
+def distributed_kneighbors(
+    item_parts,
+    query_parts,
+    k: int,
+    rank: int,
+    nranks: int,
+    control_plane,
+    mesh: Mesh = None,
+    dtype=np.float32,
+):
+    """Executor-side exact kneighbors across `nranks` cooperating processes
+    (Spark barrier tasks, OS workers, threads — anything with a string
+    control plane).  Item DATA never leaves its rank: this is the TPU shape
+    of the reference's NearestNeighborsMG partition exchange
+    (knn.py:486-560), with the control plane standing in for the UCX p2p
+    transport.
+
+    `item_parts` / `query_parts` are sequences of (features (n, D) ndarray,
+    ids (n,) int64) — this rank's local partitions of each side.  Returns
+    one (distances (m, k_eff), item_ids (m, k_eff)) pair per local QUERY
+    partition, k_eff = min(k, global item count), distances ascending —
+    identical to what a single-process knn_search over the concatenated
+    data would give those rows.
+
+    Protocol (two control-plane rounds):
+      round 1: every rank publishes its concatenated query block
+               (features + ids ride the base64 ndarray codec) and its item
+               count.  Queries are broadcast — the reference ships query
+               partitions to every index worker the same way — while items,
+               the big side, stay put.
+      local:   each rank streams its item partitions into device-resident
+               blocks (HBM-budgeted) and computes exact top-k of the GLOBAL
+               query set via the block kernels above.
+      round 2: per-rank (Q, k) candidate lists (ids + f32 distances — k
+               scalars per query, never data rows) are allGathered; each
+               rank merges the nranks sorted lists for ITS OWN query rows
+               only (native.topk_merge) and emits them per input partition.
+    Both rounds ride _allgather_large, so payloads beyond the transport's
+    per-message frame limit are split into bounded chunks automatically.
+
+    Every rank must call this (a rank with zero rows still joins both
+    gathers — bailing out would hang the barrier)."""
+    import json
+
+    from .. import native
+    from ..parallel.runner import _decode_value, _encode_value
+
+    mesh = mesh or get_mesh(None)
+    q_feats = [np.asarray(f, dtype=dtype) for f, _ in query_parts]
+    q_ids = [np.asarray(i, np.int64) for _, i in query_parts]
+    q_rows = [f.shape[0] for f in q_feats]
+    nonempty_q = [f for f in q_feats if f.shape[0]]
+    q_cat = (
+        np.concatenate(nonempty_q)
+        if nonempty_q
+        else np.zeros((0, 0), dtype=dtype)
+    )
+    n_items_loc = int(sum(np.asarray(f).shape[0] for f, _ in item_parts))
+
+    msg = json.dumps(
+        {"rank": rank, "n_items": n_items_loc, "q": _encode_value(q_cat)}
+    )
+    infos = sorted(
+        (json.loads(m) for m in _allgather_large(control_plane, msg)),
+        key=lambda g: g["rank"],
+    )
+    blocks = [_decode_value(g["q"]) for g in infos]
+    total_items = int(sum(g["n_items"] for g in infos))
+    dims = {b.shape[1] for b in blocks if b.shape[0]}
+    if len(dims) > 1:
+        raise ValueError(f"ranks disagree on query dimensionality: {sorted(dims)}")
+    D = dims.pop() if dims else (
+        np.asarray(item_parts[0][0]).shape[1] if item_parts else 0
+    )
+    blocks = [
+        b if b.shape[0] else np.zeros((0, D), dtype=dtype) for b in blocks
+    ]
+    offs = np.cumsum([0] + [b.shape[0] for b in blocks])
+    q_total = int(offs[-1])
+    k_eff = min(k, total_items)
+
+    def _empty_results():
+        return [
+            (np.zeros((r, k_eff), dtype=dtype), np.zeros((r, k_eff), np.int64))
+            for r in q_rows
+        ]
+
+    if q_total == 0 or total_items == 0:
+        # consistent across ranks (both counts are globally agreed), so
+        # skipping round 2 everywhere cannot desync the barrier
+        return _empty_results()
+    q_global = np.concatenate(blocks) if len(blocks) > 1 else blocks[0]
+
+    if n_items_loc:
+        def _parts():
+            for f, i in item_parts:
+                f = np.asarray(f, dtype=dtype)
+                if f.shape[0]:
+                    yield f, np.asarray(i, np.int64)
+
+        (res,) = knn_search_streamed(
+            iter_prepared_item_blocks(_parts(), mesh, dtype),
+            lambda p: q_global,
+            [q_total],
+            k,
+            mesh,
+        )
+        d_mine, i_mine = _pad_topk_to_k(
+            res[0].astype(np.float32, copy=False), res[1], k
+        )
+    else:
+        d_mine = np.full((q_total, k), np.inf, np.float32)
+        i_mine = np.full((q_total, k), -1, np.int64)
+
+    msg2 = json.dumps(
+        {"rank": rank, "d": _encode_value(d_mine), "i": _encode_value(i_mine)}
+    )
+    lo, hi = int(offs[rank]), int(offs[rank + 1])
+    best_d = best_i = None
+    for g in sorted(
+        (json.loads(m) for m in _allgather_large(control_plane, msg2)),
+        key=lambda g: g["rank"],
+    ):
+        # merge only THIS rank's query rows — each rank owns its slice
+        d_r = _decode_value(g["d"])[lo:hi]
+        i_r = _decode_value(g["i"])[lo:hi]
+        if best_d is None:
+            best_d, best_i = d_r, i_r
+        else:
+            best_d, best_i = native.topk_merge(best_d, best_i, d_r, i_r)
+    if best_d is None:  # this rank owns no queries
+        return _empty_results()
+    out, at = [], 0
+    for r in q_rows:
+        out.append((best_d[at : at + r, :k_eff], best_i[at : at + r, :k_eff]))
+        at += r
+    return out
+
+
 def knn_search_prepared(
     prepared: PreparedItems,
     queries: np.ndarray,
@@ -822,18 +1012,17 @@ def knn_search_prepared(
         block *= 2
     # TPU + a large resident shard: the adaptive grouped-select path
     # (knn_block_adaptive_*) — ~3x the exact chunk-scan's throughput at the
-    # 400k x 3000 k=200 benchmark shape, still always exact.  All blocks'
+    # 400k x 3000 k=200 benchmark shape; exact up to ~1e-6-relative
+    # computational ties at the kth distance (see knn_block_adaptive — ties
+    # within that sliver are ordered arbitrarily by f32 exact sorts too,
+    # and anything missing by more than a tie's width triggers the exact
+    # per-row fallback).  All blocks'
     # device phases dispatch ahead through a bounded window; the host then
     # collects verification outcomes in order, so the 3 tunnel round-trips
     # per block overlap with later blocks' compute instead of serializing
     # (the serialized form made UMAP's 50k-item graph build sync-bound).
     n_loc = prepared.items.shape[0] // max(1, mesh.shape[DATA_AXIS])
-    if (
-        jax.default_backend() == "tpu"
-        and n_loc >= _ADAPTIVE_MIN_LOCAL
-        and k <= _ADAPTIVE_CHUNK // 8
-        and n_loc >= _ADAPTIVE_CHUNK
-    ):
+    if jax.default_backend() == "tpu" and _adaptive_eligible(k, n_loc):
         out_d, out_i = [], []
         pending: list = []
         window = 4

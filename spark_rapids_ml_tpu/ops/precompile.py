@@ -22,7 +22,8 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Any, Dict, Hashable, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Hashable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,10 @@ import jax.numpy as jnp
 logger = logging.getLogger("spark_rapids_ml_tpu.precompile")
 
 _POOL_WORKERS = 16
+# executable-cache bound: far above any one fit's geometry count (the MXU
+# forest's worst case is ~480), small enough that a long-lived process
+# cycling through many distinct fit shapes cannot grow without bound
+_MAX_CACHED = 1024
 
 
 def aval(shape: Tuple[int, ...], dtype: Any) -> jax.ShapeDtypeStruct:
@@ -71,7 +76,7 @@ class Precompiler:
 
     def __init__(self, max_workers: int = _POOL_WORKERS):
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
-        self._jobs: Dict[Hashable, _Job] = {}
+        self._jobs: "OrderedDict[Hashable, _Job]" = OrderedDict()
         self._lock = threading.Lock()
         self._workers = []
         for i in range(max_workers):
@@ -100,6 +105,16 @@ class Precompiler:
                 return
             job = _Job()
             self._jobs[key] = job
+            # LRU bound: evict the oldest FINISHED executables (an in-flight
+            # job must stay — its waiter holds a reference to the key)
+            while len(self._jobs) > _MAX_CACHED:
+                stale = next(
+                    (k for k, j in self._jobs.items() if j.done.is_set()),
+                    None,
+                )
+                if stale is None:
+                    break
+                del self._jobs[stale]
         self._q.put((job, fn, avals, static_kwargs))
 
     def call(self, key: Hashable, fn, *args, **static_kwargs):
@@ -110,6 +125,8 @@ class Precompiler:
         executable propagate to the caller."""
         with self._lock:
             job = self._jobs.get(key)
+            if job is not None:
+                self._jobs.move_to_end(key)  # LRU recency
         if job is None:
             return fn(*args, **static_kwargs)
         try:
@@ -119,7 +136,30 @@ class Precompiler:
             with self._lock:
                 self._jobs.pop(key, None)
             return fn(*args, **static_kwargs)
-        return compiled(*args)
+        try:
+            return compiled(*args)
+        except Exception as exc:
+            # AOT executables are lowered from bare ShapeDtypeStructs
+            # (default placement).  An argument arriving committed to
+            # another device or carrying a non-default sharding is an INPUT
+            # incompatibility, not a kernel failure: drop the executable and
+            # fall back to the plain jit call, which re-specializes.  All
+            # other runtime errors (OOM and friends) propagate unchanged —
+            # they must surface at their true site.
+            msg = str(exc).lower()
+            if any(
+                s in msg for s in ("sharding", "placement", "compiled for input")
+            ):
+                logger.warning(
+                    "AOT executable for %r rejected its inputs (%s); "
+                    "jit fallback",
+                    key,
+                    exc,
+                )
+                with self._lock:
+                    self._jobs.pop(key, None)
+                return fn(*args, **static_kwargs)
+            raise
 
 
 _global: Optional[Precompiler] = None

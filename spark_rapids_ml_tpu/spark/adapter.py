@@ -234,13 +234,10 @@ def transform_output_ddl(model: Any, sdf: Any) -> str:
     return ", ".join(fields)
 
 
-def _prepare_features_for_arrow(model: Any, sdf: Any) -> Any:
+def _cast_vector_col(sdf: Any, input_col: str) -> Any:
     """Cast a VectorUDT features column to array<double> so Arrow can ship
     it to the executors (the reference's _pre_process_data does the same
     vector_to_array cast, core.py:1043-1124)."""
-    input_col, _ = model._get_input_columns()
-    if input_col is None:
-        return sdf
     for f in sdf.schema.fields:
         if f.name == input_col and f.dataType.simpleString() == "vector":
             from pyspark.ml.functions import vector_to_array
@@ -248,6 +245,13 @@ def _prepare_features_for_arrow(model: Any, sdf: Any) -> Any:
 
             return sdf.withColumn(input_col, vector_to_array(col(input_col)))
     return sdf
+
+
+def _prepare_features_for_arrow(model: Any, sdf: Any) -> Any:
+    input_col, _ = model._get_input_columns()
+    if input_col is None:
+        return sdf
+    return _cast_vector_col(sdf, input_col)
 
 
 def executor_transform(model: Any, sdf: Any) -> Any:
@@ -341,6 +345,184 @@ def executor_transform_evaluate(
     return [m.evaluate(evaluator) for m in metrics]
 
 
+# -- executor-side kneighbors ------------------------------------------------
+# NearestNeighbors on a live pyspark cluster: item and query partitions stay
+# on the executors (the reference keeps them worker-resident and exchanges
+# p2p inside a barrier stage, knn.py:452-560).  The two frames are tagged,
+# unioned, and dispatched as ONE barrier stage; each task splits its rows
+# back into item/query sides and runs ops.knn.distributed_kneighbors over
+# the BarrierTaskContext control plane.  Only query blocks and (Q, k)
+# candidate lists ever cross task boundaries — never item rows, and nothing
+# is collected to the driver.
+
+_KNN_MARKER = "__srml_knn_is_item__"
+
+
+def ensure_id_col(sdf: Any, id_col: str) -> Any:
+    """Append a monotonically increasing id column when `id_col` is absent
+    (the reference's _ensureIdCol, nearest_neighbors.py row-number alias)."""
+    if id_col in sdf.columns:
+        return sdf
+    from pyspark.sql.functions import monotonically_increasing_id
+
+    return sdf.withColumn(id_col, monotonically_increasing_id())
+
+
+def run_barrier_kneighbors(
+    item_sdf: Any,
+    query_sdf: Any,
+    k: int,
+    id_col: str,
+    input_col: Any,
+    input_cols: Any,
+    num_workers: int,
+) -> Any:
+    """Exact kneighbors over a barrier stage; returns the knn pyspark
+    DataFrame (query_<id>, indices, distances) sorted by query id —
+    the reference's kneighbors output contract (knn.py:411-466)."""
+    from pyspark import BarrierTaskContext
+    from pyspark.sql.functions import lit
+
+    feat_cols = [input_col] if input_col is not None else list(input_cols)
+
+    def _side(sdf: Any, is_item: bool) -> Any:
+        if input_col is not None:
+            sdf = _cast_vector_col(sdf, input_col)
+        return sdf.select(*feat_cols, id_col).withColumn(
+            _KNN_MARKER, lit(1 if is_item else 0)
+        )
+
+    union = _side(item_sdf, True).union(_side(query_sdf, False)).repartition(
+        num_workers
+    )
+
+    def _knn_udf(iterator):
+        from ..core import extract_partition_features
+        from ..ops.knn import distributed_kneighbors
+
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        cp = SparkBarrierControlPlane(ctx)
+        item_parts, query_parts = [], []
+        for pdf in iterator:
+            if len(pdf) == 0:
+                continue
+            mask = pdf[_KNN_MARKER].to_numpy() == 1
+            for is_item, sel in ((True, pdf[mask]), (False, pdf[~mask])):
+                if len(sel) == 0:
+                    continue
+                sel = sel.reset_index(drop=True)
+                feats = extract_partition_features(
+                    sel, input_col, input_cols, np.float32
+                )
+                ids = np.asarray(sel[id_col].to_numpy(), np.int64)
+                (item_parts if is_item else query_parts).append((feats, ids))
+        results = distributed_kneighbors(
+            item_parts, query_parts, k, rank, num_workers, cp
+        )
+        ctx.barrier()
+        for (d, ids), (_, qids) in zip(results, query_parts):
+            yield pd.DataFrame(
+                {
+                    f"query_{id_col}": qids,
+                    "indices": list(np.asarray(ids, np.int64)),
+                    "distances": list(np.asarray(d, np.float32)),
+                }
+            )
+
+    out_schema = (
+        f"query_{id_col} bigint, indices array<bigint>, distances array<float>"
+    )
+    rdd = (
+        union.mapInPandas(_knn_udf, schema=out_schema)
+        .rdd.barrier()
+        .mapPartitions(lambda it: it)
+    )
+    rdd = try_stage_level_scheduling(rdd, item_sdf.sparkSession)
+    knn_df = item_sdf.sparkSession.createDataFrame(rdd, schema=out_schema)
+    return knn_df.sort(f"query_{id_col}")
+
+
+def _struct_frame(
+    sdf: Any, struct_name: str, id_col: str, join_col: str, drop_id: bool
+) -> Any:
+    """(join_col bigint, struct_name struct<all columns>) built partition-
+    wise — the struct stays a per-row dict through Arrow, typed by the DDL
+    derived from the frame's own schema.  VectorUDT columns are cast to
+    array<double> first: Arrow cannot ship a UDT into the pandas UDF and
+    'vector' is not parseable DDL (the struct field type differs from the
+    reference's, which keeps the UDT via native Spark SQL structs)."""
+    for f in list(sdf.schema.fields):
+        if f.dataType.simpleString() == "vector":
+            sdf = _cast_vector_col(sdf, f.name)
+    fields = [(f.name, f.dataType.simpleString()) for f in sdf.schema.fields]
+    keep = [(n, t) for n, t in fields if not (drop_id and n == id_col)]
+    ddl = (
+        f"{join_col} bigint, {struct_name} struct<"
+        + ",".join(f"{n}:{t}" for n, t in keep)
+        + ">"
+    )
+    names = [n for n, _ in keep]
+
+    def _mk(iterator):
+        for pdf in iterator:
+            if len(pdf) == 0:
+                continue
+            yield pd.DataFrame(
+                {
+                    join_col: np.asarray(pdf[id_col].to_numpy(), np.int64),
+                    struct_name: pdf[names].to_dict("records"),
+                }
+            )
+
+    return sdf.mapInPandas(_mk, schema=ddl)
+
+
+def spark_knn_join(
+    item_df: Any,
+    query_df: Any,
+    knn_df: Any,
+    id_col: str,
+    dist_col: str,
+    drop_generated_id: bool,
+) -> Any:
+    """exactNearestNeighborsJoin on live pyspark frames: explode the knn
+    pairs partition-wise, then two real Spark equi-joins against struct-
+    packed item/query frames (the reference builds the same
+    (item_df, query_df, distCol) rows with arrays_zip/explode + two joins,
+    knn.py:604-672).  Nothing is collected to the driver."""
+    qcol, icol = f"query_{id_col}", f"item_{id_col}"
+
+    def _explode(iterator):
+        for pdf in iterator:
+            if len(pdf) == 0:
+                continue
+            ind = np.asarray(pdf["indices"].tolist(), np.int64)
+            dist = np.asarray(pdf["distances"].tolist(), np.float32)
+            if ind.ndim != 2 or ind.shape[1] == 0:
+                continue
+            kk = ind.shape[1]
+            yield pd.DataFrame(
+                {
+                    qcol: np.repeat(pdf[qcol].to_numpy(), kk),
+                    icol: ind.ravel(),
+                    dist_col: dist.ravel(),
+                }
+            )
+
+    pair = knn_df.mapInPandas(
+        _explode, schema=f"{qcol} bigint, {icol} bigint, {dist_col} float"
+    )
+    item_struct = _struct_frame(
+        item_df, "item_df", id_col, icol, drop_generated_id
+    )
+    query_struct = _struct_frame(
+        query_df, "query_df", id_col, qcol, drop_generated_id
+    )
+    out = pair.join(item_struct, on=icol).join(query_struct, on=qcol)
+    return out.select("item_df", "query_df", dist_col)
+
+
 def barrier_fit_estimator(
     estimator: Any,
     sdf: Any,
@@ -358,16 +540,45 @@ def barrier_fit_estimator(
     from ..parallel import runner
 
     num_workers = infer_spark_num_workers(estimator, sdf.sparkSession)
-    # fail fast ON THE DRIVER for estimators that cannot run multi-process —
-    # the executor-side check would surface as N opaque task tracebacks
+    # Estimators that cannot run multi-process: either degrade to a single
+    # barrier task (estimators flagging _cluster_fit_single_task — UMAP's
+    # reference semantics: sample, coalesce to one worker, fit there,
+    # distribute only inference, umap.py:831-850) or fail fast ON THE DRIVER
+    # (the executor-side check would surface as N opaque task tracebacks).
     if num_workers > 1 and not getattr(
         estimator, "_supports_multicontroller_fit", True
     ):
-        raise NotImplementedError(
-            f"{type(estimator).__name__} does not yet support multi-process "
-            "(barrier) training. Train with num_workers=1 or "
-            "SRML_SPARK_COLLECT=1 (driver-local fit)."
-        )
+        if getattr(estimator, "_cluster_fit_single_task", False):
+            from ..utils import get_logger
+
+            if (
+                estimator.hasParam("sample_fraction")
+                and estimator.getOrDefault("sample_fraction") < 1.0
+            ):
+                # sample with Spark BEFORE coalescing so only the sampled
+                # rows travel to the single fit task (the reference samples
+                # the distributed frame first too, umap.py:832-841)
+                frac = float(estimator.getOrDefault("sample_fraction"))
+                seed = estimator._tpu_params.get("random_state")
+                sdf = sdf.sample(
+                    fraction=frac,
+                    seed=int(seed) & 0x7FFFFFFF if seed is not None else None,
+                )
+                estimator = estimator.copy(
+                    {estimator.getParam("sample_fraction"): 1.0}
+                )
+            get_logger(type(estimator)).info(
+                "%s fits on a single worker; running a 1-task barrier stage "
+                "(inference remains distributed)",
+                type(estimator).__name__,
+            )
+            num_workers = 1
+        else:
+            raise NotImplementedError(
+                f"{type(estimator).__name__} does not yet support "
+                "multi-process (barrier) training. Train with num_workers=1 "
+                "or SRML_SPARK_COLLECT=1 (driver-local fit)."
+            )
 
     def _closure(partitions, rank, nranks, control_plane):
         return runner.run_distributed_fit(

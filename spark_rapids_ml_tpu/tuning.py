@@ -146,10 +146,46 @@ class CrossValidator(_ValidatorParams):
             pairs.append((DataFrame(train_parts), folds[i]))
         return pairs
 
-    def fit(self, dataset: Any) -> "CrossValidatorModel":
-        return self._fit(as_dataframe(dataset))
+    def _kFold_spark(self, sdf: Any) -> List[Tuple[Any, Any]]:
+        """Fold a LIVE pyspark DataFrame with Spark itself (randomSplit +
+        union) so the dataset is never collected to the driver — each fold's
+        train/valid frames stay distributed and ride the estimator's barrier
+        fit and the executor-side transform-evaluate (the reference folds
+        with Spark the same way, tuning.py:91-148)."""
+        n = self.getNumFolds()
+        folds = sdf.randomSplit([1.0] * n, seed=self.getOrDefault("seed"))
+        pairs = []
+        for i in range(n):
+            train = None
+            for j, f in enumerate(folds):
+                if j == i:
+                    continue
+                train = f if train is None else train.union(f)
+            # cache both frames: the fit and the transform-evaluate each
+            # action the fold, and uncached randomSplit branches would
+            # re-scan the full source lineage per action (pyspark's own CV
+            # caches folds the same way); fit() unpersists after the run
+            pairs.append((train.cache(), folds[i].cache()))
+        return pairs
 
-    def _fit(self, dataset: DataFrame) -> "CrossValidatorModel":
+    def fit(self, dataset: Any) -> "CrossValidatorModel":
+        from .core import _use_executor_path
+
+        if _use_executor_path(dataset):
+            # cluster CV: folds, fits, and scoring all stay on the executors
+            folds = self._kFold_spark(dataset)
+            try:
+                return self._fit(dataset, folds)
+            finally:
+                for train, valid in folds:
+                    train.unpersist()
+                    valid.unpersist()
+        df = as_dataframe(dataset)
+        return self._fit(df, self._kFold(df))
+
+    def _fit(
+        self, dataset: Any, datasets: Optional[List[Tuple[Any, Any]]] = None
+    ) -> "CrossValidatorModel":
         est = self.getEstimator()
         eva = self.getEvaluator()
         epm = self.getEstimatorParamMaps()
@@ -164,7 +200,8 @@ class CrossValidator(_ValidatorParams):
         sub_models: Optional[List[List[_TpuModel]]] = (
             [[None] * num_models for _ in range(n_folds)] if collect_sub else None  # type: ignore[list-item]
         )
-        datasets = self._kFold(dataset)
+        if datasets is None:
+            datasets = self._kFold(dataset)
 
         def one_fold(fold: int):
             train, valid = datasets[fold]

@@ -1,0 +1,42 @@
+# Worker process for the multi-controller kneighbors test: one rank of a
+# distributed_kneighbors exchange over a FileControlPlane (the stand-in for
+# Spark's BarrierTaskContext — same role as mc_worker.py for fits).  No
+# jax.distributed bootstrap is needed: the protocol moves query blocks and
+# candidate lists over the control plane only; each rank computes on its own
+# local device mesh, exactly as a Spark barrier task would.
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from spark_rapids_ml_tpu.ops.knn import distributed_kneighbors  # noqa: E402
+from spark_rapids_ml_tpu.parallel.runner import FileControlPlane  # noqa: E402
+
+
+def main() -> None:
+    rank, nranks, root = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    with open(os.path.join(root, "knn_job.json")) as f:
+        job = json.load(f)
+    data = np.load(os.path.join(root, f"knn_shard_{rank}.npz"))
+    item_parts = [(data["item_X"], data["item_id"])]
+    query_parts = (
+        [(data["q_X"], data["q_id"])] if data["q_X"].shape[0] else []
+    )
+    cp = FileControlPlane(os.path.join(root, "cp"), rank, nranks, timeout=180)
+    results = distributed_kneighbors(
+        item_parts, query_parts, job["k"], rank, nranks, cp
+    )
+    if results:
+        d, i = results[0]
+    else:
+        d = np.zeros((0, job["k"]), np.float32)
+        i = np.zeros((0, job["k"]), np.int64)
+    np.savez(os.path.join(root, f"knn_out_{rank}.npz"), d=d, i=i)
+
+
+if __name__ == "__main__":
+    main()

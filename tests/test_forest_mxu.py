@@ -226,6 +226,63 @@ def test_mxu_deep_phase_smoke_fast():
     assert abs(a1 - a2) < 0.03, (a1, a2)
 
 
+def test_mxu_deep_phase_smoke_fast_regression():
+    """Regression-kind deep-phase gate for default CI (round-3 advice): the
+    stats3 plumbing (tot3 rows, base=stat_rows[:2]) through the fused
+    shallow/deep steps previously ran only behind --runslow, so a
+    regression-kind breakage would merge green.  S=2 stat rows -> l_s=6,
+    so depth 7 crosses into the bucketed deep phase."""
+    rng = np.random.default_rng(12)
+    N, D, B, T, depth = _ROW_TILE, 8, 8, 2, 7
+    X = rng.standard_normal((N, D)).astype(np.float32)
+    y = (
+        X @ rng.standard_normal(D) + 0.1 * rng.standard_normal(N)
+    ).astype(np.float32)
+    edges = compute_bin_edges(X, B)
+    Xb = np.asarray(bin_features(jnp.asarray(X), jnp.asarray(edges)))
+    bins_fm = Xb.T.astype(np.int8)
+    w_trees = np.ones((T, N), np.float32)
+    base = np.stack([np.ones(N, np.float32), y])
+    stats3 = np.stack([np.ones(N, np.float32), y, y * y])
+
+    f, t, v, ns, imp = grow_forest_mxu(
+        jnp.asarray(bins_fm), jnp.asarray(base), jnp.asarray(w_trees),
+        jnp.asarray(stats3), edges, max_depth=depth, n_bins=B,
+        kind="regression", max_features=D, min_samples_leaf=1.0,
+        min_impurity_decrease=0.0, seed=3, y_vals=jnp.asarray(y),
+        interpret=True,
+    )
+    st_old = jnp.stack(
+        [jnp.ones(N), jnp.asarray(y), jnp.asarray(y) ** 2], axis=1
+    )
+    stats_t = jnp.broadcast_to(st_old[None], (T, N, 3))
+    f2, t2, v2, _, _ = grow_forest(
+        jnp.asarray(Xb), stats_t, edges, max_depth=depth, n_bins=B,
+        kind="regression", max_features=D, min_samples_leaf=1.0,
+        min_impurity_decrease=0.0, seed=3,
+    )
+    f2_h = np.asarray(f2)
+    # shallow levels must agree exactly; deep levels tolerate bf16 tie flips
+    shallow = slice(0, 2**5 - 1)
+    assert (f[:, shallow] == f2_h[:, shallow]).mean() > 0.97
+    assert (f == f2_h).mean() > 0.85, (f == f2_h).mean()
+    p1 = np.asarray(
+        forest_predict_kernel(
+            jnp.asarray(X), jnp.asarray(f), jnp.asarray(t), jnp.asarray(v),
+            max_depth=depth,
+        )
+    )[:, 0]
+    p2 = np.asarray(
+        forest_predict_kernel(
+            jnp.asarray(X), jnp.asarray(f2), jnp.asarray(t2),
+            jnp.asarray(v2), max_depth=depth,
+        )
+    )[:, 0]
+    e1 = ((p1 - y) ** 2).mean() / y.var()
+    e2 = ((p2 - y) ** 2).mean() / y.var()
+    assert abs(e1 - e2) < 0.03, (e1, e2)
+
+
 @pytest.mark.slow
 def test_mxu_deep_phase_matches_scatter_builder():
     """Depth past the slot budget triggers the bucket-sort deep phase;

@@ -318,6 +318,79 @@ def test_empty_rank_joins_fit(tmp_path):
     )
 
 
+def test_kneighbors_across_processes_matches_single_controller(tmp_path):
+    """distributed_kneighbors over 2 OS processes (VERDICT round 3, item 1):
+    item rows stay in their owning process, query blocks + candidate lists
+    ride the FileControlPlane, and the merged result must equal a
+    single-process knn_search over the concatenated item set."""
+    from spark_rapids_ml_tpu.ops.knn import knn_search
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    root = str(tmp_path)
+    rng = np.random.default_rng(13)
+    n_items, n_query, d, k = 700, 96, 10, 9
+    items = rng.standard_normal((n_items, d)).astype(np.float32)
+    queries = rng.standard_normal((n_query, d)).astype(np.float32)
+    item_ids = rng.permutation(n_items).astype(np.int64) * 5  # non-trivial ids
+    query_rows = np.array_split(np.arange(n_query), NRANKS)
+    item_rows = np.array_split(np.arange(n_items), NRANKS)
+    for r in range(NRANKS):
+        np.savez(
+            os.path.join(root, f"knn_shard_{r}.npz"),
+            item_X=items[item_rows[r]], item_id=item_ids[item_rows[r]],
+            q_X=queries[query_rows[r]],
+            q_id=query_rows[r].astype(np.int64),
+        )
+    with open(os.path.join(root, "knn_job.json"), "w") as f:
+        json.dump({"k": k}, f)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "knn_mc_worker.py"),
+             str(r), str(NRANKS), root],
+            env=_worker_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for r in range(NRANKS)
+    ]
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=600)
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+
+    d_mc = np.zeros((n_query, k), np.float32)
+    i_mc = np.zeros((n_query, k), np.int64)
+    for r in range(NRANKS):
+        got = np.load(os.path.join(root, f"knn_out_{r}.npz"))
+        d_mc[query_rows[r]] = got["d"]
+        i_mc[query_rows[r]] = got["i"]
+    d_sc, i_sc = knn_search(items, item_ids, queries, k, get_mesh(None))
+    np.testing.assert_allclose(d_mc, d_sc, rtol=1e-5, atol=1e-6)
+    assert (i_mc == i_sc).mean() > 0.99  # ids may swap only on exact ties
+
+
+def test_allgather_large_chunks_over_frame_limit(tmp_path):
+    """_allgather_large must reassemble payloads wider than the per-message
+    chunk, with ragged per-rank sizes (rank 1 sends a short message)."""
+    import threading
+
+    from spark_rapids_ml_tpu.ops.knn import _allgather_large
+    from spark_rapids_ml_tpu.parallel.runner import FileControlPlane
+
+    payloads = {0: "a" * 2500, 1: "b" * 3, 2: "c" * 7001}
+    results = {}
+
+    def run(rank):
+        cp = FileControlPlane(str(tmp_path / "cp"), rank, 3, timeout=30)
+        results[rank] = _allgather_large(cp, payloads[rank], chunk=1000)
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for rank in range(3):
+        assert results[rank] == [payloads[0], payloads[1], payloads[2]]
+
+
 def test_partition_descriptor_gather_over_file_control_plane(tmp_path):
     """PartitionDescriptor.gather exchanges per-rank sizes like the
     reference's allGather (utils.py:178-196) — driven here with threads over

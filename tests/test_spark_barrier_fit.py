@@ -69,15 +69,20 @@ class _FakeRdd:
 
 
 class _FakeConf:
+    def __init__(self, conf=None):
+        self._conf = {"spark.master": "local[1]", **(conf or {})}
+
     def get(self, key, default=None):
-        return {"spark.master": "local[1]"}.get(key, default)
+        return self._conf.get(key, default)
 
 
 class _FakeSparkSession:
     version = "3.5.0"
 
-    def __init__(self):
-        self.sparkContext = types.SimpleNamespace(getConf=lambda: _FakeConf())
+    def __init__(self, conf=None):
+        self.sparkContext = types.SimpleNamespace(
+            getConf=lambda: _FakeConf(conf)
+        )
 
 
 class _FakeSparkDataFrame:
@@ -85,10 +90,11 @@ class _FakeSparkDataFrame:
     advertises the pyspark module path so core._is_pyspark_dataframe routes
     it to the barrier dispatcher."""
 
-    def __init__(self, partitions, udf=None):
+    def __init__(self, partitions, udf=None, conf=None):
         self._partitions = partitions
         self._udf = udf
-        self.sparkSession = _FakeSparkSession()
+        self._conf = conf
+        self.sparkSession = _FakeSparkSession(conf)
 
     def repartition(self, n):
         if n == len(self._partitions):
@@ -96,11 +102,22 @@ class _FakeSparkDataFrame:
         whole = pd.concat(self._partitions, ignore_index=True)
         idx = np.array_split(np.arange(len(whole)), n)
         return _FakeSparkDataFrame(
-            [whole.iloc[ix].reset_index(drop=True) for ix in idx]
+            [whole.iloc[ix].reset_index(drop=True) for ix in idx],
+            conf=self._conf,
+        )
+
+    def sample(self, fraction=None, seed=None, withReplacement=None):
+        rng = np.random.default_rng(seed)
+        return _FakeSparkDataFrame(
+            [
+                p[rng.random(len(p)) < fraction].reset_index(drop=True)
+                for p in self._partitions
+            ],
+            conf=self._conf,
         )
 
     def mapInPandas(self, udf, schema=None):
-        return _FakeSparkDataFrame(self._partitions, udf=udf)
+        return _FakeSparkDataFrame(self._partitions, udf=udf, conf=self._conf)
 
     @property
     def rdd(self):
@@ -209,6 +226,46 @@ def test_num_workers_inference_order(fake_pyspark):
     ) == 7
     # fallback: single worker (NOT the partition or device count)
     assert infer_spark_num_workers(est, _Spark({})) == 1
+
+
+def test_umap_cluster_fit_degrades_to_single_task(fake_pyspark):
+    """UMAP on a >1-worker cluster must NOT raise: the adapter runs a 1-task
+    barrier stage (the reference samples + coalesces to one worker,
+    umap.py:831-850) and inference stays distributed."""
+    from spark_rapids_ml_tpu import UMAP
+    from spark_rapids_ml_tpu.spark.adapter import NUM_WORKERS_CONF
+
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((256, 6)).astype(np.float32)
+    parts = [
+        pd.DataFrame({"features": list(X[ix])}).reset_index(drop=True)
+        for ix in np.array_split(np.arange(len(X)), 4)
+    ]
+    sdf = _FakeSparkDataFrame(parts, conf={NUM_WORKERS_CONF: "4"})
+    model = UMAP(n_neighbors=5, n_epochs=30, random_state=4).fit(sdf)
+    emb = np.asarray(model.embedding_)
+    assert emb.shape == (256, 2) and np.isfinite(emb).all()
+
+
+def test_umap_cluster_fit_samples_with_spark(fake_pyspark):
+    """sample_fraction < 1 on the cluster path samples the DISTRIBUTED frame
+    before the 1-task stage — only the sampled rows reach the fit."""
+    from spark_rapids_ml_tpu import UMAP
+    from spark_rapids_ml_tpu.spark.adapter import NUM_WORKERS_CONF
+
+    rng = np.random.default_rng(8)
+    X = rng.standard_normal((400, 5)).astype(np.float32)
+    parts = [
+        pd.DataFrame({"features": list(X[ix])}).reset_index(drop=True)
+        for ix in np.array_split(np.arange(len(X)), 4)
+    ]
+    sdf = _FakeSparkDataFrame(parts, conf={NUM_WORKERS_CONF: "4"})
+    est = UMAP(n_neighbors=5, n_epochs=30, random_state=7, sample_fraction=0.5)
+    model = est.fit(sdf)
+    n_fit = model.raw_data_.shape[0]
+    assert 120 <= n_fit <= 280  # ~half the rows, sampled Spark-side
+    # the estimator the user holds is untouched by the internal copy
+    assert est.getSampleFraction() == 0.5
 
 
 def test_collect_override_falls_back_to_driver_local(fake_pyspark, monkeypatch):
