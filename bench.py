@@ -79,16 +79,20 @@ def _sync(x) -> float:
 
 def _timed_repeats(fn, repeats: int):
     """One warmup call (compiles are cached for the timed runs), then
-    `repeats` timed calls.  Returns the per-run seconds — the multi-repeat
-    protocol exists because single timed runs on the tunneled device have
-    been observed 5x apart under congestion."""
+    `repeats` timed calls.  Returns (cold_seconds, per-run seconds): the
+    cold time captures the first-fit experience (compiles + staging) the
+    warm numbers amortize away; the multi-repeat protocol exists because
+    single timed runs on the tunneled device have been observed 5x apart
+    under congestion."""
+    t0 = time.perf_counter()
     fn()
+    cold = time.perf_counter() - t0
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         fn()
         times.append(time.perf_counter() - t0)
-    return times
+    return cold, times
 
 
 def _device_padded_gen(mesh, rows, gen_fn, seed=42):
@@ -133,12 +137,17 @@ def build_arm(algo: str, overrides):
 
     if algo == "kmeans":
         k = int(_ov("SRML_BENCH_K", 1000 if on_accel else 64))
-        from spark_rapids_ml_tpu.ops.kmeans import lloyd_iterations, random_init
+        from spark_rapids_ml_tpu import KMeans
+        from spark_rapids_ml_tpu.dataframe import DataFrame
 
         # Unit-scale centers with unit noise: clusters overlap, so Lloyd
         # genuinely uses all maxIter iterations (wider separation converges
         # exactly in ~6 iterations and would overstate throughput vs the
-        # reference's 30-iteration arm).
+        # reference's 30-iteration arm).  Data is generated on device and
+        # enters through DataFrame.from_device — the timed region is the
+        # PUBLIC estimator fit (validation, param translation, dispatch,
+        # solver, attribute fetch), with ingest untimed the same way the
+        # reference's GPU arm starts from plugin-cached device data.
         import jax.numpy as jnp
 
         def _gen(key, n_pad):
@@ -151,22 +160,22 @@ def build_arm(algo: str, overrides):
 
         Xs, w = _device_padded_gen(mesh, rows, _gen)
         _sync(Xs.sum())
-        chunk = min(32768, Xs.shape[0])
+        df = DataFrame.from_device(Xs, n_rows=rows)
+        est = KMeans(k=k, maxIter=iters, tol=0.0, initMode="random", seed=1)
 
         def fit():
-            c0 = random_init(Xs, w, k, seed=1)
-            centers, _, _ = lloyd_iterations(
-                Xs, w, c0, mesh, max_iter=iters, tol=0.0, chunk=chunk
-            )
-            return _sync(centers)
+            model = est.fit(df)
+            return _sync(np.asarray(model.cluster_centers_))
 
         return fit, f"kmeans_fit_throughput_k{k}_d{cols}_iter{iters}", rows
 
     if algo == "pca":
         k = int(_ov("SRML_BENCH_K", 3))
-        from spark_rapids_ml_tpu.ops.linalg import pca_fit
+        from spark_rapids_ml_tpu import PCA
+        from spark_rapids_ml_tpu.dataframe import DataFrame
 
-        # low-rank + noise generated on device (no 4.8 GB upload)
+        # low-rank + noise generated on device (no 4.8 GB upload); timed
+        # region = PCA().fit() at the public API (see kmeans arm note)
         import jax.numpy as jnp
 
         def _gen(key, n_pad):
@@ -177,10 +186,12 @@ def build_arm(algo: str, overrides):
 
         Xs, w = _device_padded_gen(mesh, rows, _gen)
         _sync(Xs.sum())
+        df = DataFrame.from_device(Xs, n_rows=rows)
+        est = PCA(k=k)
 
         def fit():
-            mean, comps, var, ratio, sv = pca_fit(Xs, w, k)
-            return float(np.asarray(comps).ravel()[0])
+            model = est.fit(df)
+            return float(np.asarray(model.components_).ravel()[0])
 
         return fit, f"pca_fit_throughput_k{k}_d{cols}", rows
 
@@ -227,18 +238,19 @@ def build_arm(algo: str, overrides):
     if algo == "logreg_sparse":
         # BASELINE.json repro config scaled to one chip: multinomial logreg
         # on sparse rows (1Bx100 at 1% nnz in the reference's distributed
-        # arm).  Fits via the ELL kernels (ops/sparse.py) — no
-        # densification anywhere.
-        from spark_rapids_ml_tpu.ops.logistic import logistic_fit_kernel
-        from spark_rapids_ml_tpu.ops.sparse import EllMatrix
+        # arm).  Timed region = LogisticRegression().fit() on a CSR-built
+        # DataFrame — the ELL kernels underneath (ops/sparse.py) never
+        # densify; the device-input cache keeps repeat ingest untimed.
+        import scipy.sparse as sp
+
+        from spark_rapids_ml_tpu import LogisticRegression
+        from spark_rapids_ml_tpu.dataframe import DataFrame
 
         rows = int(_ov("SRML_BENCH_ROWS", 4_000_000 if on_accel else 50_000))
         cols = int(_ov("SRML_BENCH_COLS", 100))
         n_classes = 4
         density = 0.01
         nnz_per_row = max(1, int(cols * density))
-        # ELL construction directly (uniform row occupancy, like the
-        # reference's gen_data sparse output)
         idx = rng.integers(0, cols, size=(rows, nnz_per_row), dtype=np.int32)
         val = rng.standard_normal((rows, nnz_per_row), dtype=np.float32)
         W_true = rng.standard_normal((cols, n_classes), dtype=np.float32)
@@ -246,18 +258,20 @@ def build_arm(algo: str, overrides):
         logits = np.zeros((rows, n_classes), np.float32)
         for j in range(nnz_per_row):
             logits += val[:, j : j + 1] * W_true[idx[:, j]]
-        y = logits.argmax(axis=1).astype(np.int32)
-        ell = EllMatrix(jax.device_put(idx), jax.device_put(val), cols)
-        y_dev = jax.device_put(y)
-        w_dev = jax.device_put(np.ones(rows, np.float32))
+        y = logits.argmax(axis=1).astype(np.float32)
+        indptr = np.arange(0, (rows + 1) * nnz_per_row, nnz_per_row, dtype=np.int64)
+        csr = sp.csr_matrix(
+            (val.ravel(), idx.ravel().astype(np.int64), indptr),
+            shape=(rows, cols),
+        )
+        df = DataFrame.from_numpy(csr, y, num_partitions=1)
+        est = LogisticRegression(
+            regParam=1e-5, maxIter=max(iters, 100), tol=1e-6
+        )
 
         def fit():
-            W, b, n_iter, conv = logistic_fit_kernel(
-                ell, y_dev, w_dev, k=n_classes, reg=1e-5, l1_ratio=0.0,
-                fit_intercept=True, max_iter=max(iters, 100), tol=1e-6,
-                use_owlqn=False,
-            )
-            return _sync(W)
+            model = est.fit(df)
+            return float(np.asarray(model.coefficientMatrix).ravel()[0])
 
         return (
             fit,
@@ -270,23 +284,25 @@ def build_arm(algo: str, overrides):
 
         # brute-force kNN is FLOP-bound: 2*n_items*d FLOP per query row
         # (2.4 GFLOP at the 400k x 3000 default), so the per-chip query
-        # budget is what keeps the arm's wall-clock sane
-        n_query = int(_ov("SRML_BENCH_QUERIES", min(rows, 8192)))
+        # budget is what keeps the arm's wall-clock sane.  16384 = two
+        # dispatch blocks, so result fetches overlap the next block's
+        # compute (the steady state a real serving loop runs in)
+        n_query = int(_ov("SRML_BENCH_QUERIES", min(rows, 16384)))
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.ops.knn import (
-            knn_block_adaptive,
-            knn_block_kernel,
-        )
-
-        # index + queries GENERATED on device: the metric is query
-        # throughput against a resident index (the reference's GPU arm also
-        # queries data already on the GPUs), and a 4.9 GB index upload
-        # through the tunnel is untimed setup that can eat 30+ min when the
-        # link is congested.  Results still cross the host link as part of
-        # serving (the (Q, k) distance/position fetch inside fit()).
+        from spark_rapids_ml_tpu import NearestNeighbors
+        from spark_rapids_ml_tpu.dataframe import DataFrame
         from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
+        # Timed region = the PUBLIC model.kneighbors(query_df) call.  Index
+        # + queries are GENERATED on device (a 4.9 GB index upload through
+        # the tunnel is untimed setup that can eat 30+ min under
+        # congestion) and installed in the model's own staging caches —
+        # the state any user reaches after one prior kneighbors call on
+        # the same model (the reference's GPU arm likewise queries data
+        # already resident on the GPUs).  The host-side frames carry
+        # placeholder feature blocks whose values are never read on the
+        # cached path.
         n_dev = mesh.shape[DATA_AXIS]
         n_pad = rows + (-rows) % n_dev
         items_dev = jax.jit(
@@ -296,13 +312,6 @@ def build_arm(algo: str, overrides):
             out_shardings=data_sharding(mesh),
         )(0)
         norm_dev = jax.jit(lambda x: jnp.einsum("nd,nd->n", x, x))(items_dev)
-        pos_dev = jax.device_put(
-            np.arange(n_pad, dtype=np.int32), data_sharding(mesh)
-        )
-        valid_dev = jax.device_put(
-            np.arange(n_pad) < rows, data_sharding(mesh)
-        )
-        ids_host = np.arange(n_pad, dtype=np.int64)
         Q_dev = jax.jit(
             lambda s: jax.random.normal(
                 jax.random.PRNGKey(s), (n_query, cols), jnp.float32
@@ -311,35 +320,39 @@ def build_arm(algo: str, overrides):
         _sync(norm_dev.sum())
         _sync(Q_dev.sum())
 
-        # mirror the production gate (ops/knn.py knn_search_prepared): the
-        # adaptive kernel needs a full chunk per SHARD and its k bound
-        from spark_rapids_ml_tpu.ops.knn import (
-            _ADAPTIVE_CHUNK,
-            _ADAPTIVE_MIN_LOCAL,
-        )
+        from spark_rapids_ml_tpu.core import extract_partition_features
+        from spark_rapids_ml_tpu.ops.knn import PreparedItems
 
-        n_loc_bench = n_pad // max(1, n_dev)
-        on_tpu_wide = (
-            jax.default_backend() == "tpu"
-            and n_loc_bench >= max(_ADAPTIVE_MIN_LOCAL, _ADAPTIVE_CHUNK)
-            and k <= _ADAPTIVE_CHUNK // 8
+        item_df = DataFrame.from_numpy(
+            np.empty((rows, cols), np.float32), num_partitions=1
+        )
+        query_df = DataFrame.from_numpy(
+            np.empty((n_query, cols), np.float32), num_partitions=1
+        )
+        est = NearestNeighbors(k=k)
+        model = est.fit(item_df)
+        # seed the staging caches with the device-resident index/queries
+        prepared = PreparedItems(
+            items_dev,
+            norm_dev,
+            jax.device_put(
+                np.arange(n_pad, dtype=np.int32), data_sharding(mesh)
+            ),
+            jax.device_put(np.arange(n_pad) < rows, data_sharding(mesh)),
+            np.r_[np.arange(rows, dtype=np.int64), np.full(n_pad - rows, -1)],
+            rows,
+        )
+        q_block = extract_partition_features(
+            query_df.partitions[0], "features", None, np.float32
+        )
+        model.seed_staging(
+            prepared, query_blocks={0: (q_block, Q_dev)}, mesh=mesh
         )
 
         def fit():
-            if on_tpu_wide:
-                # adaptive exact path (ops/knn.py knn_block_adaptive):
-                # raw hardware approx + global count-verify + per-row
-                # exact fallback — the production route for this shape
-                d, pos = knn_block_adaptive(
-                    items_dev, norm_dev, pos_dev, valid_dev, Q_dev, mesh, k,
-                )
-            else:
-                d, pos = knn_block_kernel(
-                    items_dev, norm_dev, pos_dev, valid_dev, Q_dev, mesh, k,
-                )
-                d, pos = np.asarray(d), np.asarray(pos)
-            ids_out = ids_host[pos]
-            return float(np.asarray(d).ravel()[0]) + ids_out.shape[0] * 0.0
+            _, _, knn_df = model.kneighbors(query_df)
+            d0 = knn_df.partitions[0]["distances"].iloc[0]
+            return float(np.asarray(d0).ravel()[0])
 
         # throughput counts completed query rows
         return fit, f"knn_query_throughput_n{rows}_d{cols}_k{k}", n_query
@@ -348,28 +361,34 @@ def build_arm(algo: str, overrides):
     if on_accel_rf:
         # the reference's published regressor arm: 30 trees, bins=128,
         # depth=6 on 1M x 3000 synthetic (run_benchmark.sh:113-122; GPU pair
-        # 52 s).  Runs the MXU histogram builder (ops/forest_mxu) at the
-        # true 3000-column shape; the timed region covers binning + layout +
-        # growth from device-resident f32 features, matching what cuML's
-        # fit() does after ingest.  featureSubsetStrategy follows Spark's
-        # 'auto' (onethird -> 1000 features).
+        # 52 s).  Timed region = the PUBLIC RandomForest*.fit() on a
+        # from_device frame — estimator preprocessing, device-side binning
+        # sample + edges, MXU histogram growth (ops/forest_mxu), and the
+        # forest-attribute fetch all inside the clock, matching what cuML's
+        # fit() does after plugin-cached ingest.
         import jax.numpy as jnp
 
-        from spark_rapids_ml_tpu.ops.forest import bin_features_feature_major
-        from spark_rapids_ml_tpu.ops.forest_hist import _ROW_TILE
-        from spark_rapids_ml_tpu.ops.forest_mxu import grow_forest_mxu
+        from spark_rapids_ml_tpu import (
+            RandomForestClassifier,
+            RandomForestRegressor,
+        )
+        from spark_rapids_ml_tpu.dataframe import DataFrame
 
         rows = int(_ov("SRML_BENCH_ROWS", 400_000))
         if algo == "rf_reg":
-            # 30 trees, depth 6, onethird feature subsets
-            n_trees, depth, n_bins = 30, 6, 128
-            max_features = cols // 3
-            kind = "regression"
+            # 30 trees, depth 6, onethird feature subsets (Spark 'auto')
+            est = RandomForestRegressor(
+                numTrees=30, maxDepth=6, maxBins=128,
+                featureSubsetStrategy="onethird", seed=3,
+            )
+            n_trees, depth = 30, 6
         else:
             # 50 trees, depth 13 (deep bucketed phase), sqrt subsets
-            n_trees, depth, n_bins = 50, 13, 128
-            max_features = max(1, int(np.sqrt(cols)))
-            kind = "gini"
+            est = RandomForestClassifier(
+                numTrees=50, maxDepth=13, maxBins=128,
+                featureSubsetStrategy="sqrt", seed=3,
+            )
+            n_trees, depth = 50, 13
         n_informative = 10  # sklearn make_regression default, as the
         # reference's gen_data uses (gen_data.py)
         coef = np.zeros(cols, np.float32)
@@ -385,48 +404,14 @@ def build_arm(algo: str, overrides):
                 y = (y > 0).astype(jnp.float32)
             return X, y
 
-        n_pad = rows + (-rows) % _ROW_TILE
-        Xs, ys = jax.jit(lambda s: _gen(jax.random.PRNGKey(s), n_pad))(42)
+        Xs, ys = jax.jit(lambda s: _gen(jax.random.PRNGKey(s), rows))(42)
         _sync(Xs.sum())
-        w = np.zeros(n_pad, np.float32)
-        w[:rows] = 1.0
-        # quantile edges from a small strided host sample (4096 rows x D,
-        # ~50 MB): device jnp.quantile sorts (S, 3000) columns — an XLA sort
-        # that takes 20+ min to COMPILE on this backend (memory:
-        # axon-tpu-quirks), while np.quantile on the host sample is instant.
-        # Edge computation happens OUTSIDE the timed region either way.
-        qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-        sample = np.asarray(Xs[:: max(1, n_pad // 4096)])
-        edges = np.quantile(sample, qs, axis=0).T.astype(np.float32)
-        edges_dev = jnp.asarray(edges)
-        w_dev = jax.device_put(w)
-
-        @jax.jit
-        def _stats(ys, w):
-            if kind == "regression":
-                base = jnp.stack([jnp.ones_like(ys), ys])
-                stats3 = jnp.stack([jnp.ones_like(ys), ys, ys * ys])
-            else:
-                base = jnp.stack([(ys == 0.0), (ys == 1.0)]).astype(
-                    jnp.float32
-                )
-                stats3 = base  # unused for classification
-            bw = jax.random.poisson(
-                jax.random.PRNGKey(7), 1.0, (n_trees, n_pad)
-            ).astype(jnp.float32)
-            return base, stats3, w[None, :] * bw
+        y_host = np.asarray(ys)  # labels are O(N) scalars, features stay put
+        df = DataFrame.from_device(Xs, y=y_host, n_rows=rows)
 
         def fit():
-            bins_fm = bin_features_feature_major(Xs, edges_dev)
-            base, stats3, w_trees = _stats(ys, w_dev)
-            f, t, v, ns, imp = grow_forest_mxu(
-                bins_fm, base, w_trees,
-                stats3 if kind == "regression" else None, edges,
-                max_depth=depth, n_bins=n_bins, kind=kind,
-                max_features=max_features, min_samples_leaf=1.0,
-                min_impurity_decrease=0.0, seed=3, y_vals=ys,
-            )
-            return float(f[0, 0])
+            model = est.fit(df)
+            return float(model.getNumTrees)
 
         return (
             fit,
@@ -493,9 +478,11 @@ def build_arm(algo: str, overrides):
 
 
 def run_arm(algo: str, overrides, repeats: int):
-    """Build, warm up, and time one arm; returns its stats dict."""
+    """Build, warm up, and time one arm; returns its stats dict.  cold_sec
+    records the first (warmup) call — compiles + device staging included —
+    so the first-fit experience is a captured artifact, not a claim."""
     fit, label, rows = build_arm(algo, overrides)
-    times = _timed_repeats(fit, repeats)
+    cold, times = _timed_repeats(fit, repeats)
     med, best = statistics.median(times), min(times)
     value = rows / med
     baseline = REF_ROWS / REF_GPU_SECONDS.get(algo, REF_GPU_SECONDS["kmeans"])
@@ -507,16 +494,38 @@ def run_arm(algo: str, overrides, repeats: int):
         "value_best": round(rows / best, 1),
         "spread_pct": round(100.0 * (max(times) - best) / med, 1),
         "times_sec": [round(t, 3) for t in times],
+        "cold_sec": round(cold, 3),
     }
 
 
 def _release_arm_state():
     """Free device buffers between arms (the fit closures pin the staged
-    datasets; the estimator arms also pin the device-input cache slot)."""
+    datasets; the estimator arms also pin the device-input cache slot).
+    After the cache clear + gc, any still-live device array of arm scale is
+    a leak — delete it outright (nothing legitimate survives between arms)
+    and report it, then sync the stream so queued deallocations land before
+    the next arm's multi-GB staging races them (run r4a: rf/umap arms
+    RESOURCE_EXHAUSTED behind the knn arm's lingering 4.8 GB)."""
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+
     from spark_rapids_ml_tpu.core import clear_fit_cache
 
     clear_fit_cache()
     gc.collect()
+    leaked = [a for a in jax.live_arrays() if a.nbytes >= (64 << 20)]
+    if leaked:
+        total = sum(a.nbytes for a in leaked) / 2**30
+        print(
+            f"[bench] releasing {len(leaked)} leaked device buffers "
+            f"({total:.2f} GB)",
+            file=sys.stderr,
+        )
+        for a in leaked:
+            a.delete()
+    _sync(jnp.zeros(1))  # flush pending deallocations through the relay
 
 
 def main() -> None:

@@ -223,6 +223,9 @@ class _TpuCaller(_TpuParams):
     def _use_dtype(
         self, df: DataFrame, input_col: Optional[str], input_cols: Optional[List[str]]
     ) -> np.dtype:
+        dev = getattr(df, "_device_features", None)
+        if dev is not None:
+            return np.dtype(dev[0].dtype)
         if self._float32_inputs:
             return np.dtype(np.float32)
         # float32_inputs=False preserves the input dtype (reference
@@ -305,6 +308,9 @@ class _TpuCaller(_TpuParams):
     def _build_fit_inputs(
         self, df: DataFrame, keep_row_id: bool = False
     ) -> FitInputs:
+        dev = getattr(df, "_device_features", None)
+        if dev is not None:
+            return self._build_fit_inputs_device(df, dev, keep_row_id)
         feats, labels, weights, dtype = self._pre_process_data(df)
         partition_rows = [f.shape[0] for f in feats]
         nonempty = [f for f in feats if f.shape[0] > 0]
@@ -395,6 +401,57 @@ class _TpuCaller(_TpuParams):
             n_cols=n_cols,
             mesh=mesh,
             pdesc=pdesc,
+            dtype=dtype,
+            row_id=np.arange(n_rows) if keep_row_id else None,
+        )
+
+    def _build_fit_inputs_device(
+        self, df: DataFrame, dev: Any, keep_row_id: bool
+    ) -> FitInputs:
+        """FitInputs straight from a DataFrame.from_device feature array:
+        no feature extraction, no upload.  Labels/weights still come from
+        the (host) partitions; padded rows are masked through the weight
+        vector exactly like the host-ingest path."""
+        Xs, n_rows, n_cols, _fcol = dev
+        dtype = np.dtype(Xs.dtype)
+        mesh = get_mesh(self.num_workers)
+        n_pad = Xs.shape[0]
+        label_col = self._fit_label_col()
+        weight_col = (
+            self.getOrDefault("weightCol")
+            if self.hasParam("weightCol") and self.isSet("weightCol")
+            else None
+        )
+        w_np = np.ones(n_rows, dtype=dtype)
+        if weight_col is not None:
+            w_np = np.concatenate(
+                [
+                    np.asarray(p[weight_col].to_numpy(), dtype=dtype)
+                    for p in df.partitions
+                ]
+            )
+        mask = np.zeros(n_pad, dtype=dtype)
+        mask[:n_rows] = w_np
+        ws = jax.device_put(mask, data_sharding(mesh))
+        ys = None
+        if label_col is not None:
+            y_np = np.concatenate(
+                [
+                    np.asarray(p[label_col].to_numpy(), dtype=dtype)
+                    for p in df.partitions
+                ]
+            )
+            y_pad = np.zeros(n_pad, dtype=dtype)
+            y_pad[:n_rows] = y_np
+            ys = jax.device_put(y_pad, data_sharding(mesh))
+        return FitInputs(
+            X=Xs,
+            weight=ws,
+            y=ys,
+            n_rows=n_rows,
+            n_cols=n_cols,
+            mesh=mesh,
+            pdesc=PartitionDescriptor.build([n_rows], n_cols),
             dtype=dtype,
             row_id=np.arange(n_rows) if keep_row_id else None,
         )
@@ -647,6 +704,12 @@ class _TpuModel(_TpuParams):
 
             return executor_transform(self, dataset)
         df = as_dataframe(dataset)
+        if getattr(df, "_device_features", None) is not None:
+            raise NotImplementedError(
+                "DataFrame.from_device frames are fit-input only (their "
+                "features column is a placeholder); transform host or "
+                "pyspark frames instead"
+            )
         input_col, input_cols = self._get_input_columns()
         dtype = self._transform_dtype(self._model_attributes.get("dtype"))
         transform_fn = self._get_tpu_transform_func(df)

@@ -103,6 +103,9 @@ class DataFrame:
             if list(p.columns) != cols:
                 raise ValueError("All partitions must share the same columns")
         self._partitions: List[pd.DataFrame] = parts
+        # set by from_device: (X_dev, n_rows, n_cols, featuresCol) — a
+        # device-resident feature array that fits consume directly
+        self._device_features = None
 
     # -- constructors ------------------------------------------------------
     @classmethod
@@ -112,6 +115,42 @@ class DataFrame:
     @classmethod
     def from_arrow(cls, table: Any, num_partitions: int = 1) -> "DataFrame":
         return cls.from_pandas(table.to_pandas(), num_partitions)
+
+    @classmethod
+    def from_device(
+        cls,
+        X: Any,                     # jax.Array (N_pad, D), optionally sharded
+        y: Optional[Any] = None,    # (n_rows,) jax or numpy
+        weight: Optional[Any] = None,
+        featuresCol: str = "features",
+        labelCol: str = "label",
+        weightCol: str = "weight",
+        n_rows: Optional[int] = None,
+    ) -> "DataFrame":
+        """Facade backed by a DEVICE-RESIDENT feature array — jax-native
+        ingest.  Estimator fits consume `X` directly (no host
+        materialization, no upload): the TPU analog of the reference riding
+        the spark-rapids plugin's GPU-resident columnar cache (its
+        executors hand cuML device arrays when the DataFrame is cached on
+        GPU).  `X` may already be sharded over a mesh; pass `n_rows` when
+        trailing rows are padding.  Labels/weights are materialized
+        host-side (solvers re-extract them per fit; they are O(N) scalars,
+        not the O(N*D) features).
+
+        FIT-INPUT ONLY: transform/kneighbors need per-partition host
+        features and raise on a from_device frame — run inference through
+        the host-facade or pyspark paths, or the ops-level kernels."""
+        n_valid = int(n_rows if n_rows is not None else X.shape[0])
+        # the features column is a placeholder (readers must go through the
+        # device array); keep it 1 byte/row
+        cols: Dict[str, Any] = {featuresCol: np.zeros(n_valid, np.int8)}
+        if y is not None:
+            cols[labelCol] = np.asarray(y)[:n_valid]
+        if weight is not None:
+            cols[weightCol] = np.asarray(weight)[:n_valid]
+        df = cls([pd.DataFrame(cols)])
+        df._device_features = (X, n_valid, int(X.shape[1]), featuresCol)
+        return df
 
     @classmethod
     def from_numpy(

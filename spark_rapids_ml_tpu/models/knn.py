@@ -128,6 +128,13 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
     def __init__(self, item_df: Optional[DataFrame] = None, **kwargs: Any) -> None:
         super().__init__()
         self._item_df = item_df
+        # device-staging caches for repeated kneighbors calls on one model
+        # (the TPU analog of cuML keeping the index device-resident): the
+        # prepared item blocks are cached when the whole set fits the HBM
+        # budget, and each query partition's upload is cached keyed by the
+        # identity of its zero-copy feature block.  Both die with the model.
+        self._staged_items: Optional[Tuple[Any, Any]] = None
+        self._staged_queries: Dict[int, Tuple[int, Any]] = {}
 
     def _iter_item_blocks(self, id_col: str, dtype, mesh):
         """(features, ids) stream over the item partitions — the host never
@@ -225,12 +232,8 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
             )
 
         mesh = get_mesh(self.num_workers)
-        per_part = knn_search_streamed(
-            self._iter_item_blocks(id_col, dtype, mesh),
-            _query_feats,
-            [len(p) for p in q_parts],
-            self.getK(),
-            mesh,
+        per_part = self._search_partitions(
+            id_col, dtype, mesh, q_parts, _query_feats, self.getK()
         )
         out_parts = []
         for part, (dists, ids) in zip(q_parts, per_part):
@@ -246,6 +249,120 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
                 )
             )
         return self._item_df, qdf, DataFrame(out_parts)
+
+    def _search_partitions(self, id_col, dtype, mesh, q_parts, query_feats, k):
+        """Exact search of every query partition against the item set.
+
+        In-core item sets (fitting the per-replica HBM budget) are staged to
+        the device ONCE and cached on the model, so repeated kneighbors
+        calls — batch inference loops, benchmarks — pay only compute;
+        query partition uploads are cached the same way (keyed by the
+        identity of the extracted feature array, with the host array
+        pinned so the id cannot be recycled).  Larger-than-HBM item sets
+        keep the uncached streaming path (knn_search_streamed)."""
+        from ..ops.knn import (
+            _hbm_budget_bytes,
+            knn_search_prepared,
+            knn_search_streamed,
+        )
+        from ..parallel.mesh import DATA_AXIS
+
+        n_dev = mesh.shape[DATA_AXIS]
+        parts = [p for p in self._item_df.partitions if len(p)]
+        rows = sum(len(p) for p in parts)
+        dim = None
+        if parts:
+            from ..core import extract_partition_features
+
+            input_col, input_cols = self._get_input_columns()
+            # dimensionality from ONE row — extracting the whole first
+            # partition would re-stack O(rows x D) cell features on every
+            # call for list-cell frames, the cost the staging cache exists
+            # to amortize
+            dim = extract_partition_features(
+                parts[0].iloc[:1], input_col, input_cols, dtype
+            ).shape[1]
+        in_core = (
+            dim is not None
+            and rows * dim * np.dtype(dtype).itemsize
+            <= _hbm_budget_bytes() * n_dev
+        )
+        if not in_core:
+            self._staged_items = None
+            return knn_search_streamed(
+                self._iter_item_blocks(id_col, dtype, mesh),
+                query_feats,
+                [len(p) for p in q_parts],
+                k,
+                mesh,
+            )
+        key = self._staging_key(mesh, rows, dim)
+        if self._staged_items is None or self._staged_items[0] != key:
+            blocks = list(self._iter_item_blocks(id_col, dtype, mesh))
+            assert len(blocks) == 1  # by the in-core bound above
+            self._staged_items = (key, blocks[0])
+            self._staged_queries.clear()
+        prepared = self._staged_items[1]
+        k_eff = min(k, prepared.n_items)
+        out = []
+        for p in range(len(q_parts)):
+            if len(q_parts[p]) == 0:
+                out.append(
+                    (
+                        np.zeros((0, k_eff), dtype),
+                        np.zeros((0, k_eff), np.int64),
+                    )
+                )
+                continue
+            feats = query_feats(p)
+            out.append(
+                knn_search_prepared(
+                    prepared, self._staged_query(p, feats, dtype), k, mesh
+                )
+            )
+        return out
+
+    def _staging_key(self, mesh, rows: int, dim: int):
+        """Identity of the staged item set — ONE definition shared by the
+        lookup in _search_partitions and seed_staging, so external seeding
+        can never drift from the cache-hit check."""
+        return (
+            tuple(id(p) for p in self._item_df.partitions),
+            id(mesh),
+            rows,
+            dim,
+        )
+
+    def seed_staging(self, prepared, query_blocks=None, mesh=None) -> None:
+        """Install an already device-resident item set (ops.knn
+        PreparedItems) — and optionally per-query-partition device arrays —
+        as this model's staging caches.  For callers whose data is already
+        on device (jax-native pipelines, benchmarks): subsequent kneighbors
+        calls are compute-only, and a key mismatch is impossible because
+        the key is computed here by the same _staging_key the lookup
+        uses."""
+        mesh = mesh or get_mesh(self.num_workers)
+        rows = sum(len(p) for p in self._item_df.partitions)
+        dim = int(prepared.items.shape[1])
+        self._staged_items = (self._staging_key(mesh, rows, dim), prepared)
+        self._staged_queries.clear()
+        if query_blocks:
+            for p, (feats, dev) in query_blocks.items():
+                self._staged_queries[p] = (feats, dev)
+
+    def _staged_query(self, p: int, feats: np.ndarray, dtype):
+        import jax.numpy as jnp
+
+        ent = self._staged_queries.get(p)
+        if (
+            ent is not None
+            and ent[0] is feats  # pinned host array: identity is stable
+            and ent[1].shape == feats.shape
+        ):
+            return ent[1]
+        dev = jnp.asarray(np.asarray(feats, dtype))
+        self._staged_queries[p] = (feats, dev)
+        return dev
 
     def exactNearestNeighborsJoin(
         self, query_df: Any, distCol: str = "distCol"

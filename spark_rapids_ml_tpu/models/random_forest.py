@@ -52,6 +52,7 @@ from ..params import (
 from ..ops.forest import (
     TreeArrays,
     bin_features,
+    bin_features_feature_major,
     compute_bin_edges,
     forest_predict_kernel,
     grow_forest,
@@ -161,9 +162,26 @@ def _str_or_numerical(value: str) -> Union[str, float, int]:
             return value
 
 
+def _mxu_eligible(inputs, n_bins, max_features, max_depth, s_split) -> bool:
+    """Whether the MXU histogram builder (ops/forest_mxu) serves this fit;
+    False -> the scatter path.  TPU scatter sustains ~10M updates/s, the
+    MXU path ~36 TF-equivalent.  The pallas kernel is single-chip (no
+    sharding rule yet): sharded fits keep the scatter path, which runs
+    correctly under GSPMD."""
+    from ..ops import forest_mxu
+
+    return (
+        jax.default_backend() == "tpu"
+        and inputs.mesh.devices.size == 1
+        and n_bins <= 128
+        and max_features <= 1024
+        and forest_mxu.mxu_depth_supported(max_depth, s_split)
+    )
+
+
 def _maybe_grow_mxu(
     inputs,
-    Xb,
+    bins_fm,        # (D, n_pad) int8 feature-major (bin_features_feature_major)
     edges,
     stats,
     n_trees,
@@ -178,37 +196,22 @@ def _maybe_grow_mxu(
     min_samples_leaf,
     min_impurity_decrease,
 ):
-    """Route the fit through the MXU histogram builder (ops/forest_mxu) when
-    the hardware and shape qualify; None -> caller takes the scatter path.
-    TPU scatter sustains ~10M updates/s, the MXU path ~36 TF-equivalent."""
+    """Grow on the MXU histogram builder.  Caller has already checked
+    _mxu_eligible and binned feature-major — the row-major int bin matrix
+    this path used to re-lay-out was a redundant 1.2-4.8 GB resident copy
+    that tipped the depth-13 benchmark fit over HBM."""
     from ..ops import forest_mxu
-    from ..ops.forest_hist import _ROW_TILE
 
-    s_split = 2 if not is_classification else stats.shape[1]
-    if (
-        jax.default_backend() != "tpu"
-        or inputs.mesh.devices.size != 1
-        or n_bins > 128
-        or max_features > 1024
-        or not forest_mxu.mxu_depth_supported(max_depth, s_split)
-    ):
-        # the pallas kernel is single-chip (no sharding rule yet): sharded
-        # fits keep the scatter path, which runs correctly under GSPMD
-        return None
-    n = Xb.shape[0]
-    n_pad = -(-n // _ROW_TILE) * _ROW_TILE
+    n_pad = bins_fm.shape[1]
 
     @partial(jax.jit, static_argnames=("n_pad",))
-    def _layout(Xb, stats, weight, n_pad):
-        pad = n_pad - Xb.shape[0]
-        # cast before pad/transpose: the int8 copies are 4x smaller than the
-        # int32 bin matrix they derive from
-        bins_fm = jnp.pad(Xb.astype(jnp.int8), ((0, pad), (0, 0))).T
+    def _layout(stats, weight, n_pad):
+        pad = n_pad - stats.shape[0]
         st = jnp.pad(stats, ((0, pad), (0, 0))).T  # (S_in, n_pad)
         w = jnp.pad(weight, (0, pad))
-        return bins_fm, st, w
+        return st, w
 
-    bins_fm, st_fm, w_pad = _layout(Xb, stats, inputs.weight, n_pad)
+    st_fm, w_pad = _layout(stats, inputs.weight, n_pad)
     if is_classification:
         base_stats, stats3 = st_fm, None
         # class index per row (deep phase rebuilds one-hot stats post-sort)
@@ -417,7 +420,7 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
         is_classification = self._is_classification
 
         def _single_fit(
-            inputs: FitInputs, params: Dict[str, Any], Xb, edges, stats, extra_attrs
+            inputs: FitInputs, params: Dict[str, Any], get_bins, edges, stats, extra_attrs
         ) -> Dict[str, Any]:
             max_depth = int(params["max_depth"])
             if max_depth > _MAX_SUPPORTED_DEPTH:
@@ -453,11 +456,12 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
                 ),
             )
             key = jax.random.PRNGKey(seed)
-            mxu = _maybe_grow_mxu(
-                inputs, Xb, edges, stats, n_trees, bootstrap, seed,
-                is_classification, **grow_kwargs,
-            )
-            if mxu is not None:
+            s_split = 2 if not is_classification else stats.shape[1]
+            if _mxu_eligible(inputs, n_bins, max_features, max_depth, s_split):
+                mxu = _maybe_grow_mxu(
+                    inputs, get_bins("fm", edges), edges, stats, n_trees,
+                    bootstrap, seed, is_classification, **grow_kwargs,
+                )
                 features, thresholds, leaf_values, node_counts, impurities = mxu
                 logger.info(
                     "grew %d trees on the MXU histogram path (depth<=%d, "
@@ -481,6 +485,7 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
             # or the (T, N, S) per-tree stats tensor itself (a per-tree fit
             # only ever holds one (N, S) stats array) — those cases fall
             # back to per-tree growth.
+            Xb = get_bins("rm", edges)
             subset_bytes = (
                 n_trees * (2**max_depth) * inputs.n_cols * 4
                 if max_features < inputs.n_cols
@@ -540,7 +545,37 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
             # the benchmark shape — and raises outright multi-process)
             X_host = _binning_sample(inputs)
             edges = compute_bin_edges(X_host, n_bins)
-            Xb = bin_features(inputs.X, jnp.asarray(edges))
+
+            # Lazy per-route binning: the MXU route bins straight into the
+            # feature-major int8 layout (bin_features_feature_major), the
+            # scatter route row-major.  Binning eagerly row-major and
+            # re-laying-out kept TWO full bin matrices resident — the copy
+            # that OOM'd the 400k x 3000 depth-13 benchmark fit.  The cache
+            # holds the edges OBJECT alongside each entry (id() alone can
+            # be recycled after gc) and keeps only the CURRENT edges' bin
+            # matrices — distinct-n_bins sweeps drop the previous matrices
+            # instead of accumulating one full-size copy per override.
+            bins_cache: Dict[str, Any] = {}
+
+            def get_bins(layout: str, e):
+                cached = bins_cache.get(layout)
+                if cached is not None and cached[0] is e:
+                    return cached[1]
+                if any(held[0] is not e for held in bins_cache.values()):
+                    bins_cache.clear()  # new edges: old matrices are dead
+                if layout == "fm":
+                    from ..ops.forest_hist import _ROW_TILE
+
+                    n = inputs.X.shape[0]
+                    n_pad = -(-n // _ROW_TILE) * _ROW_TILE
+                    out = bin_features_feature_major(
+                        inputs.X, jnp.asarray(e), n_pad=n_pad
+                    )
+                else:
+                    out = bin_features(inputs.X, jnp.asarray(e))
+                bins_cache[layout] = (e, out)
+                return out
+
             stats, extra_attrs = self._label_stats(inputs)
             if extra_params:
                 results = []
@@ -549,12 +584,15 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
                     p.update(override)
                     if int(p["n_bins"]) != n_bins:
                         e2 = compute_bin_edges(X_host, int(p["n_bins"]))
-                        xb2 = bin_features(inputs.X, jnp.asarray(e2))
-                        results.append(_single_fit(inputs, p, xb2, e2, stats, extra_attrs))
+                        results.append(
+                            _single_fit(inputs, p, get_bins, e2, stats, extra_attrs)
+                        )
                     else:
-                        results.append(_single_fit(inputs, p, Xb, edges, stats, extra_attrs))
+                        results.append(
+                            _single_fit(inputs, p, get_bins, edges, stats, extra_attrs)
+                        )
                 return results
-            return _single_fit(inputs, params, Xb, edges, stats, extra_attrs)
+            return _single_fit(inputs, params, get_bins, edges, stats, extra_attrs)
 
         return _fit
 

@@ -29,7 +29,7 @@
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -69,12 +69,19 @@ def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
     (x > edge_b), identical to searchsorted side='left' on sorted edges)
     instead of searchsorted: binary search lowers to per-element gather
     chains that scalarize on TPU (~minutes for 400k x 3000), while the
-    compare-sum is B-1 fused VPU passes over X (~seconds, HBM-bound)."""
+    compare-sum is B-1 fused VPU passes over X (~seconds, HBM-bound).
+
+    Bins <= 128 (the common case, and everything the MXU route accepts)
+    emit int8 — the full-size int32 bin matrix was a 4.8 GB intermediate
+    at the 400k x 3000 benchmark shape, 4x the int8 footprint."""
+    # max bin value == number of edges; int8 holds up to 127
+    dt = jnp.int8 if edges.shape[1] <= 127 else jnp.int32
+
     def body(b, acc):
-        return acc + (X > edges[:, b][None, :]).astype(jnp.int32)
+        return acc + (X > edges[:, b][None, :]).astype(dt)
 
     return jax.lax.fori_loop(
-        0, edges.shape[1], body, jnp.zeros(X.shape, jnp.int32)
+        0, edges.shape[1], body, jnp.zeros(X.shape, dt)
     )
 
 
@@ -94,13 +101,15 @@ def _bin_chunk_t(X_chunk: jax.Array, edges: jax.Array) -> jax.Array:
 
 
 def bin_features_feature_major(
-    X: jax.Array, edges: jax.Array, chunk: int = 65536
+    X: jax.Array, edges: jax.Array, chunk: int = 65536,
+    n_pad: Optional[int] = None,
 ) -> jax.Array:
-    """(N, D) f32 -> (D, N) int8 binned, row-chunked so peak temp memory is
-    one (chunk, D) tile instead of a full int32 (N, D) copy (which OOMs at
-    the 3000-column benchmark shape).  A host-level chunk loop — putting the
-    searchsorted vmap inside lax.scan produced a faulting TPU kernel on the
-    axon backend.  Requires n_bins <= 128 (int8)."""
+    """(N, D) f32 -> (D, n_pad) int8 binned, row-chunked so peak temp memory
+    is one (chunk, D) tile instead of a full int32 (N, D) copy (which OOMs
+    at the 3000-column benchmark shape).  A host-level chunk loop — putting
+    the searchsorted vmap inside lax.scan produced a faulting TPU kernel on
+    the axon backend.  Requires n_bins <= 128 (int8).  Trailing columns up
+    to `n_pad` are zero bins (callers mask padded rows through weights)."""
     n, d = X.shape
     chunk = min(chunk, n)
     parts = []
@@ -109,6 +118,8 @@ def bin_features_feature_major(
         parts.append(
             _bin_chunk_t(jax.lax.dynamic_slice_in_dim(X, i, c), edges)
         )
+    if n_pad is not None and n_pad > n:
+        parts.append(jnp.zeros((d, n_pad - n), jnp.int8))
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
 
 

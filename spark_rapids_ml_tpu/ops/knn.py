@@ -497,12 +497,43 @@ def knn_block_adaptive_dispatch(
     Splitting dispatch from collection lets callers pipeline many query
     blocks — the per-block host round-trips (3 tunnel syncs each) were the
     dominant graph-build cost for small item sets like UMAP's 50k
-    self-join."""
-    cv, ci = _adaptive_candidates(
-        items, item_norm, item_pos, valid, qd, mesh, k, chunk
+    self-join.
+
+    Phase 1 (candidates) routes to the fused Pallas distance+top-m kernel
+    on single-shard TPU meshes (ops/pallas_knn.py): the selection runs on
+    the VMEM-resident distance tile instead of re-reading it from HBM m
+    times.  The merge / count-verify / exact-fallback phases are identical
+    either way, so the exactness contract does not depend on the route."""
+    from .pallas_knn import (
+        knn_candidates_pallas,
+        knn_count_pallas,
+        pallas_knn_eligible,
     )
+
+    n_pad = items.shape[0]
+    used_pallas = False
+    if pallas_knn_eligible(
+        mesh.shape[DATA_AXIS], items.shape[1], qd.shape[0]
+    ):
+        m = _select_m(k, 1024, n_pad)
+        if m <= _ADAPTIVE_MAX_M:
+            cv, ci = knn_candidates_pallas(
+                items, item_norm, valid, qd, k, m, n_pad
+            )
+            used_pallas = True
+    if not used_pallas:
+        cv, ci = _adaptive_candidates(
+            items, item_norm, item_pos, valid, qd, mesh, k, chunk
+        )
     fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
-    sa = _adaptive_count(items, item_norm, valid, qd, tu, mesh, chunk)
+    if used_pallas:
+        # count with the SAME kernel family: d2 values bitwise-match the
+        # candidate scan, so verification failures are only true overflow
+        # misses (measured: XLA count vs pallas candidates disagreed on ~3%
+        # of rows from scan rounding alone, each a wasted exact rerun)
+        sa = knn_count_pallas(items, item_norm, valid, qd, tu, n_pad)
+    else:
+        sa = _adaptive_count(items, item_norm, valid, qd, tu, mesh, chunk)
     return fv, fpos, sg, sa
 
 
@@ -988,13 +1019,19 @@ def distributed_kneighbors(
 
 def knn_search_prepared(
     prepared: PreparedItems,
-    queries: np.ndarray,
+    queries,
     k: int,
     mesh: Mesh,
     query_block: int = 8192,
     dtype=np.float32,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    q = np.asarray(queries, dtype=dtype)
+    """`queries` may be host numpy OR an already device-resident jax array
+    (repeat kneighbors calls cache their query uploads — models/knn.py);
+    the jax path pads/slices on device so no host round-trip sneaks in."""
+    if isinstance(queries, jax.Array):
+        q = queries if queries.dtype == dtype else queries.astype(dtype)
+    else:
+        q = np.asarray(queries, dtype=dtype)
     # one output contract for ALL paths (empty-query, in-core, out-of-core):
     # min(k, n_valid_items) columns, never (inf, -1)-padded to k — a -1 id
     # used to index item arrays would silently wrap to the last row
@@ -1032,9 +1069,12 @@ def knn_search_prepared(
             qb = q[start : start + block]
             n_q = qb.shape[0]
             if n_q < block:
-                qb = np.concatenate(
-                    [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)]
-                )
+                if isinstance(qb, jax.Array):
+                    qb = jnp.pad(qb, ((0, block - n_q), (0, 0)))
+                else:
+                    qb = np.concatenate(
+                        [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)]
+                    )
             qd_b = jnp.asarray(qb)
             handles = knn_block_adaptive_dispatch(
                 prepared.items, prepared.norm, prepared.pos, prepared.valid,
@@ -1103,9 +1143,13 @@ def knn_search_prepared(
         qb = q[start : start + block]
         n_q = qb.shape[0]
         if n_q < block:
-            qb = np.concatenate(
-                [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)], axis=0
-            )
+            if isinstance(qb, jax.Array):
+                qb = jnp.pad(qb, ((0, block - n_q), (0, 0)))
+            else:
+                qb = np.concatenate(
+                    [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)],
+                    axis=0,
+                )
         d, pos = knn_block_kernel(
             prepared.items, prepared.norm, prepared.pos, prepared.valid,
             jnp.asarray(qb), mesh, k,

@@ -1,0 +1,313 @@
+#
+# Fused distance + per-group partial-top-k Pallas TPU kernel for exact kNN.
+#
+# This is the structural fix for the kNN arm named in rounds 2-3: the
+# adaptive block search (ops/knn.py) pays its selection cost OUTSIDE the
+# matmul — the XLA candidates scan re-reads the (Q, chunk) distance tile
+# from HBM for every one of the m iterated (argmax, max, mask) passes, ~1 s
+# of pure VPU/HBM traffic per 8192-query block at the 400k x 3000 k=200
+# benchmark shape.  Here the (TQ, G) distance tile never leaves VMEM: each
+# grid cell accumulates the query x item-group dot product over D blocks
+# (MXU), and at the last D block runs the m selection passes on the
+# VMEM-resident tile (VPU) — selection rides the matmul's memory traffic
+# instead of repeating it.
+#
+# The kernel produces the same per-group top-m candidate pool as
+# ops/knn._candidates_scan (position-masked selection, so duplicate
+# distances stay distinct candidates); the pool then flows through the
+# UNCHANGED exact machinery — _adaptive_merge (exact top-k over the pool +
+# margined threshold), _adaptive_count (global count verification), and the
+# per-row exact fallback — so the result keeps the tie-tolerant exactness
+# contract documented at knn_block_adaptive.
+#
+# Output layout: (n_groups, m_pad, Q_pad) rather than (Q, n_groups*m) —
+# the last dim stays the 128-aligned query tile and the m_pad rows satisfy
+# the f32/int32 (8, 128) min-tile, so every store is lane-aligned.  The
+# wrapper transposes to the (Q, pool) layout _adaptive_merge expects (one
+# cheap HBM pass over the ~100 MB pool vs. the ~25 full-tile HBM sweeps
+# the fusion removes).
+#
+# Reference context: cuML brute-force kNN kernels behind NearestNeighborsMG
+# (used by spark-rapids-ml knn.py:486-560) fuse the distance epilogue the
+# same way on GPU.
+#
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .pallas_tpu import _round_up, pallas_enabled
+
+# tile geometry: TQ queries x TI items per grid cell, D consumed in KB-wide
+# blocks.  VMEM at (256, 1024, 512): 2x double-buffered q/item blocks
+# (2*(256+1024)*512*4 = 5.2 MB) + the f32 accumulator tile (1 MB) + norm
+# slivers — comfortably inside the ~15 MB scoped budget.
+_TILE_Q = 256
+_TILE_I = 1024
+_TILE_D = 512
+
+
+def _accum_dot(q_ref, it_ref, acc, kb, d_true: int, kd: int) -> None:
+    """Shared partial-dot accumulation for the candidate and count kernels.
+    MUST stay byte-for-byte identical between them: the count verification
+    compares counts derived from the two kernels' d2 values, and identical
+    tiling + identical ops on the same hardware make those values BITWISE
+    equal — so verification failures are genuine candidate-overflow misses,
+    never scan-to-scan rounding noise.
+
+    The dot runs at 3-pass bf16 precision — the explicit hi/lo decomposition
+    of lax.Precision.HIGH (~2^-19 relative), which Mosaic's dot lowering
+    does not accept as a precision flag.  A single-pass bf16 dot (~2^-8)
+    would break sklearn-level distance parity."""
+    it = it_ref[:]
+    if d_true % kd != 0:
+        # ragged D tail: the item array is (N_pad, d_true) and the last D
+        # block reads past it — undefined values (a NaN would survive the
+        # zero-padded query columns, 0 * NaN = NaN), so zero the tail
+        # in-VMEM.  Statically elided when D divides the block width.
+        dcol = kb * kd + jax.lax.broadcasted_iota(jnp.int32, it.shape, 1)
+        it = jnp.where(dcol < d_true, it, 0.0)
+    q = q_ref[:]
+    q_hi = q.astype(jnp.bfloat16)
+    q_lo = (q - q_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    it_hi = it.astype(jnp.bfloat16)
+    it_lo = (it - it_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    acc[:] += (
+        jnp.dot(q_hi, it_hi.T, preferred_element_type=jnp.float32)
+        + jnp.dot(q_hi, it_lo.T, preferred_element_type=jnp.float32)
+        + jnp.dot(q_lo, it_hi.T, preferred_element_type=jnp.float32)
+    )
+
+
+def _neg_d2(qn_ref, inorm_ref, acc, j, n_items: int, tile_i: int):
+    """Masked negated squared distances for the finished (TQ, TI) tile —
+    shared epilogue entry for both kernels (see _accum_dot on why)."""
+    tq = acc.shape[0]
+    neg = -(qn_ref[:] - 2.0 * acc[:] + inorm_ref[:])
+    # mask columns past the item set (ragged last group: OOB block reads
+    # are undefined, and NaN garbage would poison the argmax/count)
+    col = j * tile_i + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_i), 1)
+    return jnp.where(col < n_items, neg, -jnp.inf)
+
+
+def _knn_topm_kernel(
+    qn_ref, inorm_ref, q_ref, it_ref, vals_ref, idx_ref, acc,
+    *, m: int, m_pad: int, n_items: int, tile_i: int, d_true: int, kd: int,
+):
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    _accum_dot(q_ref, it_ref, acc, kb, d_true, kd)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _():
+        tq = acc.shape[0]
+        neg = _neg_d2(qn_ref, inorm_ref, acc, j, n_items, tile_i)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (tq, tile_i), 1)
+        vals, idxs = [], []
+        v = neg
+        for _ in range(m):
+            a = jnp.argmax(v, axis=1).astype(jnp.int32)
+            vals.append(jnp.max(v, axis=1))
+            idxs.append(a + j * tile_i)
+            # position-masking (not value-masking) keeps duplicate
+            # distances as distinct candidates — exact multiset semantics,
+            # same as ops/knn._group_topm
+            v = jnp.where(iota == a[:, None], -jnp.inf, v)
+        for _ in range(m_pad - m):
+            vals.append(jnp.full((tq,), -jnp.inf, jnp.float32))
+            idxs.append(jnp.zeros((tq,), jnp.int32))
+        vals_ref[0] = jnp.stack(vals)
+        idx_ref[0] = jnp.stack(idxs)
+
+
+def _knn_count_kernel(
+    qn_ref, inorm_ref, t_ref, q_ref, it_ref, out_ref, acc,
+    *, n_items: int, tile_i: int, d_true: int, kd: int,
+):
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    @pl.when((j == 0) & (kb == 0))
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    _accum_dot(q_ref, it_ref, acc, kb, d_true, kd)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _():
+        neg = _neg_d2(qn_ref, inorm_ref, acc, j, n_items, tile_i)
+        cnt = jnp.sum(neg > t_ref[:], axis=1).astype(jnp.int32)
+        out_ref[:] += cnt[:, None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "m", "n_items", "interpret")
+)
+def knn_candidates_pallas(
+    items: jax.Array,       # (N_pad, D) f32, device-resident
+    item_norm: jax.Array,   # (N_pad,) f32 squared norms
+    valid: jax.Array,       # (N_pad,) bool
+    queries: jax.Array,     # (Q, D) f32
+    k: int,
+    m: int,
+    n_items: int,           # static: N_pad (cols past it are masked)
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-group top-m candidate pool for every query: returns
+    (values (Q, ng*m_pad) negated squared distances, positions
+    (Q, ng*m_pad) int32 into the padded item set), ready for
+    ops.knn._adaptive_merge.  Padded slots carry -inf values."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Q, d = queries.shape
+    tq = min(_TILE_Q, _round_up(Q, 128))
+    d_pad = _round_up(d, 128)
+    kb = min(_TILE_D, d_pad)
+    d_blk = _round_up(d_pad, kb)
+    q_pad = _round_up(Q, tq)
+    n_pad = items.shape[0]
+    ng = -(-n_pad // _TILE_I)
+    m_pad = _round_up(m, 8)
+
+    # only the (small) query side is physically padded; the item array's
+    # ragged D tail and ragged last group are handled by in-kernel masking —
+    # padding the item side would copy GBs through HBM per call
+    qp = jnp.pad(
+        queries.astype(jnp.float32), ((0, q_pad - Q), (0, d_blk - d))
+    )
+    qn = (qp * qp).sum(axis=1, keepdims=True)  # (q_pad, 1), zeros rows safe
+    # invalid (padding) rows get +inf norms so their d2 is inf — they can
+    # never enter a top-m list
+    inorm = (
+        jnp.where(valid, item_norm, jnp.inf)
+        .reshape(1, n_pad)
+        .astype(jnp.float32)
+    )
+
+    grid = (q_pad // tq, ng, d_blk // kb)
+    vals, idxs = pl.pallas_call(
+        functools.partial(
+            _knn_topm_kernel,
+            m=m, m_pad=m_pad, n_items=n_items, tile_i=_TILE_I,
+            d_true=d, kd=kb,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tq, 1), lambda i, j, b: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _TILE_I), lambda i, j, b: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tq, kb), lambda i, j, b: (i, b), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE_I, kb), lambda i, j, b: (j, b), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, m_pad, tq), lambda i, j, b: (j, 0, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, m_pad, tq), lambda i, j, b: (j, 0, i),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((ng, m_pad, q_pad), jnp.float32),
+            jax.ShapeDtypeStruct((ng, m_pad, q_pad), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((tq, _TILE_I), jnp.float32)],
+        interpret=interpret,
+    )(qn, inorm, qp, items)
+    # (ng, m_pad, q_pad) -> (Q, ng*m_pad) pool layout for _adaptive_merge
+    cand_v = jnp.transpose(vals, (2, 0, 1)).reshape(q_pad, ng * m_pad)[:Q]
+    cand_i = jnp.transpose(idxs, (2, 0, 1)).reshape(q_pad, ng * m_pad)[:Q]
+    return cand_v, cand_i
+
+
+@functools.partial(jax.jit, static_argnames=("n_items", "interpret"))
+def knn_count_pallas(
+    items: jax.Array,       # (N_pad, D) f32
+    item_norm: jax.Array,   # (N_pad,) f32
+    valid: jax.Array,       # (N_pad,) bool
+    queries: jax.Array,     # (Q, D) f32
+    thresh: jax.Array,      # (Q,) f32 margined negated-d2 thresholds
+    n_items: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Exact global #{-d2 > thresh} per query (the verification count,
+    ops/knn._adaptive_count) computed with the SAME tiling and dot
+    decomposition as knn_candidates_pallas — the two kernels' d2 values are
+    bitwise identical, so the count check only fires on genuine overflow
+    misses.  Returns (Q,) int32."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Q, d = queries.shape
+    tq = min(_TILE_Q, _round_up(Q, 128))
+    d_pad = _round_up(d, 128)
+    kb = min(_TILE_D, d_pad)
+    d_blk = _round_up(d_pad, kb)
+    q_pad = _round_up(Q, tq)
+    n_pad = items.shape[0]
+    ng = -(-n_pad // _TILE_I)
+
+    qp = jnp.pad(
+        queries.astype(jnp.float32), ((0, q_pad - Q), (0, d_blk - d))
+    )
+    qn = (qp * qp).sum(axis=1, keepdims=True)
+    inorm = (
+        jnp.where(valid, item_norm, jnp.inf)
+        .reshape(1, n_pad)
+        .astype(jnp.float32)
+    )
+    # padded query rows: -inf threshold would count everything; +inf counts
+    # nothing (they are sliced off anyway, this just keeps sums small)
+    tp = jnp.pad(
+        thresh.astype(jnp.float32), (0, q_pad - Q), constant_values=jnp.inf
+    ).reshape(q_pad, 1)
+
+    grid = (q_pad // tq, ng, d_blk // kb)
+    counts = pl.pallas_call(
+        functools.partial(
+            _knn_count_kernel,
+            n_items=n_items, tile_i=_TILE_I, d_true=d, kd=kb,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tq, 1), lambda i, j, b: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _TILE_I), lambda i, j, b: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tq, 1), lambda i, j, b: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tq, kb), lambda i, j, b: (i, b), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE_I, kb), lambda i, j, b: (j, b), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tq, 1), lambda i, j, b: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((tq, _TILE_I), jnp.float32)],
+        interpret=interpret,
+    )(qn, inorm, tp, qp, items)
+    return counts[:Q, 0]
+
+
+def pallas_knn_eligible(mesh_shards: int, d: int, q: int) -> bool:
+    """The fused kernel serves the single-shard TPU fast path (the only
+    configuration this chip can run; multi-shard meshes keep the shard_map
+    scan).  Queries narrower than one lane tile would pad 2x+."""
+    return pallas_enabled() and mesh_shards == 1 and q >= 128 and d >= 128

@@ -174,17 +174,28 @@ class CrossValidator(_ValidatorParams):
         if _use_executor_path(dataset):
             # cluster CV: folds, fits, and scoring all stay on the executors
             folds = self._kFold_spark(dataset)
+
+            def _release_fold(train: Any, valid: Any) -> None:
+                train.unpersist()
+                valid.unpersist()
+
             try:
-                return self._fit(dataset, folds)
+                # per-fold release: holding every cached train frame until
+                # the end would pin ~(numFolds-1)x the dataset in executor
+                # storage at once (pyspark's CV unpersists per fold too)
+                return self._fit(dataset, folds, fold_cleanup=_release_fold)
             finally:
-                for train, valid in folds:
+                for train, valid in folds:  # safety for error paths
                     train.unpersist()
                     valid.unpersist()
         df = as_dataframe(dataset)
         return self._fit(df, self._kFold(df))
 
     def _fit(
-        self, dataset: Any, datasets: Optional[List[Tuple[Any, Any]]] = None
+        self,
+        dataset: Any,
+        datasets: Optional[List[Tuple[Any, Any]]] = None,
+        fold_cleanup: Optional[Any] = None,
     ) -> "CrossValidatorModel":
         est = self.getEstimator()
         eva = self.getEvaluator()
@@ -205,13 +216,17 @@ class CrossValidator(_ValidatorParams):
 
         def one_fold(fold: int):
             train, valid = datasets[fold]
-            if single_pass:
-                models = [m for _, m in est.fitMultiple(train, epm)]
-                combined = models[0]._combine(models)
-                metrics = combined._transformEvaluate(valid, eva)
-            else:
-                models = [m for _, m in est.fitMultiple(train, epm)]
-                metrics = [eva.evaluate(m.transform(valid)) for m in models]
+            try:
+                if single_pass:
+                    models = [m for _, m in est.fitMultiple(train, epm)]
+                    combined = models[0]._combine(models)
+                    metrics = combined._transformEvaluate(valid, eva)
+                else:
+                    models = [m for _, m in est.fitMultiple(train, epm)]
+                    metrics = [eva.evaluate(m.transform(valid)) for m in models]
+            finally:
+                if fold_cleanup is not None:
+                    fold_cleanup(train, valid)
             return fold, metrics, models if collect_sub else None
 
         pool = ThreadPool(processes=min(self.getParallelism(), max(1, n_folds)))

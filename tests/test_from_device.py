@@ -1,0 +1,86 @@
+#
+# DataFrame.from_device: jax-native ingest — fits consume a device-resident
+# (optionally mesh-sharded) feature array directly, skipping host
+# materialization and upload (the TPU analog of the reference riding
+# spark-rapids' GPU-resident columnar cache).  Fits must match the
+# host-ingest path bit-for-bit-ish on the same data.
+#
+import numpy as np
+import pytest
+
+import jax
+
+from spark_rapids_ml_tpu import (
+    KMeans,
+    LinearRegression,
+    PCA,
+    RandomForestRegressor,
+)
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.parallel.mesh import data_sharding, get_mesh
+
+
+def _device_df(X, y=None, mesh=None):
+    mesh = mesh or get_mesh(None)
+    n_dev = mesh.devices.size
+    n_pad = X.shape[0] + (-X.shape[0]) % n_dev
+    Xp = np.zeros((n_pad, X.shape[1]), X.dtype)
+    Xp[: X.shape[0]] = X
+    Xs = jax.device_put(Xp, data_sharding(mesh))
+    return DataFrame.from_device(Xs, y=y, n_rows=X.shape[0])
+
+
+def _data(n=500, d=12, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d) + 0.1 * rng.standard_normal(n)).astype(
+        np.float32
+    )
+    return X, y
+
+
+def test_kmeans_from_device_matches_host():
+    X, _ = _data()
+    a = KMeans(k=3, maxIter=12, seed=7).fit(_device_df(X))
+    b = KMeans(k=3, maxIter=12, seed=7).fit(DataFrame.from_numpy(X))
+    np.testing.assert_allclose(
+        np.asarray(a.cluster_centers_), np.asarray(b.cluster_centers_),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_pca_from_device_matches_host():
+    X, _ = _data(n=640)
+    a = PCA(k=3).fit(_device_df(X))
+    b = PCA(k=3).fit(DataFrame.from_numpy(X))
+    np.testing.assert_allclose(
+        np.asarray(a.components_), np.asarray(b.components_),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_linreg_from_device_matches_host_with_padding():
+    X, y = _data(n=501)  # pads on the 8-device mesh
+    a = LinearRegression(maxIter=20).fit(_device_df(X, y))
+    b = LinearRegression(maxIter=20).fit(DataFrame.from_numpy(X, y))
+    np.testing.assert_allclose(
+        np.asarray(a.coef_), np.asarray(b.coef_), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_rf_from_device_trains():
+    X, y = _data(n=400, d=10)
+    model = RandomForestRegressor(
+        numTrees=6, maxDepth=4, maxBins=16, seed=2
+    ).fit(_device_df(X, y))
+    preds = model.transform(DataFrame.from_numpy(X)).toPandas()["prediction"]
+    resid = np.asarray(preds, np.float64) - y
+    assert float(np.sqrt((resid**2).mean())) < 0.8 * float(y.std())
+
+
+def test_from_device_transform_raises():
+    X, _ = _data(n=64)
+    df = _device_df(X)
+    model = KMeans(k=2, maxIter=5, seed=1).fit(df)
+    with pytest.raises(NotImplementedError, match="fit-input only"):
+        model.transform(df)

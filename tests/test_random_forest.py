@@ -281,3 +281,47 @@ def test_wide_level_kernel_feature_subset_and_chunking():
     assert bf.shape == (n_nodes,) and np.all((bf >= 0) & (bf < D))
     assert np.all((bb >= 0) & (bb < B))
     np.testing.assert_allclose(cnt.sum(), N)
+
+
+def test_mxu_route_wiring_feature_major(monkeypatch):
+    """The MXU route is TPU-gated, so a broken symbol/shape in its wiring
+    would merge green on the CPU suite (round-4 regression: the lazily
+    bound feature-major binner raised NameError only on hardware).  Force
+    the route and verify _maybe_grow_mxu receives the (D, n_pad) int8
+    feature-major bins and its result flows into the model."""
+    import numpy as np
+
+    import spark_rapids_ml_tpu.models.random_forest as rfm
+    from spark_rapids_ml_tpu import RandomForestRegressor
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+    from spark_rapids_ml_tpu.ops.forest_hist import _ROW_TILE
+
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((300, 7)).astype(np.float32)
+    y = (X @ np.ones(7, np.float32)).astype(np.float32)
+    seen = {}
+
+    monkeypatch.setattr(
+        rfm, "_mxu_eligible", lambda *a, **kw: True
+    )
+
+    def _fake_mxu(inputs, bins_fm, edges, stats, n_trees, *a, **kw):
+        seen["shape"] = tuple(bins_fm.shape)
+        seen["dtype"] = str(bins_fm.dtype)
+        depth = kw["max_depth"]
+        m = 2 ** (depth + 1) - 1
+        return (
+            np.full((n_trees, m), -1, np.int32),
+            np.zeros((n_trees, m), np.float32),
+            np.zeros((n_trees, m, 1), np.float32),
+            np.zeros((n_trees, m), np.float32),
+            np.zeros((n_trees, m), np.float32),
+        )
+
+    monkeypatch.setattr(rfm, "_maybe_grow_mxu", _fake_mxu)
+    model = RandomForestRegressor(numTrees=3, maxDepth=3, maxBins=8).fit(
+        DataFrame.from_numpy(X, y)
+    )
+    n_pad = -(-X.shape[0] // _ROW_TILE) * _ROW_TILE
+    assert seen["shape"] == (7, n_pad) and seen["dtype"] == "int8"
+    assert model.getNumTrees == 3

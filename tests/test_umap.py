@@ -134,8 +134,12 @@ def test_umap_supervised():
     # labelCol set -> supervised fit (reference umap.py:722-724, 939-947):
     # the label intersection must tighten class clusters vs unsupervised
     X, labels = _blob_data(n=240, d=8, k=3, seed=7)
-    # make the blobs overlap so labels carry real extra information
-    X += np.random.default_rng(1).normal(scale=4.0, size=X.shape)
+    # drown the blob geometry so labels carry information the features
+    # barely do: at scale 4 the unsupervised embedding already separates the
+    # classes near-perfectly and the comparison is a coin flip; at scale 8
+    # unsupervised clearly fails (sep ~3.6) while the supervised
+    # intersection recovers the classes (sep ~9.3)
+    X += np.random.default_rng(1).normal(scale=8.0, size=X.shape)
     df = DataFrame.from_numpy(X, y=labels.astype(np.float64), num_partitions=2)
 
     def sep_score(emb):
@@ -151,7 +155,7 @@ def test_umap_supervised():
     sup = UMAP(n_neighbors=10, random_state=0, n_epochs=150).setLabelCol("label").fit(df)
     unsup = UMAP(n_neighbors=10, random_state=0, n_epochs=150).fit(df)
     assert sup.embedding.shape == (240, 2)
-    assert sep_score(sup.embedding) > sep_score(unsup.embedding), (
+    assert sep_score(sup.embedding) > 1.5 * sep_score(unsup.embedding), (
         sep_score(sup.embedding),
         sep_score(unsup.embedding),
     )
