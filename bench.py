@@ -323,11 +323,14 @@ def build_arm(algo: str, overrides):
         from spark_rapids_ml_tpu.core import extract_partition_features
         from spark_rapids_ml_tpu.ops.knn import PreparedItems
 
+        # zeros, NOT np.empty: uninitialized NaN pages fail the zero-copy
+        # block guard's row equality (NaN != NaN) and would silently defeat
+        # the seeded staging caches, re-uploading garbage inside the clock
         item_df = DataFrame.from_numpy(
-            np.empty((rows, cols), np.float32), num_partitions=1
+            np.zeros((rows, cols), np.float32), num_partitions=1
         )
         query_df = DataFrame.from_numpy(
-            np.empty((n_query, cols), np.float32), num_partitions=1
+            np.zeros((n_query, cols), np.float32), num_partitions=1
         )
         est = NearestNeighbors(k=k)
         model = est.fit(item_df)

@@ -83,6 +83,17 @@ class NearestNeighbors(_NearestNeighborsParams, _TpuEstimatorSupervised):
     def _fit(self, dataset: Any) -> "NearestNeighborsModel":
         from ..core import _use_executor_path
 
+        if getattr(dataset, "_device_features", None) is not None:
+            # fitting would silently DROP the device array (the captured
+            # frame only carries the placeholder column) and kneighbors
+            # would later read placeholder garbage; device-resident item
+            # sets enter through NearestNeighborsModel.seed_staging instead
+            raise NotImplementedError(
+                "NearestNeighbors.fit does not take DataFrame.from_device "
+                "frames (their features column is a placeholder); fit a "
+                "host frame and install the device-resident index with "
+                "model.seed_staging(...)"
+            )
         if _use_executor_path(dataset):
             # live pyspark input: hold the DataFrame itself — item partitions
             # stay on the executors until kneighbors runs its barrier stage
@@ -299,7 +310,19 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         key = self._staging_key(mesh, rows, dim)
         if self._staged_items is None or self._staged_items[0] != key:
             blocks = list(self._iter_item_blocks(id_col, dtype, mesh))
-            assert len(blocks) == 1  # by the in-core bound above
+            if len(blocks) != 1:
+                # the packer's n_dev-rounded per-block row bound can split
+                # right at the HBM-budget boundary even though the estimate
+                # above said in-core — degrade to the streaming path
+                # (uncached) instead of asserting
+                self._staged_items = None
+                return knn_search_streamed(
+                    iter(blocks),
+                    query_feats,
+                    [len(p) for p in q_parts],
+                    k,
+                    mesh,
+                )
             self._staged_items = (key, blocks[0])
             self._staged_queries.clear()
         prepared = self._staged_items[1]

@@ -198,10 +198,21 @@ class UMAP(_UMAPParams, _TpuEstimator):
             else:
                 # query_block 32768: the graph build is a self-join of many
                 # small-k blocks whose per-block host round-trips (through
-                # the tunneled device) dominate — 2 blocks at 50k beats 7
+                # the tunneled device) dominate — 2 blocks at 50k beats 7.
+                # When no row was filtered (no padding, no sampling) the
+                # search consumes the DEVICE-resident FitInputs.X directly
+                # instead of round-tripping it through the host link.
+                import jax as _jax
+
+                search_X: Any = X
+                if (
+                    isinstance(inputs.X, _jax.Array)
+                    and X.shape[0] == inputs.X.shape[0]
+                ):
+                    search_X = inputs.X
                 dists, ids = knn_search(
-                    X, np.arange(n, dtype=np.int64), X, k, mesh,
-                    query_block=32768,
+                    search_X, np.arange(n, dtype=np.int64), search_X, k,
+                    mesh, query_block=32768,
                 )
             a, b = params.get("a"), params.get("b")
             if a is None or b is None:

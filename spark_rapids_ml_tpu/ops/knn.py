@@ -488,6 +488,35 @@ def _adaptive_count(items, item_norm, valid, queries, thresh, mesh, chunk):
     )(items, item_norm, valid, queries, thresh)
 
 
+def _adaptive_pallas_phases(items, item_norm, valid, qd, k, m, n_items):
+    """candidates -> merge -> count on the pallas kernels — the ONE
+    definition of the pallas-route phase sequence, dispatched either as
+    three separate jits or fused under one (below)."""
+    from .pallas_knn import knn_candidates_pallas, knn_count_pallas
+
+    cv, ci = knn_candidates_pallas(items, item_norm, valid, qd, k, m, n_items)
+    fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
+    sa = knn_count_pallas(items, item_norm, valid, qd, tu, n_items)
+    return fv, fpos, sg, sa
+
+
+# Single-dispatch variant: candidates -> merge -> count as ONE jit.  Worth
+# it only in the LATENCY-BOUND regime (small item sets like UMAP's 50k
+# self-join, where per-block dispatch and scheduling overheads through the
+# tunneled device dominate — hardware A/B: 5.4 s -> 4.7 s per UMAP fit).
+# In the compute-bound regime the fused program SCHEDULES WORSE than the
+# three separate jits (400k x 3000 block: 2.2 s -> 3.0 s), so the
+# dispatcher gates on item-set size.
+_adaptive_dispatch_fused = partial(
+    jax.jit, static_argnames=("k", "m", "n_items")
+)(_adaptive_pallas_phases)
+
+
+# fused-dispatch bound: item cells (rows x cols) below this are latency-
+# bound (see _adaptive_dispatch_fused)
+_FUSED_DISPATCH_CELLS = 64 << 20
+
+
 def knn_block_adaptive_dispatch(
     items, item_norm, item_pos, valid, qd, mesh, k,
     chunk: int = _ADAPTIVE_CHUNK,
@@ -504,36 +533,30 @@ def knn_block_adaptive_dispatch(
     the VMEM-resident distance tile instead of re-reading it from HBM m
     times.  The merge / count-verify / exact-fallback phases are identical
     either way, so the exactness contract does not depend on the route."""
-    from .pallas_knn import (
-        knn_candidates_pallas,
-        knn_count_pallas,
-        pallas_knn_eligible,
-    )
+    from .pallas_knn import pallas_knn_eligible
 
     n_pad = items.shape[0]
-    used_pallas = False
     if pallas_knn_eligible(
         mesh.shape[DATA_AXIS], items.shape[1], qd.shape[0]
     ):
         m = _select_m(k, 1024, n_pad)
         if m <= _ADAPTIVE_MAX_M:
-            cv, ci = knn_candidates_pallas(
-                items, item_norm, valid, qd, k, m, n_pad
+            # the pallas route counts with the SAME kernel family: d2
+            # values bitwise-match the candidate scan, so verification
+            # failures are only true overflow misses (measured: XLA count
+            # vs pallas candidates disagreed on ~3% of rows from scan
+            # rounding alone, each a wasted exact rerun)
+            run = (
+                _adaptive_dispatch_fused
+                if n_pad * items.shape[1] <= _FUSED_DISPATCH_CELLS
+                else _adaptive_pallas_phases
             )
-            used_pallas = True
-    if not used_pallas:
-        cv, ci = _adaptive_candidates(
-            items, item_norm, item_pos, valid, qd, mesh, k, chunk
-        )
+            return run(items, item_norm, valid, qd, k=k, m=m, n_items=n_pad)
+    cv, ci = _adaptive_candidates(
+        items, item_norm, item_pos, valid, qd, mesh, k, chunk
+    )
     fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
-    if used_pallas:
-        # count with the SAME kernel family: d2 values bitwise-match the
-        # candidate scan, so verification failures are only true overflow
-        # misses (measured: XLA count vs pallas candidates disagreed on ~3%
-        # of rows from scan rounding alone, each a wasted exact rerun)
-        sa = knn_count_pallas(items, item_norm, valid, qd, tu, n_pad)
-    else:
-        sa = _adaptive_count(items, item_norm, valid, qd, tu, mesh, chunk)
+    sa = _adaptive_count(items, item_norm, valid, qd, tu, mesh, chunk)
     return fv, fpos, sg, sa
 
 
@@ -609,7 +632,7 @@ class PreparedItems:
 
 
 def prepare_items(
-    items: np.ndarray,
+    items,
     item_ids: np.ndarray,
     mesh: Mesh,
     dtype=np.float32,
@@ -618,6 +641,27 @@ def prepare_items(
     from ..utils import pad_rows
 
     n_dev = mesh.shape[DATA_AXIS]
+    if isinstance(items, jax.Array) and n_dev == 1:
+        # already device-resident (jax-native pipelines, UMAP's fit on its
+        # own FitInputs): shuffle by a device gather instead of fetching +
+        # re-uploading the whole set through the host link
+        n_items = items.shape[0]
+        if items.dtype != dtype:
+            items = items.astype(dtype)
+        if shuffle and n_items > 1:
+            perm = np.random.default_rng(0x5EED).permutation(n_items)
+            items = jnp.take(items, jnp.asarray(perm), axis=0)
+            item_ids = np.asarray(item_ids)[perm]
+        ids_pad = np.asarray(item_ids, np.int64)
+        norm = jax.jit(lambda x: jnp.einsum("nd,nd->n", x, x))(items)
+        return PreparedItems(
+            items,
+            norm,
+            jnp.arange(n_items, dtype=jnp.int32),
+            jnp.ones((n_items,), bool),
+            ids_pad,
+            n_items,
+        )
     items = np.asarray(items, dtype=dtype)
     n_items = items.shape[0]
     if shuffle and n_items > 1:
@@ -701,8 +745,11 @@ def knn_search(
     """Host orchestration: shard items once, stream query blocks through the
     jitted kernel (block sizes are power-of-two buckets so the number of
     compiled shapes is bounded; partial blocks padded).  Item sets too large
-    for HBM take the out-of-core route (knn_search_out_of_core)."""
-    items = np.asarray(items, dtype=dtype)
+    for HBM take the out-of-core route (knn_search_out_of_core).  Items and
+    queries may be jax arrays already on device — they stay there
+    (prepare_items / knn_search_prepared device paths)."""
+    if not isinstance(items, jax.Array):
+        items = np.asarray(items, dtype=dtype)
     n_dev = mesh.shape[DATA_AXIS]
     # items are row-sharded, so the per-replica residency is nbytes / n_dev
     if items.nbytes > _hbm_budget_bytes() * n_dev:

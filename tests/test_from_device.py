@@ -84,3 +84,12 @@ def test_from_device_transform_raises():
     model = KMeans(k=2, maxIter=5, seed=1).fit(df)
     with pytest.raises(NotImplementedError, match="fit-input only"):
         model.transform(df)
+
+
+def test_from_device_knn_fit_raises():
+    X, _ = _data(n=64)
+    df = _device_df(X)
+    from spark_rapids_ml_tpu import NearestNeighbors
+
+    with pytest.raises(NotImplementedError, match="seed_staging"):
+        NearestNeighbors(k=3).fit(df)
