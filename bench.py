@@ -321,7 +321,7 @@ def build_arm(algo: str, overrides):
         _sync(Q_dev.sum())
 
         from spark_rapids_ml_tpu.core import extract_partition_features
-        from spark_rapids_ml_tpu.ops.knn import PreparedItems
+        from spark_rapids_ml_tpu.ops.knn import prepare_items
 
         # zeros, NOT np.empty: uninitialized NaN pages fail the zero-copy
         # block guard's row equality (NaN != NaN) and would silently defeat
@@ -334,16 +334,12 @@ def build_arm(algo: str, overrides):
         )
         est = NearestNeighbors(k=k)
         model = est.fit(item_df)
-        # seed the staging caches with the device-resident index/queries
-        prepared = PreparedItems(
-            items_dev,
-            norm_dev,
-            jax.device_put(
-                np.arange(n_pad, dtype=np.int32), data_sharding(mesh)
-            ),
-            jax.device_put(np.arange(n_pad) < rows, data_sharding(mesh)),
-            np.r_[np.arange(rows, dtype=np.int64), np.full(n_pad - rows, -1)],
-            rows,
+        # stage the device-resident index through prepare_items: the device
+        # path tile-aligns it once, so the fused kernels never re-pad
+        # (shuffle off — the data is i.i.d.-generated)
+        prepared = prepare_items(
+            items_dev[:rows], np.arange(rows, dtype=np.int64), mesh,
+            shuffle=False,
         )
         q_block = extract_partition_features(
             query_df.partitions[0], "features", None, np.float32

@@ -411,7 +411,10 @@ class _TpuCaller(_TpuParams):
         """FitInputs straight from a DataFrame.from_device feature array:
         no feature extraction, no upload.  Labels/weights still come from
         the (host) partitions; padded rows are masked through the weight
-        vector exactly like the host-ingest path."""
+        vector exactly like the host-ingest path.  The built inputs are
+        cached ON THE FRAME (keyed by the consuming label/weight columns),
+        so repeated fits skip the per-fit label/mask device_puts the way
+        the host path's input cache does."""
         Xs, n_rows, n_cols, _fcol = dev
         dtype = np.dtype(Xs.dtype)
         mesh = get_mesh(self.num_workers)
@@ -422,6 +425,10 @@ class _TpuCaller(_TpuParams):
             if self.hasParam("weightCol") and self.isSet("weightCol")
             else None
         )
+        cache_key = (label_col, weight_col, id(mesh), bool(keep_row_id))
+        cached = getattr(df, "_device_fit_inputs", None)
+        if cached is not None and cached[0] == cache_key:
+            return cached[1]
         w_np = np.ones(n_rows, dtype=dtype)
         if weight_col is not None:
             w_np = np.concatenate(
@@ -444,7 +451,7 @@ class _TpuCaller(_TpuParams):
             y_pad = np.zeros(n_pad, dtype=dtype)
             y_pad[:n_rows] = y_np
             ys = jax.device_put(y_pad, data_sharding(mesh))
-        return FitInputs(
+        inputs = FitInputs(
             X=Xs,
             weight=ws,
             y=ys,
@@ -455,6 +462,8 @@ class _TpuCaller(_TpuParams):
             dtype=dtype,
             row_id=np.arange(n_rows) if keep_row_id else None,
         )
+        df._device_fit_inputs = (cache_key, inputs)
+        return inputs
 
     def _call_tpu_fit_func(
         self,

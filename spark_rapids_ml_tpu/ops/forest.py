@@ -55,7 +55,16 @@ def compute_bin_edges(X: np.ndarray, n_bins: int, max_sample: int = 100_000, see
     else:
         sample = X
     qs = np.linspace(0, 1, n_bins + 1)[1:-1]
-    edges = np.quantile(sample, qs, axis=0).T.astype(np.float32)  # (D, B-1)
+    # one explicit sort + linear interpolation (the np.quantile formula):
+    # np.quantile re-partitions per quantile vector internally and took
+    # 1.4 s on the benchmark's (2778, 3000) sample where the sort form
+    # runs in ~0.15 s — this sits inside every RandomForest fit
+    s = np.sort(np.asarray(sample, dtype=np.float64), axis=0)
+    pos = qs * (s.shape[0] - 1)
+    lo = np.floor(pos).astype(np.int64)
+    hi = np.ceil(pos).astype(np.int64)
+    frac = (pos - lo)[:, None]
+    edges = (s[lo] * (1.0 - frac) + s[hi] * frac).T.astype(np.float32)
     # strictly increasing edges make searchsorted/thresholds deterministic
     return edges
 
@@ -111,6 +120,21 @@ def bin_features_feature_major(
     the axon backend.  Requires n_bins <= 128 (int8).  Trailing columns up
     to `n_pad` are zero bins (callers mask padded rows through weights)."""
     n, d = X.shape
+    from .pallas_tpu import bin_features_fm_pallas, pallas_enabled
+
+    single_device = not (
+        isinstance(X, jax.Array) and len(X.sharding.device_set) > 1
+    )
+    if pallas_enabled() and edges.shape[1] <= 127 and single_device:
+        # fused VMEM-resident binning: one HBM read of X instead of one per
+        # edge (2.9 s -> ~0.2 s at the 400k x 3000 128-bin benchmark
+        # shape).  Multi-device operands keep the XLA path: jit-of-pallas
+        # under a multi-device NamedSharding lowers through the
+        # partitioner, the failure mode documented at
+        # bin_features_fm_pallas
+        return bin_features_fm_pallas(
+            jnp.asarray(X), jnp.asarray(edges), n_pad if n_pad else n
+        )
     chunk = min(chunk, n)
     parts = []
     for i in range(0, n, chunk):

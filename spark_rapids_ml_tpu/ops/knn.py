@@ -120,7 +120,13 @@ def knn_block_kernel(
     the host (user ids can be int64, which jax would silently truncate to
     int32 — see PreparedItems.ids).  ||item||^2 is iteration-invariant, so
     it is computed once at prepare time instead of once per query block (a
-    full HBM sweep over the item shard per block otherwise)."""
+    full HBM sweep over the item shard per block otherwise).  Queries
+    narrower than the (possibly tile-aligned) item columns are zero-padded
+    to match — zero columns on both matmul operands are exact no-ops."""
+    if queries.shape[1] != items.shape[1]:
+        queries = jnp.pad(
+            queries, ((0, 0), (0, items.shape[1] - queries.shape[1]))
+        )
 
     # Per-device item-CHUNKED evaluation: the (Q, chunk) distance tile is the
     # only big intermediate — a lax.scan over item chunks with a running
@@ -535,6 +541,10 @@ def knn_block_adaptive_dispatch(
     either way, so the exactness contract does not depend on the route."""
     from .pallas_knn import pallas_knn_eligible
 
+    if qd.shape[1] != items.shape[1]:
+        # tile-aligned item columns (prepare_items): zero-pad the query
+        # side to match — exact no-op columns on both matmul operands
+        qd = jnp.pad(qd, ((0, 0), (0, items.shape[1] - qd.shape[1])))
     n_pad = items.shape[0]
     if pallas_knn_eligible(
         mesh.shape[DATA_AXIS], items.shape[1], qd.shape[0]
@@ -638,13 +648,28 @@ def prepare_items(
     dtype=np.float32,
     shuffle: bool = True,
 ) -> PreparedItems:
-    from ..utils import pad_rows
-
     n_dev = mesh.shape[DATA_AXIS]
+    # Tile-align item sets the fused pallas kernels will serve AT PREPARE
+    # TIME: their block reads must stay in-bounds (an OOB DMA can wedge
+    # the device — pallas_knn._aligned_items), and aligning the invariant
+    # array once here makes the per-dispatch alignment a no-op instead of
+    # a multi-GB pad copy per query block.
+    from .pallas_knn import pallas_align_dims
+
+    d_items = items.shape[1]
+    align = pallas_align_dims(items.shape[0], d_items, n_dev)
+    row_mult, d_target = align if align else (n_dev, d_items)
     if isinstance(items, jax.Array) and n_dev == 1:
         # already device-resident (jax-native pipelines, UMAP's fit on its
         # own FitInputs): shuffle by a device gather instead of fetching +
-        # re-uploading the whole set through the host link
+        # re-uploading the whole set through the host link.  A mesh
+        # sharding (even over one device) is re-committed to the plain
+        # single-device sharding first — eager ops keep NamedSharding on
+        # their outputs, and jit-of-pallas under a NamedSharding operand
+        # lowers through the partitioner (OOMs at multi-GB shapes).
+        if hasattr(items.sharding, "mesh"):
+            (dev,) = items.sharding.device_set
+            items = jax.device_put(items, dev)
         n_items = items.shape[0]
         if items.dtype != dtype:
             items = items.astype(dtype)
@@ -652,13 +677,21 @@ def prepare_items(
             perm = np.random.default_rng(0x5EED).permutation(n_items)
             items = jnp.take(items, jnp.asarray(perm), axis=0)
             item_ids = np.asarray(item_ids)[perm]
-        ids_pad = np.asarray(item_ids, np.int64)
+        n_al = -(-n_items // row_mult) * row_mult
+        if (n_al, d_target) != items.shape:
+            items = jnp.pad(
+                items, ((0, n_al - n_items), (0, d_target - d_items))
+            )
+        ids_pad = np.full(n_al, -1, np.int64)
+        ids_pad[:n_items] = np.asarray(item_ids, np.int64)
+        valid = np.zeros(n_al, bool)
+        valid[:n_items] = True
         norm = jax.jit(lambda x: jnp.einsum("nd,nd->n", x, x))(items)
         return PreparedItems(
             items,
             norm,
-            jnp.arange(n_items, dtype=jnp.int32),
-            jnp.ones((n_items,), bool),
+            jnp.arange(n_al, dtype=jnp.int32),
+            jnp.asarray(valid),
             ids_pad,
             n_items,
         )
@@ -673,7 +706,12 @@ def prepare_items(
         perm = np.random.default_rng(0x5EED).permutation(n_items)
         items = items[perm]
         item_ids = np.asarray(item_ids)[perm]
-    items_pad = pad_rows(items, n_dev)
+    n_al = -(-n_items // row_mult) * row_mult
+    items_pad = (
+        items
+        if (n_al, d_target) == items.shape
+        else np.pad(items, ((0, n_al - n_items), (0, d_target - d_items)))
+    )
     n_pad = items_pad.shape[0]
     ids_pad = np.full(n_pad, -1, np.int64)
     ids_pad[:n_items] = item_ids

@@ -52,6 +52,58 @@ _TILE_I = 1024
 _TILE_D = 512
 
 
+# minimum item rows for the adaptive/pallas path (ops/knn._ADAPTIVE_MIN_LOCAL;
+# duplicated here to keep the import DAG acyclic)
+_MIN_ALIGN_ROWS = 1 << 15
+
+
+def pallas_align_dims(n_rows: int, d: int, n_dev: int):
+    """(row_multiple, col_target) that prepare_items should pad item sets
+    to so the fused kernels' block reads are in-bounds WITHOUT a per-call
+    pad copy (review finding: _aligned_items re-padded the multi-GB
+    invariant item array on every dispatch).  None when the pallas path
+    cannot serve the shape anyway — small sets, d < 128, or shapes whose
+    column alignment would waste >25% HBM (those keep the scan path, see
+    pallas_knn_eligible)."""
+    if not pallas_enabled() or n_rows < _MIN_ALIGN_ROWS or d < 128:
+        return None
+    d_al = _col_target(d)
+    if d_al * 4 > d * 5:
+        return None
+    row_mult = int(np.lcm(n_dev, _TILE_I))
+    return row_mult, d_al
+
+
+def _col_target(d: int) -> int:
+    from .pallas_tpu import _round_up
+
+    d_pad = _round_up(d, 128)
+    kb = min(_TILE_D, d_pad)
+    return _round_up(d, kb)
+
+
+def _aligned_items(items: jax.Array, inorm: jax.Array, kb: int):
+    """Pad the item array/norms to (TILE_I, kb) multiples so every block
+    read is IN BOUNDS.  Out-of-bounds block DMA past an array's HBM extent
+    is not a safe pad-with-garbage on real hardware: a ~17 MB overread left
+    the device in a FAILED_PRECONDITION state (see bin_features_fm_pallas —
+    same hazard, same fix).  The pad is one HBM copy (~12 ms at 400k x
+    3000) and a no-op when already aligned; padded rows carry +inf norms so
+    they can never enter a top-m list, padded columns are zeros on both
+    operands of the dot."""
+    from .pallas_tpu import _round_up as _ru
+
+    n_pad, d = items.shape
+    n_al = _ru(n_pad, _TILE_I)
+    d_al = _ru(d, kb)
+    if (n_al, d_al) != (n_pad, d):
+        items = jnp.pad(items, ((0, n_al - n_pad), (0, d_al - d)))
+        inorm = jnp.pad(
+            inorm, (0, n_al - n_pad), constant_values=jnp.inf
+        )
+    return items, inorm, n_al // _TILE_I
+
+
 def _accum_dot(q_ref, it_ref, acc, kb, d_true: int, kd: int) -> None:
     """Shared partial-dot accumulation for the candidate and count kernels.
     MUST stay byte-for-byte identical between them: the count verification
@@ -184,31 +236,24 @@ def knn_candidates_pallas(
     kb = min(_TILE_D, d_pad)
     d_blk = _round_up(d_pad, kb)
     q_pad = _round_up(Q, tq)
-    n_pad = items.shape[0]
-    ng = -(-n_pad // _TILE_I)
     m_pad = _round_up(m, 8)
 
-    # only the (small) query side is physically padded; the item array's
-    # ragged D tail and ragged last group are handled by in-kernel masking —
-    # padding the item side would copy GBs through HBM per call
     qp = jnp.pad(
         queries.astype(jnp.float32), ((0, q_pad - Q), (0, d_blk - d))
     )
     qn = (qp * qp).sum(axis=1, keepdims=True)  # (q_pad, 1), zeros rows safe
     # invalid (padding) rows get +inf norms so their d2 is inf — they can
     # never enter a top-m list
-    inorm = (
-        jnp.where(valid, item_norm, jnp.inf)
-        .reshape(1, n_pad)
-        .astype(jnp.float32)
-    )
+    inorm = jnp.where(valid, item_norm, jnp.inf).astype(jnp.float32)
+    items, inorm, ng = _aligned_items(items, inorm, kb)
+    inorm = inorm.reshape(1, -1)
 
     grid = (q_pad // tq, ng, d_blk // kb)
     vals, idxs = pl.pallas_call(
         functools.partial(
             _knn_topm_kernel,
             m=m, m_pad=m_pad, n_items=n_items, tile_i=_TILE_I,
-            d_true=d, kd=kb,
+            d_true=d_blk, kd=kb,
         ),
         grid=grid,
         in_specs=[
@@ -264,18 +309,14 @@ def knn_count_pallas(
     kb = min(_TILE_D, d_pad)
     d_blk = _round_up(d_pad, kb)
     q_pad = _round_up(Q, tq)
-    n_pad = items.shape[0]
-    ng = -(-n_pad // _TILE_I)
 
     qp = jnp.pad(
         queries.astype(jnp.float32), ((0, q_pad - Q), (0, d_blk - d))
     )
     qn = (qp * qp).sum(axis=1, keepdims=True)
-    inorm = (
-        jnp.where(valid, item_norm, jnp.inf)
-        .reshape(1, n_pad)
-        .astype(jnp.float32)
-    )
+    inorm = jnp.where(valid, item_norm, jnp.inf).astype(jnp.float32)
+    items, inorm, ng = _aligned_items(items, inorm, kb)
+    inorm = inorm.reshape(1, -1)
     # padded query rows: -inf threshold would count everything; +inf counts
     # nothing (they are sliced off anyway, this just keeps sums small)
     tp = jnp.pad(
@@ -286,7 +327,7 @@ def knn_count_pallas(
     counts = pl.pallas_call(
         functools.partial(
             _knn_count_kernel,
-            n_items=n_items, tile_i=_TILE_I, d_true=d, kd=kb,
+            n_items=n_items, tile_i=_TILE_I, d_true=d_blk, kd=kb,
         ),
         grid=grid,
         in_specs=[
@@ -309,5 +350,13 @@ def knn_count_pallas(
 def pallas_knn_eligible(mesh_shards: int, d: int, q: int) -> bool:
     """The fused kernel serves the single-shard TPU fast path (the only
     configuration this chip can run; multi-shard meshes keep the shard_map
-    scan).  Queries narrower than one lane tile would pad 2x+."""
-    return pallas_enabled() and mesh_shards == 1 and q >= 128 and d >= 128
+    scan).  Queries narrower than one lane tile would pad 2x+, and shapes
+    whose column alignment wastes >25% HBM keep the scan path (their item
+    padding would otherwise be re-paid per dispatch)."""
+    return (
+        pallas_enabled()
+        and mesh_shards == 1
+        and q >= 128
+        and d >= 128
+        and _col_target(d) * 4 <= d * 5
+    )

@@ -202,6 +202,127 @@ def _min_dist_argmin_xla(
     return jnp.min(d2, axis=1), jnp.argmin(d2, axis=1).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Fused feature binning: (N, D) f32 + (D, B-1) edges -> (D, N) int8 bins.
+#
+# The XLA compare-accumulate (ops/forest.bin_features_feature_major) re-reads
+# each X chunk from HBM once per edge — 127 x 4.8 GB ~ 700 GB of HBM traffic
+# (2.9 s) at the 400k x 3000 128-bin benchmark shape.  Here each (TN, TD)
+# X tile is read into VMEM ONCE and all B-1 compares run on the resident
+# tile: HBM traffic drops to X + edges + the int8 output (~6 GB).
+# ---------------------------------------------------------------------------
+
+_BIN_TILE_N = 512
+_BIN_TILE_D = 512
+
+
+def _bin_kernel(x_ref, e_ref, out_ref, *, n_edges: int, n_true: int, tile_n: int):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)  # row-tile index (minor grid dim)
+    xt = x_ref[:].T  # (TD, TN) — transpose once in VMEM
+    # accumulate in int32 (Mosaic vector adds support i16/i32 only) and
+    # cast to int8 at the single output store
+    acc = jnp.zeros(xt.shape, jnp.int32)
+    for b in range(n_edges):
+        acc += (xt > e_ref[:, b][:, None]).astype(jnp.int32)
+    # rows past the true count carry garbage X (OOB block reads): force
+    # bin 0 so padded rows look like the zero-padding the XLA path emits
+    col = i * tile_n + jax.lax.broadcasted_iota(jnp.int32, xt.shape, 1)
+    out_ref[:] = jnp.where(col < n_true, acc, 0).astype(jnp.int8)
+
+
+def bin_features_fm_pallas(
+    X: jax.Array,          # (N, D) f32
+    edges: jax.Array,      # (D, B-1) f32, B-1 <= 127
+    n_pad: int,            # output row padding target (>= N)
+    interpret: bool = False,
+) -> jax.Array:
+    """(D, n_pad) int8 feature-major bins — pallas drop-in for
+    ops/forest.bin_features_feature_major on TPU.
+
+    Mesh-sharded inputs (NamedSharding, even over ONE device — what
+    DataFrame.from_device / core ingest produce) are re-committed to the
+    plain single-device sharding first: jit-of-pallas under a NamedSharding
+    operand lowers through the partitioner, which at the 400k x 3000
+    benchmark shape exhausted HBM / left the device in a failed state.
+    Same-device re-commit is copy-free."""
+    if (
+        isinstance(X, jax.Array)
+        and not interpret
+        and hasattr(X.sharding, "mesh")
+        and len(X.sharding.device_set) == 1
+    ):
+        (dev,) = X.sharding.device_set
+        X = jax.device_put(X, dev)
+    return _bin_features_fm_pallas(X, edges, n_pad, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("n_pad", "interpret"))
+def _bin_features_fm_pallas(
+    X: jax.Array,
+    edges: jax.Array,
+    n_pad: int,
+    interpret: bool = False,
+) -> jax.Array:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, d = X.shape
+    n_edges = edges.shape[1]
+    tn, td = _BIN_TILE_N, _BIN_TILE_D
+    grid = (pl.cdiv(d, td), pl.cdiv(n_pad, tn))
+    # NO out-of-bounds block reads: OOB DMA past an input's HBM extent is
+    # not a safe pad-with-garbage on real hardware — a ~17 MB overread (the
+    # RF row-tile padding target) left the device in a failed state where
+    # a ~5 MB one happened to survive.  Pad X/edges to tile multiples (one
+    # ~12 ms HBM copy of X) and clamp row-block indices past the X extent
+    # (those tiles are pure padding output; the kernel masks them to 0).
+    n_x = _round_up(n, tn)
+    d_x = _round_up(d, td)
+    Xp = (
+        X
+        if (n_x, d_x) == X.shape
+        else jnp.pad(X, ((0, n_x - n), (0, d_x - d)))
+    )
+    max_row_blk = n_x // tn - 1
+    # lane-pad the edge block; padded edge slots hold +inf so they never
+    # count ((x > inf) == 0), keeping the compare loop branch-free
+    e_pad = jnp.pad(
+        edges.astype(jnp.float32),
+        (
+            (0, d_x - edges.shape[0]),
+            (0, _round_up(max(n_edges, 1), 128) - n_edges),
+        ),
+        constant_values=jnp.inf,
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _bin_kernel, n_edges=n_edges, n_true=n, tile_n=tn
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (tn, td),
+                lambda j, i: (jnp.minimum(i, max_row_blk), j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (td, e_pad.shape[1]), lambda j, i: (j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (td, tn), lambda j, i: (j, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (d_x, _round_up(n_pad, tn)), jnp.int8
+        ),
+        interpret=interpret,
+    )(Xp, e_pad)
+    return out[:d, :n_pad]
+
+
 def min_dist_argmin(
     X: jax.Array,
     centers: jax.Array,

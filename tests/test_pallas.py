@@ -194,3 +194,33 @@ def test_knn_candidates_duplicate_distances_stay_distinct():
     assert np.allclose(dists[:, 0], 0, atol=5e-2)
     assert np.allclose(dists[:, 1], 0, atol=5e-2)
     assert (pos[:, 0] % (n // 2) == pos[:, 1] % (n // 2)).all()
+
+
+# -- fused feature binning kernel (ops/pallas_tpu.bin_features_fm_pallas) ----
+
+from spark_rapids_ml_tpu.ops.pallas_tpu import bin_features_fm_pallas
+
+
+@pytest.mark.parametrize(
+    "n,d,b,n_pad",
+    [
+        (1024, 512, 128, 1024),   # aligned, max int8 bins
+        (700, 300, 16, 1024),     # ragged rows+cols, padded target
+        (513, 130, 64, 520),      # everything unaligned
+    ],
+)
+def test_bin_features_pallas_matches_xla(n, d, b, n_pad):
+    from spark_rapids_ml_tpu.ops.forest import _bin_chunk_t
+
+    rng = np.random.default_rng(n + d + b)
+    X = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    edges = jnp.asarray(
+        np.sort(rng.standard_normal((d, b - 1)).astype(np.float32), axis=1)
+    )
+    got = np.asarray(
+        bin_features_fm_pallas(X, edges, n_pad, interpret=KERNEL_INTERPRET)
+    )
+    want = np.asarray(_bin_chunk_t(X, edges))
+    assert got.shape == (d, n_pad)
+    np.testing.assert_array_equal(got[:, :n], want)
+    assert (got[:, n:] == 0).all(), "padding rows must be bin 0"
