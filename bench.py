@@ -61,11 +61,6 @@ CYCLE_ARMS = [
     "knn", "rf_reg", "rf_clf", "umap",
 ]
 CYCLE_OVERRIDES = {
-    # the estimator-path GLM arms generate on the host and upload through
-    # the (congestion-prone) host link; 100k x 3000 bounds that untimed
-    # setup at ~1.2 GB while the timed fit reuses the device-input cache
-    "linreg": {"SRML_BENCH_ROWS": "100000"},
-    "logreg": {"SRML_BENCH_ROWS": "100000"},
     # 1M x 100 sparse (the BASELINE.json shape family, 4x smaller)
     "logreg_sparse": {"SRML_BENCH_ROWS": "1000000"},
 }
@@ -195,39 +190,45 @@ def build_arm(algo: str, overrides):
 
         return fit, f"pca_fit_throughput_k{k}_d{cols}", rows
 
-    if algo == "linreg":
-        from spark_rapids_ml_tpu import LinearRegression
+    if algo in ("linreg", "logreg"):
+        # GLMs through the public estimator fit on a from_device frame —
+        # data generated on device like every other arm (the old host
+        # from_numpy staging uploaded 1.2 GB through the tunnel during the
+        # untimed warmup, 60+ s under congestion, and forced a 100k-row
+        # override; the full 400k shape now runs)
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu import LinearRegression, LogisticRegression
         from spark_rapids_ml_tpu.dataframe import DataFrame
 
         coef = rng.standard_normal(cols, dtype=np.float32)
-        X_host = rng.standard_normal((rows, cols), dtype=np.float32)
-        y = X_host @ coef + 0.1 * rng.standard_normal(rows, dtype=np.float32)
-        df = DataFrame.from_numpy(X_host, y, feature_layout="array", num_partitions=8)
-        est = (
-            LinearRegression(regParam=1e-5, maxIter=iters)
-            .setFeaturesCol("features")
-            .setLabelCol("label")
-        )
 
-        def fit():
-            model = est.fit(df)
-            return float(np.asarray(model.coefficients).ravel()[0])
+        def _gen(key, n_pad):
+            kx, kn = jax.random.split(key)
+            X = jax.random.normal(kx, (n_pad, cols), jnp.float32)
+            y = X @ jnp.asarray(coef) + 0.1 * jax.random.normal(kn, (n_pad,))
+            if algo == "logreg":
+                y = (y > 0).astype(jnp.float32)
+            return X, y
 
-        return fit, f"linreg_ridge_fit_throughput_d{cols}", rows
+        n_dev = mesh.devices.size
+        n_pad = rows + (-rows) % n_dev
+        Xs, ys = jax.jit(
+            lambda s: _gen(jax.random.PRNGKey(s), n_pad),
+            out_shardings=(data_sharding(mesh), data_sharding(mesh)),
+        )(42)
+        _sync(Xs.sum())
+        y_host = np.asarray(ys)[:rows]  # labels are O(N) scalars
+        df = DataFrame.from_device(Xs, y=y_host, n_rows=rows)
+        if algo == "linreg":
+            est = LinearRegression(regParam=1e-5, maxIter=iters)
 
-    if algo == "logreg":
-        from spark_rapids_ml_tpu import LogisticRegression
-        from spark_rapids_ml_tpu.dataframe import DataFrame
+            def fit():
+                model = est.fit(df)
+                return float(np.asarray(model.coefficients).ravel()[0])
 
-        coef = rng.standard_normal(cols, dtype=np.float32)
-        X_host = rng.standard_normal((rows, cols), dtype=np.float32)
-        y = (X_host @ coef > 0).astype(np.float32)
-        df = DataFrame.from_numpy(X_host, y, feature_layout="array", num_partitions=8)
-        est = (
-            LogisticRegression(regParam=1e-5, maxIter=max(iters, 200))
-            .setFeaturesCol("features")
-            .setLabelCol("label")
-        )
+            return fit, f"linreg_ridge_fit_throughput_d{cols}", rows
+        est = LogisticRegression(regParam=1e-5, maxIter=max(iters, 200))
 
         def fit():
             model = est.fit(df)
@@ -311,13 +312,12 @@ def build_arm(algo: str, overrides):
             ),
             out_shardings=data_sharding(mesh),
         )(0)
-        norm_dev = jax.jit(lambda x: jnp.einsum("nd,nd->n", x, x))(items_dev)
         Q_dev = jax.jit(
             lambda s: jax.random.normal(
                 jax.random.PRNGKey(s), (n_query, cols), jnp.float32
             )
         )(7)
-        _sync(norm_dev.sum())
+        _sync(items_dev.sum())
         _sync(Q_dev.sum())
 
         from spark_rapids_ml_tpu.core import extract_partition_features

@@ -281,18 +281,7 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         n_dev = mesh.shape[DATA_AXIS]
         parts = [p for p in self._item_df.partitions if len(p)]
         rows = sum(len(p) for p in parts)
-        dim = None
-        if parts:
-            from ..core import extract_partition_features
-
-            input_col, input_cols = self._get_input_columns()
-            # dimensionality from ONE row — extracting the whole first
-            # partition would re-stack O(rows x D) cell features on every
-            # call for list-cell frames, the cost the staging cache exists
-            # to amortize
-            dim = extract_partition_features(
-                parts[0].iloc[:1], input_col, input_cols, dtype
-            ).shape[1]
+        dim = self._frame_dim(dtype)
         in_core = (
             dim is not None
             and rows * dim * np.dtype(dtype).itemsize
@@ -345,6 +334,24 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
             )
         return out
 
+    def _frame_dim(self, dtype):
+        """Feature dimensionality of the item frame, from ONE row —
+        extracting a whole partition would re-stack O(rows x D) cell
+        features per call for list-cell frames.  ONE definition shared by
+        the cache lookup and seed_staging: the key must describe the SOURCE
+        frame, not a prepared layout (prepare_items may tile-align columns,
+        so prepared.items.shape[1] can exceed the frame dim — deriving the
+        key from it silently defeated the seeded cache)."""
+        parts = [p for p in self._item_df.partitions if len(p)]
+        if not parts:
+            return None
+        from ..core import extract_partition_features
+
+        input_col, input_cols = self._get_input_columns()
+        return extract_partition_features(
+            parts[0].iloc[:1], input_col, input_cols, dtype
+        ).shape[1]
+
     def _staging_key(self, mesh, rows: int, dim: int):
         """Identity of the staged item set — ONE definition shared by the
         lookup in _search_partitions and seed_staging, so external seeding
@@ -366,7 +373,10 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         uses."""
         mesh = mesh or get_mesh(self.num_workers)
         rows = sum(len(p) for p in self._item_df.partitions)
-        dim = int(prepared.items.shape[1])
+        dim = self._frame_dim(np.float32)
+        assert dim is not None and prepared.items.shape[1] >= dim, (
+            "prepared item columns narrower than the frame's feature dim"
+        )
         self._staged_items = (self._staging_key(mesh, rows, dim), prepared)
         self._staged_queries.clear()
         if query_blocks:

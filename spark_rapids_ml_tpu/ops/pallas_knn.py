@@ -65,13 +65,17 @@ def pallas_align_dims(n_rows: int, d: int, n_dev: int):
     cannot serve the shape anyway — small sets, d < 128, or shapes whose
     column alignment would waste >25% HBM (those keep the scan path, see
     pallas_knn_eligible)."""
-    if not pallas_enabled() or n_rows < _MIN_ALIGN_ROWS or d < 128:
+    if (
+        not pallas_enabled()
+        or n_dev != 1  # the fused kernels are single-shard only
+        or n_rows < _MIN_ALIGN_ROWS
+        or d < 128
+    ):
         return None
     d_al = _col_target(d)
     if d_al * 4 > d * 5:
         return None
-    row_mult = int(np.lcm(n_dev, _TILE_I))
-    return row_mult, d_al
+    return _TILE_I, d_al
 
 
 def _col_target(d: int) -> int:

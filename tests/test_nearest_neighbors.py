@@ -316,3 +316,44 @@ def test_knn_block_adaptive_fallback_rescues_corrupted_merge(monkeypatch):
     assert flagged.get("called")
     sk_d, _ = SkNN(n_neighbors=k).fit(X).kneighbors(Q)
     np.testing.assert_allclose(d_out, sk_d, rtol=1e-4, atol=1e-4)
+
+
+def test_seed_staging_hits_even_with_aligned_prepared_columns(monkeypatch):
+    """seed_staging must install a key that the kneighbors lookup MATCHES —
+    including when prepare_items tile-aligned the prepared columns wider
+    than the frame's feature dim (regression: the key was derived from
+    prepared.items.shape[1], silently defeating the cache and rebuilding
+    the index from the frame on every call)."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu import NearestNeighbors
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+    from spark_rapids_ml_tpu.models.knn import NearestNeighborsModel
+    from spark_rapids_ml_tpu.ops.knn import prepare_items
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((300, 12)).astype(np.float32)
+    Q = rng.standard_normal((40, 12)).astype(np.float32)
+    mesh = get_mesh(None)
+    model = NearestNeighbors(k=4).fit(DataFrame.from_numpy(X))
+    # simulate column tile-alignment: prepared carries 64 extra zero cols
+    Xal = np.pad(X, ((0, 0), (0, 64)))
+    prepared = prepare_items(
+        Xal, np.arange(300, dtype=np.int64), mesh, shuffle=False
+    )
+    model.seed_staging(prepared, mesh=mesh)
+
+    def _boom(*a, **kw):
+        raise AssertionError(
+            "kneighbors rebuilt the index: seeded staging key missed"
+        )
+
+    monkeypatch.setattr(
+        NearestNeighborsModel, "_iter_item_blocks", _boom
+    )
+    _, _, knn = model.kneighbors(DataFrame.from_numpy(Q))
+    d = np.stack(knn.toPandas()["distances"].to_numpy())
+    d2 = ((Q[:, None, :] - X[None]) ** 2).sum(-1)
+    want = np.sort(np.sqrt(d2), axis=1)[:, :4]
+    np.testing.assert_allclose(d, want, rtol=1e-4, atol=1e-4)
