@@ -144,6 +144,11 @@ class FitInputs:
     dtype: np.dtype
     row_id: Optional[np.ndarray] = None   # original row numbers (host, unpadded)
     extra_cols: Dict[str, np.ndarray] = field(default_factory=dict)
+    # host copies of the (unpadded) labels/weights when ingest had them —
+    # single-controller label discovery reads these instead of round-
+    # tripping the device label shards over the host link per fit
+    host_y: Optional[np.ndarray] = None
+    host_w: Optional[np.ndarray] = None
     # multi-controller context: which rank this process is, how many ranks
     # cooperate, and the string control plane they share (None single-
     # controller).  Fit functions that need host-side views of the inputs
@@ -187,6 +192,16 @@ def discover_label_classes(
     # the no-cast target is y's own dtype so every rank returns the same
     # dtype even when some rank holds zero valid rows
     target = np.dtype(cast) if cast is not None else np.dtype(inputs.y.dtype)
+    if inputs.nranks == 1 and inputs.host_y is not None:
+        # single-controller: the ingest's host label copy avoids fetching
+        # the device label shards back over the host link on EVERY fit
+        # (labels are re-uploaded per fit, so the fetch never warms)
+        vals = inputs.host_y
+        if inputs.host_w is not None:
+            vals = vals[inputs.host_w > 0]
+        if cast is not None:
+            vals = vals.astype(target)
+        return np.unique(vals).astype(target, copy=False)
     locs = []
     for y_loc, w_loc in _row_aligned_shards(inputs.y, inputs.weight):
         vals = y_loc[w_loc > 0]
@@ -403,6 +418,8 @@ class _TpuCaller(_TpuParams):
             pdesc=pdesc,
             dtype=dtype,
             row_id=np.arange(n_rows) if keep_row_id else None,
+            host_y=y_np,
+            host_w=w_np if weights is not None else None,
         )
 
     def _build_fit_inputs_device(
@@ -461,6 +478,8 @@ class _TpuCaller(_TpuParams):
             pdesc=PartitionDescriptor.build([n_rows], n_cols),
             dtype=dtype,
             row_id=np.arange(n_rows) if keep_row_id else None,
+            host_y=y_np if label_col is not None else None,
+            host_w=w_np if weight_col is not None else None,
         )
         df._device_fit_inputs = (cache_key, inputs)
         return inputs

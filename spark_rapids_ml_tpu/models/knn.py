@@ -374,9 +374,17 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         mesh = mesh or get_mesh(self.num_workers)
         rows = sum(len(p) for p in self._item_df.partitions)
         dim = self._frame_dim(np.float32)
-        assert dim is not None and prepared.items.shape[1] >= dim, (
-            "prepared item columns narrower than the frame's feature dim"
-        )
+        if dim is None:
+            raise ValueError(
+                "cannot seed staging for an empty item frame (no rows to "
+                "derive the feature dimensionality from)"
+            )
+        if prepared.items.shape[1] < dim:
+            raise ValueError(
+                f"prepared item columns ({prepared.items.shape[1]}) are "
+                f"narrower than the frame's feature dim ({dim}); the "
+                "seeded index would search truncated vectors"
+            )
         self._staged_items = (self._staging_key(mesh, rows, dim), prepared)
         self._staged_queries.clear()
         if query_blocks:
