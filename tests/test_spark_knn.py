@@ -443,3 +443,52 @@ def test_mixed_input_types_fail_loudly():
     model = NearestNeighbors(k=3).fit(_fake_sdf(items))
     with pytest.raises(TypeError, match="pyspark"):
         model.kneighbors(DataFrame.from_numpy(queries))
+
+
+def test_kneighbors_empty_rank_and_k_beyond_items():
+    """One barrier task ends up with zero item AND zero query rows (skewed
+    repartition), and k exceeds the global item count: the empty rank must
+    still join both control-plane rounds (bailing out would hang the
+    barrier) and every result row gets min(k, n_items) columns."""
+    import threading
+
+    from spark_rapids_ml_tpu.ops.knn import distributed_kneighbors
+
+    rng = np.random.default_rng(11)
+    items = rng.standard_normal((12, 5)).astype(np.float32)
+    queries = rng.standard_normal((7, 5)).astype(np.float32)
+    shared = _SharedBarrier(3)
+    res = {}
+    errs = []
+
+    def run(rank):
+        ctx = _FakeBarrierTaskContext(rank, shared)
+        if rank == 0:
+            ip = [(items, np.arange(12, dtype=np.int64))]
+            qp = []
+        elif rank == 1:
+            ip = []
+            qp = [(queries, np.arange(7, dtype=np.int64))]
+        else:  # rank 2: completely empty
+            ip, qp = [], []
+        try:
+            res[rank] = distributed_kneighbors(ip, qp, 50, rank, 3, ctx)
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            errs.append(e)
+            shared.barrier.abort()  # free the other ranks immediately
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[0]
+    assert res[0] == [] and res[2] == []
+    (d, i), = res[1]
+    assert d.shape == (7, 12) and i.shape == (7, 12)  # k_eff = 12 items
+    d2 = ((queries[:, None, :] - items[None]) ** 2).sum(-1)
+    want = np.sort(np.sqrt(d2), axis=1)
+    np.testing.assert_allclose(d, want, rtol=1e-4, atol=1e-5)
+    # every item id appears exactly once per row (full ranking)
+    assert all(set(row) == set(range(12)) for row in i)
