@@ -62,3 +62,7 @@ def __getattr__(name):  # lazy re-exports keep `import spark_rapids_ml_tpu` ligh
                 f"module {__name__!r} has no attribute {name!r} ({e})"
             ) from e
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():  # surface the lazy re-exports to dir()/completion
+    return sorted(set(globals()) | set(__all__))

@@ -179,15 +179,21 @@ class KMeans(_KMeansParams, _TpuEstimator):
                 float(params["tol"]),
                 chunk,
             )
+            # ONE batched device fetch: int()/float()/np.asarray each cost
+            # a host round-trip through the tunneled device (~30-100 ms
+            # apiece), and centers/n_iter/inertia are ready together
+            centers_h, n_iter_h, inertia_h = jax.device_get(
+                (centers, n_iter, inertia)
+            )
             logger.info(
-                "iterations: %d, inertia: %f", int(n_iter), float(inertia)
+                "iterations: %d, inertia: %f", int(n_iter_h), float(inertia_h)
             )
             return {
-                "cluster_centers_": np.asarray(centers, dtype=np.float64),
+                "cluster_centers_": np.asarray(centers_h, dtype=np.float64),
                 "n_cols": inputs.n_cols,
                 "dtype": str(inputs.dtype),
-                "n_iter_": int(n_iter),
-                "inertia_": float(inertia),
+                "n_iter_": int(n_iter_h),
+                "inertia_": float(inertia_h),
             }
 
         return _fit

@@ -248,6 +248,7 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
             l1_ratio = float(params["l1_ratio"])
             fit_intercept = bool(params["fit_intercept"])
             normalize = bool(params["normalize"])
+            n_iter = None
             if alpha == 0.0 or l1_ratio == 0.0:
                 # OLS ("eig") or Ridge with Spark-parity alpha*n scaling —
                 # scaling handled inside solve_linear (reg = alpha * wsum)
@@ -255,6 +256,8 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                     stats, alpha, fit_intercept=fit_intercept, normalize=normalize
                 )
             else:
+                # n_iter joins the batched fetch below — int() here would
+                # pay its own device round-trip
                 coef, intercept, n_iter = solve_elasticnet_cd(
                     stats,
                     alpha,
@@ -264,10 +267,16 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                     max_iter=int(params["max_iter"]),
                     tol=float(params["tol"]),
                 )
-                logger.info("CD sweeps: %d", int(n_iter))
+            # one batched device fetch (separate np.asarray/float coercions
+            # each cost a host round-trip through the tunneled device)
+            coef_h, intercept_h, n_iter_h = jax.device_get(
+                (coef, intercept, n_iter)
+            )
+            if n_iter_h is not None:
+                logger.info("CD sweeps: %d", int(n_iter_h))
             return {
-                "coef_": np.asarray(coef, dtype=np.float64),
-                "intercept_": float(intercept),
+                "coef_": np.asarray(coef_h, dtype=np.float64),
+                "intercept_": float(intercept_h),
                 "n_cols": inputs.n_cols,
                 "dtype": str(inputs.dtype),
             }

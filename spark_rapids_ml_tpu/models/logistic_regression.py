@@ -295,16 +295,21 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 float(params["tol"]),
                 use_owlqn,
             )
+            # one batched device fetch (each scalar coercion alone costs a
+            # host round-trip through the tunneled device)
+            W_h, b_h, n_iter_h, conv_h = jax.device_get(
+                (W, b, n_iter, converged)
+            )
             logger.info(
-                "L-BFGS iters: %d converged: %s", int(n_iter), bool(converged)
+                "L-BFGS iters: %d converged: %s", int(n_iter_h), bool(conv_h)
             )
             return {
-                "coef_": np.asarray(W, dtype=np.float64),
-                "intercept_": np.asarray(b, dtype=np.float64),
+                "coef_": np.asarray(W_h, dtype=np.float64),
+                "intercept_": np.asarray(b_h, dtype=np.float64),
                 "classes_": np.asarray(classes, dtype=np.float64),
                 "n_cols": inputs.n_cols,
                 "dtype": str(inputs.dtype),
-                "num_iters": int(n_iter),
+                "num_iters": int(n_iter_h),
             }
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
