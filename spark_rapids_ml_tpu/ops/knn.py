@@ -307,9 +307,7 @@ def _adaptive_eligible(k: int, n_loc: int) -> bool:
         and n_loc >= _ADAPTIVE_CHUNK
     ):
         return False
-    chunk = _ADAPTIVE_CHUNK
-    G = _GROUP_WIDTH if chunk % _GROUP_WIDTH == 0 else chunk
-    return _select_m(k, G, n_loc) <= _ADAPTIVE_MAX_M
+    return _scan_geometry(k, _ADAPTIVE_CHUNK, n_loc)[1] <= _ADAPTIVE_MAX_M
 
 
 def _select_m(k: int, G: int, n_loc: int) -> int:
@@ -364,12 +362,20 @@ def _chunk_d2(items_loc, x_norm, valid_loc, q, qn, i, chunk):
     return jnp.where(vb[None, :], d2, jnp.inf), start
 
 
+def _scan_geometry(k: int, chunk: int, n_loc: int) -> Tuple[int, int]:
+    """(G, m) for the chunked candidate scan — the ONE derivation shared by
+    the scan itself and the dispatcher's self-verification stride (the
+    worst-kept column slice in _adaptive_merge_self is only sound when its
+    m matches the m the scan laid the pool out with)."""
+    G = _GROUP_WIDTH if chunk % _GROUP_WIDTH == 0 else chunk
+    return G, _select_m(k, G, n_loc)
+
+
 def _candidates_scan(items_loc, x_norm, pos_loc, valid_loc, q, k, chunk):
     qn = (q * q).sum(axis=1)
     n_loc = items_loc.shape[0]
     n_chunks = -(-n_loc // chunk)
-    G = _GROUP_WIDTH if chunk % _GROUP_WIDTH == 0 else chunk
-    m = _select_m(k, G, n_loc)
+    G, m = _scan_geometry(k, chunk, n_loc)
 
     def body(c, i):
         d2, start = _chunk_d2(items_loc, x_norm, valid_loc, q, qn, i, chunk)
@@ -429,14 +435,16 @@ def _adaptive_candidates(items, item_norm, item_pos, valid, queries, mesh, k, ch
     )
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _adaptive_merge(cand_v, cand_i, k):
-    """Phase 2: EXACT top-k over the candidate pool (the pool is
+def _merge_pool(cand_v, cand_i, k):
+    """Shared merge core: EXACT top-k over the candidate pool (the pool is
     n_chunks*(chunk/G)*m wide — a few thousand columns, two orders of
     magnitude narrower than the scan, so one grouped exact top-k is cheap).
     Also emits the margined verification threshold and the returned-list
-    count so the host only round-trips the final arrays once."""
-    fv, fi = _grouped_topk_exact(cand_v, min(k, cand_v.shape[1]))
+    count so the host only round-trips the final arrays once.  Top-k rides
+    the PartialReduce hardware via _grouped_topk (approx + verify + exact
+    cond-fallback — ALWAYS exact): the pool sort was ~0.3 s of the 0.8 s
+    block at the bench shape on the exact two-stage sort."""
+    fv, fi = _grouped_topk(cand_v, min(k, cand_v.shape[1]))
     fpos = jnp.take_along_axis(cand_i, fi, axis=1)
     if fv.shape[1] < k:
         # keep the k-column output contract when the pool is narrower than
@@ -459,6 +467,55 @@ def _adaptive_merge(cand_v, cand_i, k):
     tu = jnp.where(jnp.isfinite(t), t + delta, t)
     sg = (fv > tu[:, None]).sum(axis=1)
     return fv, fpos, tu, sg
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _adaptive_merge(cand_v, cand_i, k):
+    """Merge phase for the COUNT-verified route (audit mode and tests):
+    returns (top-k values, positions, margined threshold, returned-list
+    count) — the count is compared against a second full distance scan."""
+    return _merge_pool(cand_v, cand_i, k)
+
+
+@partial(jax.jit, static_argnames=("k", "m"))
+def _adaptive_merge_self(cand_v, cand_i, k, m):
+    """Merge phase with SELF-CONTAINED overflow verification — no second
+    distance scan.  The pool holds each G-wide item group's exact top-m
+    (descending m-wide column blocks, one per group).  An item absent
+    from the pool is, by construction, no
+    better than its group's m-th kept value — so if every group's m-th kept
+    value is <= the margined global k-th threshold tu, NOTHING strictly
+    better than tu is missing and the merged list is exact (up to the
+    documented ~1e-6-relative ties at the kth distance).  Conversely a
+    group whose m-th kept value beats tu MIGHT have overflowed (held > m of
+    the true top-k); those rows are flagged for the exact per-row fallback.
+
+    Flag probability is governed by the same _select_m envelope the count
+    check rode: a flag fires iff some group holds >= m candidates above tu,
+    the count check fired iff some group held > m — one binomial tail term
+    apart, both ~1e-4 per block.  What this buys: the verification no
+    longer re-reads the item set (the count scan repaid the candidates
+    scan's full matmul+HBM cost, ~0.45 s of the ~0.95 s block at the
+    400k x 3000 k=200 bench shape), and it is bitwise self-consistent —
+    pool and threshold come from the SAME scan, so cross-scan rounding
+    cannot fire it (the very hazard the shared _accum_dot existed to tame).
+
+    Returns (fv, fpos, flags int32, zeros) — callers detect failures as
+    flags != zeros, the same contract as the (sg, sa) count pair.
+    Reference context: cuML's brute-force NN-MG (knn.py:486-560) instead
+    guarantees exactness with full per-chunk k (no verification); the
+    adaptive m << k trade plus this pool-resident check is the TPU design.
+    """
+    fv, fpos, tu, sg = _merge_pool(cand_v, cand_i, k)
+    # group g's m-th kept value lives at column g*m + (m-1)
+    worst_kept = cand_v[:, m - 1 :: m]
+    flags = (worst_kept > tu[:, None]).any(axis=1).astype(sg.dtype)
+    # emit euclidean distances directly — the host collect then only maps
+    # positions to ids (the per-block np.sqrt pass was ~10 ms of the
+    # 0.67 s block budget); -inf pool slots surface as +inf distances,
+    # which the callers' -1 id sentinel logic keys on
+    dist = jnp.sqrt(jnp.maximum(-fv, 0.0))
+    return dist, fpos, flags, jnp.zeros_like(sg)
 
 
 @partial(jax.jit, static_argnames=("mesh", "chunk"))
@@ -495,40 +552,66 @@ def _adaptive_count(items, item_norm, valid, queries, thresh, mesh, chunk):
 
 
 def _adaptive_pallas_phases(items, item_norm, valid, qd, k, m, n_items):
-    """candidates -> merge -> count on the pallas kernels — the ONE
+    """candidates -> self-verified merge on the pallas kernel — the ONE
     definition of the pallas-route phase sequence, dispatched either as
-    three separate jits or fused under one (below)."""
-    from .pallas_knn import knn_candidates_pallas, knn_count_pallas
+    separate jits or fused under one (below).  Verification reads the
+    pool's per-group m-th kept values (_adaptive_merge_self) instead of
+    re-scanning the item set; SRML_KNN_AUDIT_COUNT=1 restores the global
+    count scan (knn_count_pallas) for auditing the flag against ground
+    truth."""
+    from .pallas_knn import knn_candidates_pallas
 
+    if _audit_count_enabled():
+        from .pallas_knn import knn_count_pallas
+
+        # the audit pairs the LEGACY candidates kernel with the count
+        # kernel — those two share _accum_dot byte-for-byte, so the d2
+        # comparison is bitwise and audit failures are genuine misses
+        cv, ci = knn_candidates_pallas(
+            items, item_norm, valid, qd, k, m, n_items, legacy=True
+        )
+        fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
+        sa = knn_count_pallas(items, item_norm, valid, qd, tu, n_items)
+        return _neg_to_dist(fv), fpos, sg, sa
     cv, ci = knn_candidates_pallas(items, item_norm, valid, qd, k, m, n_items)
-    fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
-    sa = knn_count_pallas(items, item_norm, valid, qd, tu, n_items)
-    return fv, fpos, sg, sa
+    return _adaptive_merge_self(cv, ci, k, m=m)
 
 
-# Single-dispatch variant: candidates -> merge -> count as ONE jit.  Worth
-# it only in the LATENCY-BOUND regime (small item sets like UMAP's 50k
-# self-join, where per-block dispatch and scheduling overheads through the
-# tunneled device dominate — hardware A/B: 5.4 s -> 4.7 s per UMAP fit).
-# In the compute-bound regime the fused program SCHEDULES WORSE than the
-# three separate jits (400k x 3000 block: 2.2 s -> 3.0 s), so the
-# dispatcher gates on item-set size.
+def _audit_count_enabled() -> bool:
+    import os
+
+    return os.environ.get("SRML_KNN_AUDIT_COUNT", "") == "1"
+
+
+# audit-route shim: the self-verify merge emits euclidean distances on
+# device; the audit merge keeps negated-d2 (its threshold feeds the count
+# kernel), so its first output converts here to keep ONE dispatch contract
+_neg_to_dist = jax.jit(lambda fv: jnp.sqrt(jnp.maximum(-fv, 0.0)))
+
+
+# Single-dispatch variant: candidates -> self-verified merge as ONE jit.
+# With the count scan gone this wins (or ties) in BOTH regimes: in the
+# latency-bound regime (small item sets like UMAP's 50k self-join) it
+# halves per-block dispatch round-trips through the tunneled device
+# (hardware A/B: 5.4 s -> 4.7 s per UMAP fit), and in the compute-bound
+# regime it lets XLA overlap the pool transpose/merge with the kernel
+# epilogue (400k x 3000 block: 0.59 s separate -> 0.54 s fused; the OLD
+# three-phase program with the count kernel scheduled worse fused, 2.2 s
+# -> 3.0 s, which is why a size gate used to exist here).  Audit mode
+# (SRML_KNN_AUDIT_COUNT) keeps the separate dispatches.
 _adaptive_dispatch_fused = partial(
     jax.jit, static_argnames=("k", "m", "n_items")
 )(_adaptive_pallas_phases)
-
-
-# fused-dispatch bound: item cells (rows x cols) below this are latency-
-# bound (see _adaptive_dispatch_fused)
-_FUSED_DISPATCH_CELLS = 64 << 20
 
 
 def knn_block_adaptive_dispatch(
     items, item_norm, item_pos, valid, qd, mesh, k,
     chunk: int = _ADAPTIVE_CHUNK,
 ):
-    """Dispatch the three device phases of the adaptive block search WITHOUT
-    any host synchronization; returns device arrays (fv, fpos, sg, sa).
+    """Dispatch the device phases of the adaptive block search WITHOUT
+    any host synchronization; returns device arrays (euclidean distances
+    (Q, k) ascending, positions, flags, expected) where rows whose
+    flags != expected need the exact per-row fallback.
     Splitting dispatch from collection lets callers pipeline many query
     blocks — the per-block host round-trips (3 tunnel syncs each) were the
     dominant graph-build cost for small item sets like UMAP's 50k
@@ -551,23 +634,30 @@ def knn_block_adaptive_dispatch(
     ):
         m = _select_m(k, 1024, n_pad)
         if m <= _ADAPTIVE_MAX_M:
-            # the pallas route counts with the SAME kernel family: d2
-            # values bitwise-match the candidate scan, so verification
-            # failures are only true overflow misses (measured: XLA count
-            # vs pallas candidates disagreed on ~3% of rows from scan
-            # rounding alone, each a wasted exact rerun)
+            # audit mode keeps the separate dispatches (its count kernel
+            # pairs bitwise with the legacy candidates kernel); the
+            # default self-verify route fuses everything into one jit
             run = (
-                _adaptive_dispatch_fused
-                if n_pad * items.shape[1] <= _FUSED_DISPATCH_CELLS
-                else _adaptive_pallas_phases
+                _adaptive_pallas_phases
+                if _audit_count_enabled()
+                else _adaptive_dispatch_fused
             )
             return run(items, item_norm, valid, qd, k=k, m=m, n_items=n_pad)
     cv, ci = _adaptive_candidates(
         items, item_norm, item_pos, valid, qd, mesh, k, chunk
     )
-    fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
-    sa = _adaptive_count(items, item_norm, valid, qd, tu, mesh, chunk)
-    return fv, fpos, sg, sa
+    if _audit_count_enabled():
+        fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
+        sa = _adaptive_count(items, item_norm, valid, qd, tu, mesh, chunk)
+        return _neg_to_dist(fv), fpos, sg, sa
+    # the scan pool's per-group blocks are m wide (G-group top-m laid out
+    # contiguously by _group_topm; the layout survives the chunk moveaxis
+    # and the multi-shard all_gather, both of which concatenate whole
+    # group blocks).  _scan_geometry is the same derivation the scan used,
+    # with n_loc the per-shard row count the sharded scan sees.
+    n_loc = items.shape[0] // max(1, mesh.shape[DATA_AXIS])
+    _, m = _scan_geometry(k, chunk, n_loc)
+    return _adaptive_merge_self(cv, ci, k, m=m)
 
 
 def knn_block_adaptive_collect(
@@ -578,9 +668,7 @@ def knn_block_adaptive_collect(
     compiled fallback shapes stay bounded)."""
     fv, fpos, sg, sa = handles
     fail = np.flatnonzero(np.asarray(sa) != np.asarray(sg))
-    fv_h, fpos_h = np.array(fv), np.array(fpos)
-    d_out = np.sqrt(np.maximum(-fv_h, 0))
-    p_out = fpos_h
+    d_out, p_out = np.array(fv), np.array(fpos)  # fv is distances already
     if fail.size:
         b = 64
         while b < fail.size:
@@ -1173,7 +1261,7 @@ def knn_search_prepared(
             # pay 4 tunnel round-trips); failing rows are only QUEUED here —
             # running each block's rerun inline would serialize the pipeline
             fv_h, fpos_h, sg_h, sa_h = jax.device_get(handles)
-            d_host = np.sqrt(np.maximum(-fv_h[:n_q], 0))
+            d_host = fv_h[:n_q]  # distances computed on device
             ids_host = prepared.ids[fpos_h[:n_q]]
             ids_host[np.isinf(d_host)] = -1
             fail = np.flatnonzero(sa_h[:n_q] != sg_h[:n_q])

@@ -15,10 +15,12 @@
 # The kernel produces the same per-group top-m candidate pool as
 # ops/knn._candidates_scan (position-masked selection, so duplicate
 # distances stay distinct candidates); the pool then flows through the
-# UNCHANGED exact machinery — _adaptive_merge (exact top-k over the pool +
-# margined threshold), _adaptive_count (global count verification), and the
-# per-row exact fallback — so the result keeps the tie-tolerant exactness
-# contract documented at knn_block_adaptive.
+# UNCHANGED exact machinery — _adaptive_merge_self (exact top-k over the
+# pool + pool-resident overflow verification) and the per-row exact
+# fallback — so the result keeps the tie-tolerant exactness contract
+# documented at knn_block_adaptive.  The global count scan
+# (knn_count_pallas below) remains as the SRML_KNN_AUDIT_COUNT=1 audit
+# route that cross-checks the pool-resident flag against ground truth.
 #
 # Output layout: (n_groups, m_pad, Q_pad) rather than (Q, n_groups*m) —
 # the last dim stays the 128-aligned query tile and the m_pad rows satisfy
@@ -56,6 +58,18 @@ _TILE_D = 512
 # duplicated here to keep the import DAG acyclic)
 _MIN_ALIGN_ROWS = 1 << 15
 
+# VMEM budget for the query-resident accumulator slab (q_pad x tile_i f32);
+# past it the (i, j, b) kernel's per-tile scratch is used instead.  32 MB
+# covers the 8192-query bench block at tile_i=1024 and leaves >half of the
+# v5e's 128 MB VMEM for blocks, hi/lo scratch and epilogue temporaries.
+_ACC_SCRATCH_BUDGET = 32 << 20
+
+# K-block cap for the query-resident kernel (the whole D when it fits):
+# (tile_i, kb) f32 in-blocks double-buffered + the bf16 hi/lo scratch cost
+# ~(4 + 4 + 2 + 2) bytes x tile_i x kb = 36 MB at (1024, 3072), which with
+# the 32 MB accumulator slab stays inside the raised 100 MB scoped budget.
+_TILE_D_QRES = 3072
+
 
 def pallas_align_dims(n_rows: int, d: int, n_dev: int):
     """(row_multiple, col_target) that prepare_items should pad item sets
@@ -86,7 +100,7 @@ def _col_target(d: int) -> int:
     return _round_up(d, kb)
 
 
-def _aligned_items(items: jax.Array, inorm: jax.Array, kb: int):
+def _aligned_items(items: jax.Array, inorm: jax.Array, kb: int, tile_i: int = _TILE_I):
     """Pad the item array/norms to (TILE_I, kb) multiples so every block
     read is IN BOUNDS.  Out-of-bounds block DMA past an array's HBM extent
     is not a safe pad-with-garbage on real hardware: a ~17 MB overread left
@@ -98,14 +112,14 @@ def _aligned_items(items: jax.Array, inorm: jax.Array, kb: int):
     from .pallas_tpu import _round_up as _ru
 
     n_pad, d = items.shape
-    n_al = _ru(n_pad, _TILE_I)
+    n_al = _ru(n_pad, tile_i)
     d_al = _ru(d, kb)
     if (n_al, d_al) != (n_pad, d):
         items = jnp.pad(items, ((0, n_al - n_pad), (0, d_al - d)))
         inorm = jnp.pad(
             inorm, (0, n_al - n_pad), constant_values=jnp.inf
         )
-    return items, inorm, n_al // _TILE_I
+    return items, inorm, n_al // tile_i
 
 
 def _accum_dot(q_ref, it_ref, acc, kb, d_true: int, kd: int) -> None:
@@ -140,15 +154,38 @@ def _accum_dot(q_ref, it_ref, acc, kb, d_true: int, kd: int) -> None:
     )
 
 
-def _neg_d2(qn_ref, inorm_ref, acc, j, n_items: int, tile_i: int):
-    """Masked negated squared distances for the finished (TQ, TI) tile —
-    shared epilogue entry for both kernels (see _accum_dot on why)."""
-    tq = acc.shape[0]
-    neg = -(qn_ref[:] - 2.0 * acc[:] + inorm_ref[:])
+def _neg_d2(qn_ref, inorm_ref, a, j, n_items: int, tile_i: int):
+    """Masked negated squared distances for a finished (TQ, TI) tile value
+    — shared epilogue entry for all kernels (see _accum_dot on why)."""
+    tq = a.shape[0]
+    neg = -(qn_ref[:] - 2.0 * a + inorm_ref[:])
     # mask columns past the item set (ragged last group: OOB block reads
     # are undefined, and NaN garbage would poison the argmax/count)
     col = j * tile_i + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_i), 1)
     return jnp.where(col < n_items, neg, -jnp.inf)
+
+
+def _select_topm_store(neg, m: int, m_pad: int, j, tile_i: int,
+                       vals_ref, idx_ref):
+    """The per-group top-m selection epilogue shared by both candidates
+    kernels: m iterated (argmax, max, position-mask) passes over the
+    VMEM-resident (TQ, TI) tile.  Position-masking (not value-masking)
+    keeps duplicate distances as distinct candidates — exact multiset
+    semantics, same as ops/knn._group_topm."""
+    tq = neg.shape[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (tq, tile_i), 1)
+    vals, idxs = [], []
+    v = neg
+    for _ in range(m):
+        am = jnp.argmax(v, axis=1).astype(jnp.int32)
+        vals.append(jnp.max(v, axis=1))
+        idxs.append(am + j * tile_i)
+        v = jnp.where(iota == am[:, None], -jnp.inf, v)
+    for _ in range(m_pad - m):
+        vals.append(jnp.full((tq,), -jnp.inf, jnp.float32))
+        idxs.append(jnp.zeros((tq,), jnp.int32))
+    vals_ref[0] = jnp.stack(vals)
+    idx_ref[0] = jnp.stack(idxs)
 
 
 def _knn_topm_kernel(
@@ -168,24 +205,76 @@ def _knn_topm_kernel(
 
     @pl.when(kb == pl.num_programs(2) - 1)
     def _():
-        tq = acc.shape[0]
-        neg = _neg_d2(qn_ref, inorm_ref, acc, j, n_items, tile_i)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (tq, tile_i), 1)
-        vals, idxs = [], []
-        v = neg
-        for _ in range(m):
-            a = jnp.argmax(v, axis=1).astype(jnp.int32)
-            vals.append(jnp.max(v, axis=1))
-            idxs.append(a + j * tile_i)
-            # position-masking (not value-masking) keeps duplicate
-            # distances as distinct candidates — exact multiset semantics,
-            # same as ops/knn._group_topm
-            v = jnp.where(iota == a[:, None], -jnp.inf, v)
-        for _ in range(m_pad - m):
-            vals.append(jnp.full((tq,), -jnp.inf, jnp.float32))
-            idxs.append(jnp.zeros((tq,), jnp.int32))
-        vals_ref[0] = jnp.stack(vals)
-        idx_ref[0] = jnp.stack(idxs)
+        neg = _neg_d2(qn_ref, inorm_ref, acc[:], j, n_items, tile_i)
+        _select_topm_store(neg, m, m_pad, j, tile_i, vals_ref, idx_ref)
+
+
+def _knn_topm_kernel_qres(
+    qn_ref, inorm_ref, qhi_ref, qlo_ref, it_ref, vals_ref, idx_ref,
+    acc, ith, itl,
+    *, m: int, m_pad: int, n_items: int, tile_i: int, d_true: int, kd: int,
+    tq: int,
+):
+    """Query-resident-accumulator variant: grid (j, b, i) with the QUERY
+    tile innermost, so the (tile_i, kd) item block's index map (j, b) is
+    constant across the whole i sweep — Mosaic skips the repeated DMA and
+    the multi-GB item set crosses HBM ONCE per (j, b) instead of once per
+    query tile (the (i, j, b) grid re-read it q_pad/tq times: 157 GB at
+    the 400k x 3000 bench shape).  The item block's bf16 hi/lo split is
+    computed once per block (at i == 0) into scratch, and the QUERY hi/lo
+    split arrives precomputed (same bytes/elem as the f32 it replaces) —
+    the inner loop is exactly three MXU dots + the accumulate, no VPU
+    cast traffic.  Costs a (q_pad, tile_i) f32 accumulator slab in VMEM
+    (32 MB at 8192 queries x 1024 items) because every query tile's
+    accumulation is in flight at once — the wrapper gates on that budget
+    and falls back to the (i, j, b) kernel past it."""
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(0)
+    b = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        # no D-tail masking here: the qres route picks kb to DIVIDE the
+        # padded width, and _aligned_items/qp zero-pad their columns, so
+        # every block read is in-bounds zero-padded data
+        it = it_ref[:]
+        hi = it.astype(jnp.bfloat16)
+        ith[:] = hi
+        itl[:] = (it - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    single = d_true <= kd  # whole D in one K block: no cross-step state
+
+    q_hi = qhi_ref[:]
+    q_lo = qlo_ref[:]
+    it_hi = ith[:]
+    it_lo = itl[:]
+    dots = (
+        jnp.dot(q_hi, it_hi.T, preferred_element_type=jnp.float32)
+        + jnp.dot(q_hi, it_lo.T, preferred_element_type=jnp.float32)
+        + jnp.dot(q_lo, it_hi.T, preferred_element_type=jnp.float32)
+    )
+    if not single:
+        rows = pl.ds(i * tq, tq)
+
+        @pl.when(b == 0)
+        def _():
+            acc[rows, :] = jnp.zeros((tq, acc.shape[1]), acc.dtype)
+
+        acc[rows, :] += dots
+
+    def _epilogue(a):
+        neg = _neg_d2(qn_ref, inorm_ref, a, j, n_items, tile_i)
+        _select_topm_store(neg, m, m_pad, j, tile_i, vals_ref, idx_ref)
+
+    if single:
+        _epilogue(dots)
+    else:
+
+        @pl.when(b == pl.num_programs(1) - 1)
+        def _():
+            _epilogue(acc[pl.ds(i * tq, tq), :])
 
 
 def _knn_count_kernel(
@@ -215,7 +304,11 @@ def _knn_count_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "m", "n_items", "interpret")
+    jax.jit,
+    static_argnames=(
+        "k", "m", "n_items", "interpret", "tile_q", "tile_i", "tile_d",
+        "legacy",
+    ),
 )
 def knn_candidates_pallas(
     items: jax.Array,       # (N_pad, D) f32, device-resident
@@ -226,21 +319,41 @@ def knn_candidates_pallas(
     m: int,
     n_items: int,           # static: N_pad (cols past it are masked)
     interpret: bool = False,
+    tile_q: int = _TILE_Q,
+    tile_i: int = _TILE_I,
+    tile_d: int = 0,  # 0 = route default (legacy 512, qres cap 3072)
+    legacy: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-group top-m candidate pool for every query: returns
-    (values (Q, ng*m_pad) negated squared distances, positions
-    (Q, ng*m_pad) int32 into the padded item set), ready for
-    ops.knn._adaptive_merge.  Padded slots carry -inf values."""
+    (values (Q, ng*m) negated squared distances, positions (Q, ng*m) int32
+    into the padded item set), ready for ops.knn._adaptive_merge_self with
+    stride=m.  The kernel stores m_pad = round_up(m, 8) rows per group to
+    satisfy the f32/int32 min-tile; the wrapper's transpose drops the
+    padding rows so the downstream merge sort never pays for them (44% of
+    the pool at the bench shape's m=9)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     Q, d = queries.shape
-    tq = min(_TILE_Q, _round_up(Q, 128))
+    tq = min(tile_q, _round_up(Q, 128))
     d_pad = _round_up(d, 128)
-    kb = min(_TILE_D, d_pad)
-    d_blk = _round_up(d_pad, kb)
     q_pad = _round_up(Q, tq)
     m_pad = _round_up(m, 8)
+    use_qres = not legacy and q_pad * tile_i * 4 <= _ACC_SCRATCH_BUDGET
+    if use_qres:
+        # one K block spanning as much of D as VMEM allows (hardware A/B:
+        # 6 x 512 K blocks 0.57 s -> one 3072 block 0.455 s per bench
+        # query block — fewer acc read-modify-writes, deeper MXU dots);
+        # kb is chosen to DIVIDE d_pad so prepared 512-aligned item sets
+        # never pay a per-dispatch pad copy
+        cap = tile_d or _TILE_D_QRES
+        nb = -(-d_pad // cap)
+        while (d_pad // 128) % nb:
+            nb += 1
+        kb = d_pad // nb
+    else:
+        kb = min(tile_d or _TILE_D, d_pad)
+    d_blk = _round_up(d_pad, kb)
 
     qp = jnp.pad(
         queries.astype(jnp.float32), ((0, q_pad - Q), (0, d_blk - d))
@@ -249,43 +362,97 @@ def knn_candidates_pallas(
     # invalid (padding) rows get +inf norms so their d2 is inf — they can
     # never enter a top-m list
     inorm = jnp.where(valid, item_norm, jnp.inf).astype(jnp.float32)
-    items, inorm, ng = _aligned_items(items, inorm, kb)
+    items, inorm, ng = _aligned_items(items, inorm, kb, tile_i)
     inorm = inorm.reshape(1, -1)
 
-    grid = (q_pad // tq, ng, d_blk // kb)
-    vals, idxs = pl.pallas_call(
-        functools.partial(
-            _knn_topm_kernel,
-            m=m, m_pad=m_pad, n_items=n_items, tile_i=_TILE_I,
-            d_true=d_blk, kd=kb,
+    out_specs = [
+        pl.BlockSpec(
+            (1, m_pad, tq), lambda i, j, b: (j, 0, i),
+            memory_space=pltpu.VMEM,
         ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((tq, 1), lambda i, j, b: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _TILE_I), lambda i, j, b: (0, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tq, kb), lambda i, j, b: (i, b), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_TILE_I, kb), lambda i, j, b: (j, b), memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec(
-                (1, m_pad, tq), lambda i, j, b: (j, 0, i),
-                memory_space=pltpu.VMEM,
+        pl.BlockSpec(
+            (1, m_pad, tq), lambda i, j, b: (j, 0, i),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((ng, m_pad, q_pad), jnp.float32),
+        jax.ShapeDtypeStruct((ng, m_pad, q_pad), jnp.int32),
+    ]
+    if use_qres:
+        # query-resident-accumulator grid: item blocks cross HBM once per
+        # (group, D-block) instead of once per query tile (kernel header)
+        q_hi = qp.astype(jnp.bfloat16)
+        q_lo = (qp - q_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        vals, idxs = pl.pallas_call(
+            functools.partial(
+                _knn_topm_kernel_qres,
+                m=m, m_pad=m_pad, n_items=n_items, tile_i=tile_i,
+                d_true=d_blk, kd=kb, tq=tq,
             ),
-            pl.BlockSpec(
-                (1, m_pad, tq), lambda i, j, b: (j, 0, i),
-                memory_space=pltpu.VMEM,
+            grid=(ng, d_blk // kb, q_pad // tq),
+            in_specs=[
+                pl.BlockSpec((tq, 1), lambda j, b, i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, tile_i), lambda j, b, i: (0, j), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tq, kb), lambda j, b, i: (i, b), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tq, kb), lambda j, b, i: (i, b), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tile_i, kb), lambda j, b, i: (j, b), memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, m_pad, tq), lambda j, b, i: (j, 0, i),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (1, m_pad, tq), lambda j, b, i: (j, 0, i),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_shape=out_shape,
+            scratch_shapes=[
+                # the accumulator slab only exists when D spans multiple
+                # K blocks; at nb == 1 the dots feed the epilogue directly
+                pltpu.VMEM(
+                    (q_pad, tile_i) if d_blk > kb else (8, 128),
+                    jnp.float32,
+                ),
+                pltpu.VMEM((tile_i, kb), jnp.bfloat16),
+                pltpu.VMEM((tile_i, kb), jnp.bfloat16),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 << 20
             ),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((ng, m_pad, q_pad), jnp.float32),
-            jax.ShapeDtypeStruct((ng, m_pad, q_pad), jnp.int32),
-        ],
-        scratch_shapes=[pltpu.VMEM((tq, _TILE_I), jnp.float32)],
-        interpret=interpret,
-    )(qn, inorm, qp, items)
-    # (ng, m_pad, q_pad) -> (Q, ng*m_pad) pool layout for _adaptive_merge
-    cand_v = jnp.transpose(vals, (2, 0, 1)).reshape(q_pad, ng * m_pad)[:Q]
-    cand_i = jnp.transpose(idxs, (2, 0, 1)).reshape(q_pad, ng * m_pad)[:Q]
+            interpret=interpret,
+        )(qn, inorm, q_hi, q_lo, items)
+    else:
+        vals, idxs = pl.pallas_call(
+            functools.partial(
+                _knn_topm_kernel,
+                m=m, m_pad=m_pad, n_items=n_items, tile_i=tile_i,
+                d_true=d_blk, kd=kb,
+            ),
+            grid=(q_pad // tq, ng, d_blk // kb),
+            in_specs=[
+                pl.BlockSpec((tq, 1), lambda i, j, b: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, tile_i), lambda i, j, b: (0, j), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tq, kb), lambda i, j, b: (i, b), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tile_i, kb), lambda i, j, b: (j, b), memory_space=pltpu.VMEM),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((tq, tile_i), jnp.float32)],
+            # the epilogue's unrolled selection passes carry several
+            # (tq, tile_i) f32 temporaries at once; the default 16 MB
+            # scoped budget caps the tile at (256, 1024) — larger query
+            # tiles need the raised limit
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=96 << 20
+            ),
+            interpret=interpret,
+        )(qn, inorm, qp, items)
+    # (ng, m_pad, q_pad) -> compact (Q, ng*m) pool layout for the merge
+    cand_v = jnp.transpose(vals[:, :m], (2, 0, 1)).reshape(q_pad, ng * m)[:Q]
+    cand_i = jnp.transpose(idxs[:, :m], (2, 0, 1)).reshape(q_pad, ng * m)[:Q]
     return cand_v, cand_i
 
 

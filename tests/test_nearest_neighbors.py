@@ -271,15 +271,17 @@ def test_knn_block_adaptive_exact_small_mesh():
 
 
 def test_knn_block_adaptive_fallback_rescues_corrupted_merge(monkeypatch):
-    """Force a merge 'miss': corrupt one row's merged candidate list.  The
-    global count-verification must flag exactly that row and the exact
-    fallback must restore the correct answer."""
+    """AUDIT route (SRML_KNN_AUDIT_COUNT=1): force a merge 'miss' by
+    corrupting one row's merged candidate list.  The global
+    count-verification must flag exactly that row and the exact fallback
+    must restore the correct answer."""
     import jax.numpy as jnp
 
     import spark_rapids_ml_tpu.ops.knn as knn_mod
     from spark_rapids_ml_tpu.parallel.mesh import get_mesh
     from sklearn.neighbors import NearestNeighbors as SkNN
 
+    monkeypatch.setenv("SRML_KNN_AUDIT_COUNT", "1")
     rng = np.random.default_rng(5)
     n, d, q_n, k = 768, 16, 64, 7
     X = rng.standard_normal((n, d)).astype(np.float32)
@@ -316,6 +318,90 @@ def test_knn_block_adaptive_fallback_rescues_corrupted_merge(monkeypatch):
     assert flagged.get("called")
     sk_d, _ = SkNN(n_neighbors=k).fit(X).kneighbors(Q)
     np.testing.assert_allclose(d_out, sk_d, rtol=1e-4, atol=1e-4)
+
+
+def test_knn_adaptive_selfverify_flags_genuine_overflow(monkeypatch):
+    """The pool-resident verification (_adaptive_merge_self, the default
+    route) must catch the production failure mode it exists for: a group
+    holding MORE of the true top-k than the per-group candidate budget m.
+    Force it by shrinking m to 2 and clustering the entire top-k of every
+    query inside one item group — the merged list is then provably wrong
+    for every query, the group's m-th kept value beats the global kth
+    threshold, and the per-row exact fallback must restore sklearn
+    parity."""
+    import spark_rapids_ml_tpu.ops.knn as knn_mod
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    rng = np.random.default_rng(11)
+    n, d, q_n, k, chunk = 640, 12, 64, 5, 32
+    # far background + a tight cluster of k*2 items at the FRONT of the row
+    # order (one 32-wide group on the first shard after row-sharding)
+    X = rng.standard_normal((n, d)).astype(np.float32) * 10.0
+    X[: 2 * k] = rng.standard_normal((2 * k, d)).astype(np.float32) * 1e-2
+    Q = (rng.standard_normal((q_n, d)) * 1e-2).astype(np.float32)
+    mesh = get_mesh()
+    # shuffle=False: the deterministic prepare-time shuffle exists exactly
+    # to break up clusters like this one — keep it off so the overflow the
+    # test constructs survives into the scan
+    prepared = knn_mod.prepare_items(
+        X, np.arange(n, dtype=np.int64), mesh, shuffle=False
+    )
+
+    monkeypatch.setattr(knn_mod, "_select_m", lambda kk, G, n_loc: 2)
+    real_self = knn_mod._adaptive_merge_self
+    seen = {}
+
+    def spy(cand_v, cand_i, kk, m):
+        out = real_self(cand_v, cand_i, kk, m=m)
+        seen["flags"] = np.asarray(out[2])
+        return out
+
+    monkeypatch.setattr(knn_mod, "_adaptive_merge_self", spy)
+    d_out, p_out = knn_mod.knn_block_adaptive(
+        prepared.items, prepared.norm, prepared.pos, prepared.valid,
+        Q, mesh, k, chunk=chunk,
+    )
+    assert seen["flags"].any(), "overflow went undetected"
+    sk_d, _ = SkNN(n_neighbors=k).fit(X).kneighbors(Q)
+    np.testing.assert_allclose(d_out, sk_d, rtol=1e-4, atol=1e-4)
+
+
+def test_knn_adaptive_selfverify_matches_count_audit():
+    """On ordinary shuffled data the pool-resident flag and the audit
+    count-verify must agree that nothing failed, and both routes must
+    return identical results (same pool, same exact merge)."""
+    import os
+
+    import jax.numpy as jnp
+
+    import spark_rapids_ml_tpu.ops.knn as knn_mod
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    rng = np.random.default_rng(12)
+    n, d, q_n, k = 1024, 24, 96, 9
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Q = rng.standard_normal((q_n, d)).astype(np.float32)
+    mesh = get_mesh()
+    prepared = knn_mod.prepare_items(X, np.arange(n, dtype=np.int64), mesh)
+    args = (
+        prepared.items, prepared.norm, prepared.pos, prepared.valid,
+        jnp.asarray(Q), mesh, k,
+    )
+    fv_s, fp_s, flags, zeros = knn_mod.knn_block_adaptive_dispatch(
+        *args, chunk=128
+    )
+    assert not np.asarray(flags).any() and not np.asarray(zeros).any()
+    os.environ["SRML_KNN_AUDIT_COUNT"] = "1"
+    try:
+        fv_a, fp_a, sg, sa = knn_mod.knn_block_adaptive_dispatch(
+            *args, chunk=128
+        )
+    finally:
+        del os.environ["SRML_KNN_AUDIT_COUNT"]
+    np.testing.assert_array_equal(np.asarray(sg), np.asarray(sa))
+    np.testing.assert_array_equal(np.asarray(fv_s), np.asarray(fv_a))
+    np.testing.assert_array_equal(np.asarray(fp_s), np.asarray(fp_a))
 
 
 def test_seed_staging_hits_even_with_aligned_prepared_columns(monkeypatch):

@@ -114,19 +114,23 @@ def test_cpu_fallback_is_xla_path():
 # -- fused kNN distance + per-group top-m kernel (ops/pallas_knn.py) ---------
 
 from spark_rapids_ml_tpu.ops.pallas_knn import knn_candidates_pallas
-from spark_rapids_ml_tpu.ops.knn import _adaptive_merge, _select_m
+from spark_rapids_ml_tpu.ops.knn import _adaptive_merge_self, _select_m
 
 
 def _knn_pool_topk(items, norms, valid, Q, k, m):
-    """Run the pallas candidate kernel + the exact merge; return host
-    (distances ascending, positions)."""
+    """Run the pallas candidate kernel + the self-verified exact merge
+    (the production route, including the pallas m_pad pool stride); return
+    host (distances ascending, positions).  Asserts no overflow flag fired
+    — with _select_m-sized (or >= k) budgets on these shapes the pool
+    provably contains the exact top-k."""
     cv, ci = knn_candidates_pallas(
         jnp.asarray(items), jnp.asarray(norms), jnp.asarray(valid),
         jnp.asarray(Q), k, m, items.shape[0],
         interpret=KERNEL_INTERPRET,
     )
-    fv, fpos, _tu, _sg = _adaptive_merge(cv, ci, k)
-    return np.sqrt(np.maximum(-np.asarray(fv), 0)), np.asarray(fpos)
+    fv, fpos, flags, _z = _adaptive_merge_self(cv, ci, k, m=m)
+    assert not np.asarray(flags).any()
+    return np.asarray(fv), np.asarray(fpos)  # fv is distances already
 
 
 @pytest.mark.parametrize(
