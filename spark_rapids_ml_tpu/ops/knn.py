@@ -1026,31 +1026,6 @@ def knn_search_streamed(
     return out
 
 
-# control-plane transports cap per-message size (Spark's allGather rides the
-# RPC channel, spark.rpc.message.maxSize default 128 MiB) — large payloads
-# are split into bounded chunks sent over as many rounds as the widest rank
-# needs.  8 MiB keeps each frame far under the limit with base64 overhead.
-_ALLGATHER_CHUNK = 8 << 20
-
-
-def _allgather_large(control_plane, payload: str, chunk: int = _ALLGATHER_CHUNK):
-    """allGather of arbitrarily large strings over a frame-limited control
-    plane: one small round agrees on the per-rank chunk counts, then
-    max(counts) rounds ship the chunks.  Every rank must call this the same
-    number of times (it is a collective, like allGather itself)."""
-    chunks = [payload[i : i + chunk] for i in range(0, len(payload), chunk)]
-    if not chunks:
-        chunks = [""]
-    counts = [int(c) for c in control_plane.allGather(str(len(chunks)))]
-    parts: list = [[] for _ in counts]
-    for r in range(max(counts)):
-        got = control_plane.allGather(chunks[r] if r < len(chunks) else "")
-        for i, g in enumerate(got):
-            if r < counts[i]:
-                parts[i].append(g)
-    return ["".join(p) for p in parts]
-
-
 def distributed_kneighbors(
     item_parts,
     query_parts,
@@ -1075,28 +1050,34 @@ def distributed_kneighbors(
     identical to what a single-process knn_search over the concatenated
     data would give those rows.
 
-    Protocol (two control-plane rounds):
-      round 1: every rank publishes its concatenated query block
-               (features + ids ride the base64 ndarray codec) and its item
-               count.  Queries are broadcast — the reference ships query
-               partitions to every index worker the same way — while items,
-               the big side, stay put.
+    Protocol (two control-plane rounds, binary frames —
+    parallel/exchange.py):
+      round 1: every rank broadcasts its concatenated query block + item
+               count as ONE length-prefixed binary frame
+               (exchange.allgather_bytes).  Queries are broadcast — the
+               reference ships query partitions to every index worker the
+               same way — while items, the big side, stay put.
       local:   each rank streams its item partitions into device-resident
                blocks (HBM-budgeted) and computes exact top-k of the GLOBAL
                query set via the block kernels above.
-      round 2: per-rank (Q, k) candidate lists (ids + f32 distances — k
-               scalars per query, never data rows) are allGathered; each
-               rank merges the nranks sorted lists for ITS OWN query rows
-               only (native.topk_merge) and emits them per input partition.
-    Both rounds ride _allgather_large, so payloads beyond the transport's
-    per-message frame limit are split into bounded chunks automatically.
+      round 2: each rank SLICES its (Q_total, k) results per owning rank
+               and sends each slice to its owner (exchange.alltoall_bytes)
+               — k scalars per query, never data rows.  A receiver only
+               materializes the chunks addressed to it, so per-rank decode
+               volume is O(own_Q x k x nranks), the p2p shape of the
+               reference's UCX return (knn.py:549-560) rather than the
+               full-matrix broadcast it replaced.  The owner merges the
+               nranks sorted lists (native.topk_merge) and emits them per
+               input partition.
+    Both rounds chunk payloads under the transport's per-message frame
+    limit; bytes-capable planes (shared-FS, local) skip base64 entirely.
 
     Every rank must call this (a rank with zero rows still joins both
     gathers — bailing out would hang the barrier)."""
-    import json
-
     from .. import native
-    from ..parallel.runner import _decode_value, _encode_value
+    from ..parallel.exchange import (
+        allgather_bytes, alltoall_bytes, pack_arrays, unpack_arrays,
+    )
 
     mesh = mesh or get_mesh(None)
     q_feats = [np.asarray(f, dtype=dtype) for f, _ in query_parts]
@@ -1110,15 +1091,16 @@ def distributed_kneighbors(
     )
     n_items_loc = int(sum(np.asarray(f).shape[0] for f, _ in item_parts))
 
-    msg = json.dumps(
-        {"rank": rank, "n_items": n_items_loc, "q": _encode_value(q_cat)}
+    frames = allgather_bytes(
+        control_plane,
+        pack_arrays([q_cat, np.array([n_items_loc], np.int64)]),
     )
-    infos = sorted(
-        (json.loads(m) for m in _allgather_large(control_plane, msg)),
-        key=lambda g: g["rank"],
-    )
-    blocks = [_decode_value(g["q"]) for g in infos]
-    total_items = int(sum(g["n_items"] for g in infos))
+    blocks, item_counts = [], []
+    for fr in frames:  # allGather returns rank order
+        qb, ni = unpack_arrays(fr)
+        blocks.append(qb)
+        item_counts.append(int(ni[0]))
+    total_items = sum(item_counts)
     dims = {b.shape[1] for b in blocks if b.shape[0]}
     if len(dims) > 1:
         raise ValueError(f"ranks disagree on query dimensionality: {sorted(dims)}")
@@ -1165,21 +1147,29 @@ def distributed_kneighbors(
         d_mine = np.full((q_total, k), np.inf, np.float32)
         i_mine = np.full((q_total, k), -1, np.int64)
 
-    msg2 = json.dumps(
-        {"rank": rank, "d": _encode_value(d_mine), "i": _encode_value(i_mine)}
-    )
-    lo, hi = int(offs[rank]), int(offs[rank + 1])
+    # round 2: slice results by owning rank — each destination receives
+    # ONLY its own query rows' candidate lists.  The self slice never
+    # rides the wire (it is already local in d_mine/i_mine): at reference
+    # scale that is 1/nranks of the broadcast volume and the largest
+    # per-source chunk count gone.
+    lo_r, hi_r = int(offs[rank]), int(offs[rank + 1])
+    dests = [
+        pack_arrays(
+            [d_mine[int(offs[r]) : int(offs[r + 1])],
+             i_mine[int(offs[r]) : int(offs[r + 1])]]
+        )
+        if r != rank
+        else b""
+        for r in range(nranks)
+    ]
+    got = alltoall_bytes(control_plane, rank, nranks, dests)
     best_d = best_i = None
-    for g in sorted(
-        (json.loads(m) for m in _allgather_large(control_plane, msg2)),
-        key=lambda g: g["rank"],
-    ):
-        # merge only THIS rank's query rows — each rank owns its slice
-        d_r = _decode_value(g["d"])[lo:hi]
-        i_r = _decode_value(g["i"])[lo:hi]
-        if best_d is None:
-            best_d, best_i = d_r, i_r
-        else:
+    if hi_r > lo_r:
+        best_d, best_i = d_mine[lo_r:hi_r], i_mine[lo_r:hi_r]
+        for s, fr in enumerate(got):  # rank order; merge the sorted lists
+            if s == rank:
+                continue
+            d_r, i_r = unpack_arrays(fr)
             best_d, best_i = native.topk_merge(best_d, best_i, d_r, i_r)
     if best_d is None:  # this rank owns no queries
         return _empty_results()

@@ -32,7 +32,18 @@ from ..utils import get_logger
 
 class ControlPlane(Protocol):
     """Minimal control-plane contract: Spark's BarrierTaskContext satisfies it
-    (allGather of strings + barrier), as does the local trivial impl."""
+    (allGather of strings + barrier), as does the local trivial impl.
+
+    ORDERING REQUIREMENT: allGather must return messages indexed by rank
+    (result[r] = rank r's message) — Spark's BarrierTaskContext orders by
+    partition id, FileControlPlane by rank-numbered files.  The binary
+    collectives (parallel/exchange.py) and the kneighbors exchange index
+    results positionally and would silently mis-attribute payloads on an
+    arrival-ordered plane.
+
+    Planes MAY additionally provide ``allGatherBytes(bytes) -> List[bytes]``
+    (same semantics, binary frames); exchange.py uses it to skip base64
+    where the transport allows raw bytes."""
 
     def allGather(self, message: str) -> List[str]: ...
 
@@ -44,6 +55,9 @@ class LocalControlPlane:
     gather/barrier are identities."""
 
     def allGather(self, message: str) -> List[str]:
+        return [message]
+
+    def allGatherBytes(self, message: bytes) -> List[bytes]:
         return [message]
 
     def barrier(self) -> None:

@@ -65,11 +65,23 @@ class FileControlPlane:
         os.makedirs(root, exist_ok=True)
 
     def allGather(self, message: str) -> List[str]:
+        return [
+            b.decode("utf-8")
+            for b in self._gather_round(message.encode("utf-8"))
+        ]
+
+    def allGatherBytes(self, message: bytes) -> List[bytes]:
+        """Binary gather round — shared-FS planes move raw frames without
+        the base64 detour the string-only Spark RPC transport needs
+        (parallel/exchange.py picks this path up by hasattr)."""
+        return self._gather_round(message)
+
+    def _gather_round(self, message: bytes) -> List[bytes]:
         r = self._round
         self._round += 1
         path = os.path.join(self._root, f"round{r:05d}_rank{self._rank:05d}.msg")
         tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
+        with open(tmp, "wb") as f:
             f.write(message)
         os.replace(tmp, path)  # atomic publish
         expected = [
@@ -87,7 +99,7 @@ class FileControlPlane:
             time.sleep(self._poll)
         out = []
         for p in expected:
-            with open(p) as f:
+            with open(p, "rb") as f:
                 out.append(f.read())
         return out
 

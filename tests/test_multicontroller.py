@@ -367,20 +367,21 @@ def test_kneighbors_across_processes_matches_single_controller(tmp_path):
     assert (i_mc == i_sc).mean() > 0.99  # ids may swap only on exact ties
 
 
-def test_allgather_large_chunks_over_frame_limit(tmp_path):
-    """_allgather_large must reassemble payloads wider than the per-message
-    chunk, with ragged per-rank sizes (rank 1 sends a short message)."""
+def test_allgather_bytes_chunks_over_frame_limit(tmp_path):
+    """exchange.allgather_bytes must reassemble payloads wider than the
+    per-message chunk, with ragged per-rank sizes (rank 1 sends a short
+    message), over the FileControlPlane's native-bytes path."""
     import threading
 
-    from spark_rapids_ml_tpu.ops.knn import _allgather_large
+    from spark_rapids_ml_tpu.parallel.exchange import allgather_bytes
     from spark_rapids_ml_tpu.parallel.runner import FileControlPlane
 
-    payloads = {0: "a" * 2500, 1: "b" * 3, 2: "c" * 7001}
+    payloads = {0: b"a" * 2500, 1: b"b" * 3, 2: b"c" * 7001}
     results = {}
 
     def run(rank):
         cp = FileControlPlane(str(tmp_path / "cp"), rank, 3, timeout=30)
-        results[rank] = _allgather_large(cp, payloads[rank], chunk=1000)
+        results[rank] = allgather_bytes(cp, payloads[rank], chunk=1000)
 
     threads = [threading.Thread(target=run, args=(r,)) for r in range(3)]
     for t in threads:
