@@ -54,6 +54,7 @@ from ..ops.forest import (
     bin_features,
     bin_features_feature_major,
     compute_bin_edges,
+    compute_bin_edges_device,
     forest_predict_kernel,
     grow_forest,
     grow_tree,
@@ -72,6 +73,34 @@ _BINNING_SAMPLE_ROWS = 16_384
 _BINNING_SAMPLE_BYTES = 32 << 20
 
 
+def _binning_quota(X, n_shards_global: int) -> int:
+    """Rows each shard may contribute to the binning sample: the byte/row
+    budget divided over the GLOBAL shard count, so a 2-process x 4-device
+    fit samples exactly like a 1-process x 8-device fit over the same
+    global row layout (identical edges either way).  The floor sits on
+    the TOTAL, not per shard — a per-shard floor times a big mesh would
+    overshoot the byte cap this sample exists to enforce."""
+    row_bytes = max(1, X.shape[1] * X.dtype.itemsize)
+    budget = max(
+        2048, min(_BINNING_SAMPLE_ROWS, _BINNING_SAMPLE_BYTES // row_bytes)
+    )
+    return max(1, budget // max(1, n_shards_global))
+
+
+def _binning_rows(shard_weight, quota: int) -> np.ndarray:
+    """One shard's sampled row indices: valid (weight > 0) rows, ceil-
+    strided down to the quota.  Ceil stride spans the FULL row range — a
+    floor stride would truncate to a leading prefix, badly biasing edges
+    on label/time-sorted data.  The ONE row-selection policy shared by
+    the host-gather and device-edges paths."""
+    wv = np.asarray(shard_weight)
+    idx = np.flatnonzero(wv > 0)
+    if idx.size > quota:
+        step = -(-idx.size // quota)
+        idx = idx[::step]
+    return idx
+
+
 def _binning_sample(inputs: FitInputs) -> np.ndarray:
     """Bounded strided row sample of the device-resident features for
     quantile binning: per-shard strided gathers of valid rows (at most
@@ -84,19 +113,10 @@ def _binning_sample(inputs: FitInputs) -> np.ndarray:
     from ..core import _aligned_shard_objs
 
     X, w = inputs.X, inputs.weight
-    row_bytes = max(1, X.shape[1] * X.dtype.itemsize)
-    budget = max(
-        2048, min(_BINNING_SAMPLE_ROWS, _BINNING_SAMPLE_BYTES // row_bytes)
-    )
     shard_pairs = list(_aligned_shard_objs(X, w))
-    # per-shard quota sized by the GLOBAL shard count, so a 2-process x
-    # 4-device fit samples exactly like a 1-process x 8-device fit over the
-    # same global row layout (identical edges either way).  The floor sits
-    # on the TOTAL (the `budget` max above), not per shard — a per-shard
-    # floor times a big mesh would overshoot the byte cap this sample
-    # exists to enforce.
-    n_shards_global = max(1, inputs.nranks) * max(1, len(shard_pairs))
-    quota = max(1, budget // n_shards_global)
+    quota = _binning_quota(
+        X, max(1, inputs.nranks) * max(1, len(shard_pairs))
+    )
     # On TPU the sample crosses the (congestion-prone) host link: fetch it
     # bf16 — half the bytes.  Quantile edges from a ~2.8k-row sample carry
     # sampling error orders of magnitude above bf16 rounding OF THE
@@ -112,14 +132,7 @@ def _binning_sample(inputs: FitInputs) -> np.ndarray:
     )
     parts = []
     for sx, sw in shard_pairs:
-        wv = np.asarray(sw.data)
-        idx = np.flatnonzero(wv > 0)
-        if idx.size > quota:
-            # ceil stride spans the FULL row range (floor would truncate to
-            # a leading prefix — badly biased edges on label/time-sorted
-            # data)
-            step = -(-idx.size // quota)
-            idx = idx[::step]
+        idx = _binning_rows(sw.data, quota)
         if idx.size:
             sub = sx.data[jnp.asarray(idx)]
             if halve:
@@ -147,6 +160,30 @@ def _binning_sample(inputs: FitInputs) -> np.ndarray:
             allgather_ndarray(inputs.control_plane, inputs.rank, local)
         ).astype(X.dtype, copy=False)
     return local
+
+
+def _binning_sample_device(inputs: FitInputs):
+    """Single-rank TPU path: the strided binning sample STAYS ON DEVICE
+    (same row selection as _binning_sample) so the quantile edges can be
+    computed there (ops/forest.compute_bin_edges_device) and only the
+    (D, B-1) edge matrix crosses the host link.  Returns None when the
+    fit is multi-rank/multi-shard or non-f32 — those keep the host
+    gather path."""
+    from ..core import _aligned_shard_objs
+
+    if jax.default_backend() != "tpu" or inputs.nranks > 1:
+        return None
+    X, w = inputs.X, inputs.weight
+    if np.dtype(inputs.dtype) != np.float32:
+        return None
+    shard_pairs = list(_aligned_shard_objs(X, w))
+    if len(shard_pairs) != 1:
+        return None
+    sx, sw = shard_pairs[0]
+    idx = _binning_rows(sw.data, _binning_quota(X, 1))
+    if idx.size == 0:
+        return None
+    return sx.data[jnp.asarray(idx)]
 
 
 @partial(jax.jit, static_argnames=("n_trees", "bootstrap"))
@@ -562,12 +599,20 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
             assert inputs.y is not None
             n_bins = int(params["n_bins"])
-            # quantile edges from a bounded strided row sample fetched from
-            # the local device shards (a full np.asarray(inputs.X)
-            # round-trips the whole dataset over the host link — 4.8 GB at
-            # the benchmark shape — and raises outright multi-process)
-            X_host = _binning_sample(inputs)
-            edges = compute_bin_edges(X_host, n_bins)
+            # quantile edges from a bounded strided row sample (a full
+            # np.asarray(inputs.X) round-trips the whole dataset over the
+            # host link — 4.8 GB at the benchmark shape — and raises
+            # outright multi-process).  Single-rank TPU fits keep the
+            # sample on device and sort there (only the 1.5 MB edge
+            # matrix crosses the link); multi-rank/CPU fits take the host
+            # gather path.
+            X_host = None
+            sample_dev = _binning_sample_device(inputs)
+            if sample_dev is not None:
+                edges = compute_bin_edges_device(sample_dev, n_bins)
+            else:
+                X_host = _binning_sample(inputs)
+                edges = compute_bin_edges(X_host, n_bins)
 
             # Lazy per-route binning: the MXU route bins straight into the
             # feature-major int8 layout (bin_features_feature_major), the
@@ -606,7 +651,13 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
                     p = dict(params)
                     p.update(override)
                     if int(p["n_bins"]) != n_bins:
-                        e2 = compute_bin_edges(X_host, int(p["n_bins"]))
+                        e2 = (
+                            compute_bin_edges_device(
+                                sample_dev, int(p["n_bins"])
+                            )
+                            if sample_dev is not None
+                            else compute_bin_edges(X_host, int(p["n_bins"]))
+                        )
                         results.append(
                             _single_fit(inputs, p, get_bins, e2, stats, extra_attrs)
                         )

@@ -69,6 +69,47 @@ def compute_bin_edges(X: np.ndarray, n_bins: int, max_sample: int = 100_000, see
     return edges
 
 
+@partial(jax.jit, static_argnames=("n_bins", "n_cols"))
+def _bin_edges_device_kernel(sample: jax.Array, n_bins: int, n_cols: int):
+    """Device-side per-feature quantile edges over a (S, D) sample: the
+    same sort + linear-interpolation formula as compute_bin_edges, run in
+    f32 on device so only the (D, B-1) edge matrix crosses the host link
+    (the bf16 sample fetch + host sort it replaces was ~0.5-1.4 s per fit
+    at the 400k x 3000 bench shape).  Column-CHUNKED sort under lax.scan:
+    one monolithic sort over (S, 3000) is an XLA compile pathology on this
+    backend (20+ min), 256-column blocks compile in seconds."""
+    S, D = sample.shape
+    qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    pos = qs * (S - 1)
+    lo = jnp.asarray(np.floor(pos).astype(np.int32))
+    hi = jnp.asarray(np.ceil(pos).astype(np.int32))
+    frac = jnp.asarray((pos - np.floor(pos)).astype(np.float32))[:, None]
+    C = 256
+    d_pad = -(-D // C) * C
+    sp = jnp.pad(sample.astype(jnp.float32), ((0, 0), (0, d_pad - D)))
+
+    def body(c, i):
+        blk = jax.lax.dynamic_slice(sp, (0, i * C), (S, C))
+        srt = jnp.sort(blk, axis=0)
+        return c, srt[lo] * (1.0 - frac) + srt[hi] * frac  # (B-1, C)
+
+    _, es = jax.lax.scan(body, 0, jnp.arange(d_pad // C))
+    return jnp.transpose(es, (1, 0, 2)).reshape(n_bins - 1, d_pad)[:, :n_cols].T
+
+
+def compute_bin_edges_device(sample_dev: jax.Array, n_bins: int) -> np.ndarray:
+    """Edges (D, n_bins-1) float32 from a DEVICE-resident sample; one
+    1.5 MB fetch.  f32 interpolation instead of the host path's float64 —
+    a <=1 ulp delta on edge positions, orders of magnitude below the
+    sampling error of the ~2.8k-row sample, and used consistently for
+    training and prediction thresholds (no train/serve skew)."""
+    return np.asarray(
+        _bin_edges_device_kernel(
+            sample_dev, n_bins=n_bins, n_cols=sample_dev.shape[1]
+        )
+    )
+
+
 @jax.jit
 def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
     """bin = number of edges strictly below x, in [0, B-1]; x <= edges[b]

@@ -45,10 +45,11 @@ class _Job:
     """A one-shot future: holds either the compiled executable or the
     compile-time exception."""
 
-    __slots__ = ("done", "result", "error")
+    __slots__ = ("done", "result", "error", "key")
 
-    def __init__(self):
+    def __init__(self, key=None):
         self.done = threading.Event()
+        self.key = key
         self.result = None
         self.error: Optional[BaseException] = None
 
@@ -87,10 +88,19 @@ class Precompiler:
             self._workers.append(t)
 
     def _worker(self):
+        import os
+        import time
+
+        trace = os.environ.get("SRML_PRECOMPILE_LOG") == "1"
         while True:
             job, fn, avals, static_kwargs = self._q.get()
             try:
+                t0 = time.perf_counter() if trace else 0.0
                 job.result = fn.lower(*avals, **static_kwargs).compile()
+                if trace:
+                    logger.warning(
+                        "compiled %r in %.2fs", job.key, time.perf_counter() - t0
+                    )
             except BaseException as exc:  # noqa: BLE001 - relayed to waiter
                 job.error = exc
             finally:
@@ -103,7 +113,7 @@ class Precompiler:
         with self._lock:
             if key in self._jobs:
                 return
-            job = _Job()
+            job = _Job(key)
             self._jobs[key] = job
             # LRU bound: evict the oldest FINISHED executables (an in-flight
             # job must stay — its waiter holds a reference to the key)

@@ -325,3 +325,26 @@ def test_mxu_route_wiring_feature_major(monkeypatch):
     n_pad = -(-X.shape[0] // _ROW_TILE) * _ROW_TILE
     assert seen["shape"] == (7, n_pad) and seen["dtype"] == "int8"
     assert model.getNumTrees == 3
+
+
+def test_device_bin_edges_match_host():
+    """compute_bin_edges_device (chunked device sort + f32 interpolation)
+    must reproduce the host float64 quantile edges up to f32 interpolation
+    error — including a ragged column count that exercises the 256-column
+    chunk padding."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.forest import (
+        compute_bin_edges,
+        compute_bin_edges_device,
+    )
+
+    rng = np.random.default_rng(17)
+    for S, D, B in [(2778, 300, 128), (513, 700, 32), (100, 5, 16)]:
+        # offset-heavy features stress the f32 interpolation the most
+        X = (rng.normal(size=(S, D)) * rng.gamma(1.0, 5.0, size=D)[None]
+             + rng.normal(size=D)[None] * 100).astype(np.float32)
+        host = compute_bin_edges(X, B)
+        dev = compute_bin_edges_device(jnp.asarray(X), B)
+        assert dev.shape == host.shape == (D, B - 1)
+        np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-4)
