@@ -712,6 +712,13 @@ class _TpuModel(_TpuParams):
     def _get_model_attributes(self) -> Dict[str, Any]:
         return self._model_attributes
 
+    @classmethod
+    def _construct(cls, attrs: Dict[str, Any]) -> "_TpuModel":
+        """Rebuild a model from its (decoded) attribute dict.  Override
+        when _get_model_attributes carries entries that are not
+        constructor arguments (see _construct_model)."""
+        return cls(**attrs)
+
     @property
     def hasSummary(self) -> bool:
         return False
@@ -956,6 +963,15 @@ class _TpuModelWriter:
             json.dump(attrs, f)
 
 
+def _construct_model(cls: type, attrs: Dict[str, Any]) -> "_TpuModel":
+    """Instantiate a model from decoded attributes via the class's
+    _construct hook — model classes whose attribute dict carries
+    NON-constructor entries (e.g. a combined multi-model's sub-model
+    split) override it to pop and reattach them, keeping this layer
+    model-agnostic."""
+    return cls._construct(dict(attrs))
+
+
 class _TpuModelReader:
     def __init__(self, cls: type):
         self.cls = cls
@@ -969,7 +985,7 @@ class _TpuModelReader:
         npz = np.load(os.path.join(path, _ARRAYS_FILE), allow_pickle=False)
         for k in npz.files:
             attrs[k] = npz[k]
-        model = cls(**attrs)
+        model = _construct_model(cls, attrs)
         _apply_params_metadata(meta, model)
         return model
 

@@ -16,6 +16,7 @@ from .dataframe import DataFrame, as_dataframe
 from .metrics.multiclass import MulticlassMetrics
 from .metrics.regression import RegressionMetrics
 from .params import (
+    HasFeaturesCol,
     HasLabelCol,
     HasPredictionCol,
     HasProbabilityCol,
@@ -34,6 +35,20 @@ class Evaluator(Params):
 
     def isLargerBetter(self) -> bool:
         return True
+
+    def _evaluate_executor_side(self, dataset: Any):
+        """Route a LIVE pyspark prediction frame through executor-side
+        partial metrics (spark/adapter.executor_evaluate) — the facade
+        coercion (as_dataframe -> spark_to_facade) would collect the whole
+        prediction frame to the driver.  Returns None when `dataset` is
+        not a live Spark frame (callers fall through to the local path)."""
+        from .core import _use_executor_path
+
+        if not _use_executor_path(dataset):
+            return None
+        from .spark.adapter import executor_evaluate
+
+        return executor_evaluate(dataset, self)
 
 
 class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol, HasWeightCol):
@@ -70,16 +85,25 @@ class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol, HasWeightCol
     def isLargerBetter(self) -> bool:
         return self.getMetricName() in ("r2", "var")
 
+    def _partial_metrics_frame(self, pdf: Any) -> RegressionMetrics:
+        """One partition's mergeable metric partial — the ONE extraction
+        shared by the local loop below and the executor-side UDF
+        (spark/adapter.executor_evaluate)."""
+        return RegressionMetrics.from_arrays(
+            pdf[self.getOrDefault("labelCol")].to_numpy(),
+            pdf[self.getOrDefault("predictionCol")].to_numpy(),
+        )
+
     def evaluate(self, dataset: Any) -> float:
+        spark_score = self._evaluate_executor_side(dataset)
+        if spark_score is not None:
+            return spark_score
         df = as_dataframe(dataset)
         metrics = None
         for part in df.partitions:
             if len(part) == 0:
                 continue
-            m = RegressionMetrics.from_arrays(
-                part[self.getOrDefault("labelCol")].to_numpy(),
-                part[self.getOrDefault("predictionCol")].to_numpy(),
-            )
+            m = self._partial_metrics_frame(part)
             metrics = m if metrics is None else metrics.merge(m)
         assert metrics is not None, "empty dataset"
         return metrics.evaluate(self)
@@ -134,27 +158,100 @@ class MulticlassClassificationEvaluator(
             "logLoss",
         )
 
-    def evaluate(self, dataset: Any) -> float:
-        df = as_dataframe(dataset)
+    def _partial_metrics_frame(self, pdf: Any) -> MulticlassMetrics:
+        """One partition's mergeable metric partial (see
+        RegressionEvaluator._partial_metrics_frame)."""
         needs_probs = self.getMetricName() == "logLoss"
+        probs = (
+            np.stack(pdf[self.getOrDefault("probabilityCol")].to_numpy())
+            if needs_probs
+            else None
+        )
+        return MulticlassMetrics.from_arrays(
+            pdf[self.getOrDefault("labelCol")].to_numpy(),
+            pdf[self.getOrDefault("predictionCol")].to_numpy(),
+            probs=probs,
+            eps=self.getEps(),
+        )
+
+    def evaluate(self, dataset: Any) -> float:
+        spark_score = self._evaluate_executor_side(dataset)
+        if spark_score is not None:
+            return spark_score
+        df = as_dataframe(dataset)
         metrics = None
         for part in df.partitions:
             if len(part) == 0:
                 continue
-            probs = (
-                np.stack(part[self.getOrDefault("probabilityCol")].to_numpy())
-                if needs_probs
-                else None
-            )
-            m = MulticlassMetrics.from_arrays(
-                part[self.getOrDefault("labelCol")].to_numpy(),
-                part[self.getOrDefault("predictionCol")].to_numpy(),
-                probs=probs,
-                eps=self.getEps(),
-            )
+            m = self._partial_metrics_frame(part)
             metrics = m if metrics is None else metrics.merge(m)
         assert metrics is not None, "empty dataset"
         return metrics.evaluate(self)
+
+
+class ClusteringEvaluator(Evaluator, HasFeaturesCol, HasPredictionCol):
+    """pyspark ClusteringEvaluator stand-in: silhouette with squared
+    euclidean distance (Spark's default distanceMeasure), computed in
+    Spark's mergeable two-pass form (metrics/clustering.py) so it scores
+    executor-side on live clusters — this is what lets KMeans ride
+    CrossValidator.  Matches
+    sklearn.metrics.silhouette_score(metric='sqeuclidean')."""
+
+    metricName = Param(_dummy(), "metricName", "metric name in evaluation (silhouette)", TypeConverters.toString)
+    distanceMeasure = Param(_dummy(), "distanceMeasure", "distance measure (squaredEuclidean)", TypeConverters.toString)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            metricName="silhouette", distanceMeasure="squaredEuclidean"
+        )
+        for k, v in kwargs.items():
+            self.set(self.getParam(k), v)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def getDistanceMeasure(self) -> str:
+        return self.getOrDefault("distanceMeasure")
+
+    def setPredictionCol(self, value: str) -> "ClusteringEvaluator":
+        self.set(self.getParam("predictionCol"), value)
+        return self
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+    def _check_config(self) -> None:
+        if self.getMetricName() != "silhouette":
+            raise ValueError(
+                f"Unsupported metric name, found {self.getMetricName()}"
+            )
+        if self.getDistanceMeasure() != "squaredEuclidean":
+            raise NotImplementedError(
+                "only distanceMeasure='squaredEuclidean' is implemented "
+                "(pyspark's default; the cosine form is not ported)"
+            )
+
+    def evaluate(self, dataset: Any) -> float:
+        from .metrics.clustering import silhouette_score
+        from .utils import stack_feature_cells
+
+        self._check_config()
+        spark_score = self._evaluate_executor_side(dataset)
+        if spark_score is not None:
+            return spark_score
+        df = as_dataframe(dataset)
+        feat_col = self.getOrDefault("featuresCol")
+        pred_col = self.getOrDefault("predictionCol")
+        feats, preds = [], []
+        for part in df.partitions:
+            if len(part) == 0:
+                continue
+            feats.append(stack_feature_cells(part[feat_col].to_numpy(), np.float64))
+            preds.append(part[pred_col].to_numpy())
+        assert feats, "empty dataset"
+        k = int(max(p.max() for p in preds)) + 1
+        return silhouette_score(feats, preds, k)
 
 
 class BinaryClassificationEvaluator(

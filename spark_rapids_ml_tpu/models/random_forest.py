@@ -688,6 +688,20 @@ class _RandomForestModelBase(_RandomForestParams, _TpuModelWithPredictionCol):
         return len(counts) if counts else 1
 
     @classmethod
+    def _construct(cls, attrs):
+        """A combined multi-model's sub-model split ('tree_counts') is an
+        attribute, not a constructor argument — reattach it so the
+        combined structure survives executor serialization and npz
+        persistence."""
+        tc = attrs.pop("tree_counts", None)
+        model = cls(**attrs)
+        if tc is not None:
+            counts = [int(c) for c in np.asarray(tc).tolist()]
+            model._tree_counts = counts
+            model._model_attributes["tree_counts"] = counts
+        return model
+
+    @classmethod
     def _combine(cls, models: List["_RandomForestModelBase"]) -> "_RandomForestModelBase":
         assert models and all(isinstance(m, cls) for m in models)
         first = models[0]
@@ -724,6 +738,11 @@ class _RandomForestModelBase(_RandomForestParams, _TpuModelWithPredictionCol):
             kwargs.update(classes_=first.classes_, num_classes=first.num_classes)
         combined = cls(**kwargs)
         combined._tree_counts = [m.features_.shape[0] for m in models]
+        # record the split in the ATTRIBUTES too: serialize_model ships
+        # _get_model_attributes() to the executors, and a combined model
+        # arriving there without its sub-model split would score as ONE
+        # forest (core._construct_model reattaches it)
+        combined._model_attributes["tree_counts"] = combined._tree_counts
         first._copyValues(combined)
         combined._tpu_params.update(first._tpu_params)
         combined._float32_inputs = first._float32_inputs

@@ -205,11 +205,11 @@ def serialize_model(model: Any) -> Dict[str, Any]:
 
 
 def deserialize_model(payload: Dict[str, Any]) -> Any:
-    from ..core import _apply_params_metadata, _resolve_class
+    from ..core import _apply_params_metadata, _construct_model, _resolve_class
     from ..parallel.runner import decode_attrs
 
     cls = _resolve_class(payload["metadata"]["class"])
-    model = cls(**decode_attrs(payload["attrs"]))
+    model = _construct_model(cls, decode_attrs(payload["attrs"]))
     _apply_params_metadata(payload["metadata"], model)
     return model
 
@@ -343,6 +343,121 @@ def executor_transform_evaluate(
     ]
     metrics = metrics_cls._from_rows(num_models, rows)
     return [m.evaluate(evaluator) for m in metrics]
+
+
+def executor_evaluate(sdf: Any, evaluator: Any) -> float:
+    """Evaluator.evaluate on a live pyspark PREDICTION frame (post
+    transform): per-partition mergeable metric partials computed
+    executor-side and merged on the driver — only metric rows (a few
+    floats each) ever leave the executors.  This is the CV fallback
+    scoring route (tuning.one_fold non-single-pass): the old path was
+    evaluate(transform(valid).toPandas()), an O(rows) driver collect of
+    the prediction frame.  Match: the reference scores folds through
+    pyspark evaluators, whose implementations aggregate cluster-side
+    (tuning.py:96-148)."""
+    import json
+
+    from ..evaluation import (
+        ClusteringEvaluator,
+        MulticlassClassificationEvaluator,
+        RegressionEvaluator,
+    )
+    from ..metrics.multiclass import MulticlassMetrics
+    from ..metrics.regression import RegressionMetrics
+
+    if isinstance(evaluator, ClusteringEvaluator):
+        return _executor_evaluate_clustering(sdf, evaluator)
+    if isinstance(evaluator, MulticlassClassificationEvaluator):
+        metrics_cls: Any = MulticlassMetrics
+    elif isinstance(evaluator, RegressionEvaluator):
+        metrics_cls = RegressionMetrics
+    else:
+        raise NotImplementedError(f"{evaluator} is unsupported yet.")
+
+    def _metrics_udf(iterator):
+        m = None
+        for pdf in iterator:
+            if len(pdf) == 0:
+                continue
+            # the ONE per-partition extraction, shared with the local
+            # evaluate loop (Evaluator._partial_metrics_frame)
+            mm = evaluator._partial_metrics_frame(pdf)
+            m = mm if m is None else m.merge(mm)
+        if m is not None:
+            yield pd.DataFrame({"metrics_json": [json.dumps(m.to_row(0))]})
+
+    rows = [
+        json.loads(r["metrics_json"])
+        for r in sdf.mapInPandas(_metrics_udf, schema="metrics_json string").collect()
+    ]
+    assert rows, "empty dataset"
+    return metrics_cls._from_rows(1, rows)[0].evaluate(evaluator)
+
+
+def _executor_evaluate_clustering(sdf: Any, evaluator: Any) -> float:
+    """Two-pass executor-side silhouette (metrics/clustering.py): pass 1
+    collects per-partition cluster stats built with each partition's LOCAL
+    cluster-id range (ClusterStats.merge pads, so no separate k round is
+    needed), pass 2 ships the merged GLOBAL stats back in the task closure
+    and collects one (sum_s, count) pair per partition.  The frame is
+    cached across the passes — it is usually a lazy transform lineage
+    (model inference), which would otherwise re-run per action."""
+    import json
+
+    from ..metrics.clustering import ClusterStats, silhouette_partial
+    from ..utils import stack_feature_cells
+
+    feat_col = evaluator.getOrDefault("featuresCol")
+    pred_col = evaluator.getOrDefault("predictionCol")
+
+    def _feats(pdf):
+        return stack_feature_cells(pdf[feat_col].to_numpy(), np.float64)
+
+    def _stats_udf(iterator):
+        st = None
+        for pdf in iterator:
+            if len(pdf) == 0:
+                continue
+            preds = pdf[pred_col].to_numpy()
+            s = ClusterStats.from_arrays(
+                _feats(pdf), preds, int(preds.max()) + 1
+            )
+            st = s if st is None else st.merge(s)
+        if st is not None:
+            yield pd.DataFrame({"stats_json": [json.dumps(st.to_row())]})
+
+    sdf = sdf.cache()
+    try:
+        stats = ClusterStats.merge_rows(
+            [
+                json.loads(r["stats_json"])
+                for r in sdf.mapInPandas(
+                    _stats_udf, schema="stats_json string"
+                ).collect()
+            ]
+        )
+        if int((stats.n > 0).sum()) < 2:
+            raise AssertionError("Number of clusters must be greater than one.")
+
+        def _sil_udf(iterator):
+            tot, cnt = 0.0, 0
+            for pdf in iterator:
+                if len(pdf) == 0:
+                    continue
+                t, c = silhouette_partial(
+                    _feats(pdf), pdf[pred_col].to_numpy(), stats
+                )
+                tot += t
+                cnt += c
+            if cnt:
+                yield pd.DataFrame({"s": [tot], "n": [cnt]})
+
+        parts = sdf.mapInPandas(_sil_udf, schema="s double, n long").collect()
+        total = sum(r["s"] for r in parts)
+        count = sum(r["n"] for r in parts)
+        return total / max(count, 1)
+    finally:
+        sdf.unpersist()
 
 
 # -- executor-side kneighbors ------------------------------------------------

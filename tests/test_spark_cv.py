@@ -154,7 +154,21 @@ class _FakeSparkDataFrame:
         return _FakeSparkDataFrame(_split_pandas(self._whole(), n))
 
     def mapInPandas(self, udf, schema=None):
-        return _FakeSparkDataFrame(self._partitions, udf=udf)
+        if self._udf is None:
+            return _FakeSparkDataFrame(self._partitions, udf=udf)
+        # compose stages like lazy pyspark: a mapInPandas over an already
+        # udf-bearing frame (e.g. evaluate over a transform) applies to the
+        # PREVIOUS stage's output, per partition
+        prev = self._udf
+
+        def chained(part_iter):
+            def gen():
+                for part in part_iter:
+                    yield from prev(iter([part]))
+
+            return udf(gen())
+
+        return _FakeSparkDataFrame(self._partitions, udf=chained)
 
     def collect(self):
         # executor_transform_evaluate collects METRIC rows (never data rows)
@@ -259,3 +273,92 @@ def test_cv_logreg_cluster_side():
     assert got.bestModel.getOrDefault("regParam") == want.bestModel.getOrDefault(
         "regParam"
     )
+
+
+def test_cv_random_forest_single_pass_cluster_side():
+    """RF rides the SINGLE-PASS CV route on the cluster (fitMultiple ->
+    _combine -> executor transform-evaluate).  Regression guard: the
+    combined multi-model's sub-model split (_tree_counts) must survive
+    serialization to the executors — without it the combined forest
+    scored as ONE model and indexed out of bounds.  Metric-for-metric
+    parity with the driver-local CV on identical folds; spark_to_facade
+    is patched to raise, so any driver collect fails loudly."""
+    from spark_rapids_ml_tpu import RandomForestClassifier
+
+    X, _, y_cls = _data(n=300, d=5, seed=9)
+    sdf, facade = _frames(X, y_cls)
+
+    def _cv():
+        est = RandomForestClassifier(numTrees=5, maxDepth=4, seed=7)
+        grid = (
+            ParamGridBuilder()
+            .addGrid(est.getParam("numTrees"), [3, 5])
+            .build()
+        )
+        return CrossValidator(
+            estimator=est,
+            estimatorParamMaps=grid,
+            evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+            numFolds=2,
+            seed=11,
+        )
+
+    got = _cv().fit(sdf)
+    want = _cv().fit(facade)
+    np.testing.assert_allclose(got.avgMetrics, want.avgMetrics, rtol=1e-6)
+    assert got.bestModel.getNumTrees == want.bestModel.getNumTrees
+
+
+def test_cv_kmeans_cluster_side_with_clustering_evaluator():
+    """KMeans CV on the cluster: folds with Spark, fits through the
+    barrier, silhouette scored via the two-pass executor-side partials
+    (ClusteringEvaluator).  Must reproduce the driver-local CV and never
+    collect the dataset."""
+    from spark_rapids_ml_tpu import KMeans
+    from spark_rapids_ml_tpu.evaluation import ClusteringEvaluator
+
+    rng = np.random.default_rng(2)
+    centers = rng.normal(size=(3, 6)) * 6
+    X = np.concatenate(
+        [rng.normal(size=(120, 6)) + c for c in centers]
+    ).astype(np.float32)
+    rng.shuffle(X)
+    pdf = pd.DataFrame({"features": list(X)})
+    sdf = _FakeSparkDataFrame(_split_pandas(pdf, 3))
+    facade = DataFrame.from_pandas(pdf, 3)
+
+    def _cv():
+        est = KMeans(seed=4, maxIter=20)
+        grid = ParamGridBuilder().addGrid(est.getParam("k"), [2, 3]).build()
+        return CrossValidator(
+            estimator=est,
+            estimatorParamMaps=grid,
+            evaluator=ClusteringEvaluator(),
+            numFolds=2,
+            seed=13,
+        )
+
+    got = _cv().fit(sdf)
+    want = _cv().fit(facade)
+    np.testing.assert_allclose(got.avgMetrics, want.avgMetrics, rtol=1e-6)
+    assert got.bestModel.getK() == want.bestModel.getK() == 3
+
+
+def test_clustering_evaluator_matches_sklearn_silhouette():
+    from sklearn.metrics import silhouette_score as sk_sil
+
+    from spark_rapids_ml_tpu.evaluation import ClusteringEvaluator
+
+    rng = np.random.default_rng(0)
+    X = np.concatenate(
+        [rng.normal(size=(80, 5)) + c for c in (0, 4, 9)]
+    ).astype(np.float32)
+    preds = np.repeat([0.0, 1.0, 2.0], 80)
+    pdf = pd.DataFrame({"features": list(X), "prediction": preds})
+    got = ClusteringEvaluator().evaluate(DataFrame.from_pandas(pdf, 3))
+    want = sk_sil(X, preds.astype(int), metric="sqeuclidean")
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+    # single-cluster predictions must raise like pyspark
+    one = pd.DataFrame({"features": list(X), "prediction": np.zeros(len(X))})
+    with pytest.raises(AssertionError):
+        ClusteringEvaluator().evaluate(DataFrame.from_pandas(one, 2))
