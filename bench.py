@@ -476,6 +476,19 @@ def build_arm(algo: str, overrides):
     raise SystemExit(f"unknown SRML_BENCH_ALGO={algo}")
 
 
+# measurement assumptions that must travel WITH the numbers (advisor
+# round-4: the caveat lived only in comments, so cross-framework
+# comparisons could silently drop it)
+ARM_NOTES = {
+    "knn": (
+        "timed region is model.kneighbors with the item index and query "
+        "upload pre-seeded in the model staging caches (the steady state "
+        "after one prior call on the same model); query/index ingest is "
+        "NOT in the clock"
+    ),
+}
+
+
 def run_arm(algo: str, overrides, repeats: int):
     """Build, warm up, and time one arm; returns its stats dict.  cold_sec
     records the first (warmup) call — compiles + device staging included —
@@ -485,7 +498,7 @@ def run_arm(algo: str, overrides, repeats: int):
     med, best = statistics.median(times), min(times)
     value = rows / med
     baseline = REF_ROWS / REF_GPU_SECONDS.get(algo, REF_GPU_SECONDS["kmeans"])
-    return {
+    out = {
         "metric": label,
         "value": round(value, 1),
         "unit": "rows/sec",
@@ -495,6 +508,9 @@ def run_arm(algo: str, overrides, repeats: int):
         "times_sec": [round(t, 3) for t in times],
         "cold_sec": round(cold, 3),
     }
+    if algo in ARM_NOTES:
+        out["notes"] = ARM_NOTES[algo]
+    return out
 
 
 def _release_arm_state():

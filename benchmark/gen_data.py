@@ -51,6 +51,12 @@ class DataGenBase:
             "--output_format", type=str, default="parquet", choices=["parquet", "csv"]
         )
         self._parser.add_argument("--random_state", type=int, default=1)
+        self._parser.add_argument(
+            "--distributed",
+            action="store_true",
+            help="generate/write each chunk inside a Spark executor task "
+            "(requires a live SparkSession and a shared output_dir)",
+        )
         self._add_extra_arguments()
         self.args = self._parser.parse_args(argv)
 
@@ -72,16 +78,7 @@ class DataGenBase:
         """Return (features (n_rows, D), labels (n_rows,) or None)."""
         raise NotImplementedError
 
-    def gen_dataframes(self) -> Iterator[pd.DataFrame]:
-        dtype = np.dtype(self.args.dtype)
-        for i, size in enumerate(self._chunk_sizes()):
-            X, y = self.gen_chunk(size, self.args.random_state + i)
-            pdf = pd.DataFrame(np.asarray(X, dtype=dtype), columns=self.feature_cols)
-            if y is not None:
-                pdf["label"] = np.asarray(y, dtype=dtype)
-            yield pdf
-
-    def write(self) -> None:
+    def _prepare_output_dir(self) -> str:
         out = self.args.output_dir
         if os.path.exists(out) and not self.args.overwrite:
             raise RuntimeError(f"{out} exists; pass --overwrite to replace")
@@ -91,14 +88,82 @@ class DataGenBase:
             # mixed dataset behind
             if stale.endswith(".parquet") or stale.endswith(".csv"):
                 os.remove(os.path.join(out, stale))
+        return out
+
+    def _chunk_frame(self, i: int, size: int) -> pd.DataFrame:
+        """Chunk i's dataframe — THE chunk law: content depends only on
+        (random_state + i, size), never on which process generates it.
+        This is what makes local and distributed generation byte-identical
+        file-for-file."""
+        dtype = np.dtype(self.args.dtype)
+        X, y = self.gen_chunk(size, self.args.random_state + i)
+        pdf = pd.DataFrame(np.asarray(X, dtype=dtype), columns=self.feature_cols)
+        if y is not None:
+            pdf["label"] = np.asarray(y, dtype=dtype)
+        return pdf
+
+    def _write_chunk(self, out: str, i: int, pdf: pd.DataFrame) -> str:
         fmt = self.args.output_format
-        for i, pdf in enumerate(self.gen_dataframes()):
-            path = os.path.join(out, f"part-{i:05d}.{fmt}")
-            if fmt == "csv":
-                pdf.to_csv(path, index=False)
-            else:
-                pdf.to_parquet(path, index=False)
+        path = os.path.join(out, f"part-{i:05d}.{fmt}")
+        if fmt == "csv":
+            pdf.to_csv(path, index=False)
+        else:
+            pdf.to_parquet(path, index=False)
+        return path
+
+    def gen_dataframes(self) -> Iterator[pd.DataFrame]:
+        for i, size in enumerate(self._chunk_sizes()):
+            yield self._chunk_frame(i, size)
+
+    def write(self) -> None:
+        out = self._prepare_output_dir()
+        for i, size in enumerate(self._chunk_sizes()):
+            self._write_chunk(out, i, self._chunk_frame(i, size))
         print(f"wrote {self.args.num_rows} rows x {self.args.num_cols} cols to {out}")
+
+    def write_distributed(self, spark) -> None:
+        """Generate as partition-parallel Spark tasks: the driver ships
+        only (chunk_id, n_rows) metadata; every chunk's rows are produced
+        AND written to the shared output dir inside an executor task
+        (mapInPandas), so a cluster-scale dataset never funnels through
+        the driver — the role of the reference's pandas-UDF generators
+        (gen_data_distributed.py:57-722).  Requires `output_dir` to be a
+        shared filesystem all executors mount (the tpu-vm cluster layout).
+        The per-chunk seed law makes the output byte-identical to the
+        local write() regardless of task placement."""
+        out = self._prepare_output_dir()
+        sizes = self._chunk_sizes()
+        meta = pd.DataFrame(
+            {"chunk_id": np.arange(len(sizes), dtype=np.int64),
+             "n_rows": np.asarray(sizes, dtype=np.int64)}
+        )
+        gen = self  # rides the task closure (args + generator code only)
+
+        def _gen_udf(iterator):
+            for pdf in iterator:
+                written = []
+                for _, row in pdf.iterrows():
+                    i = int(row["chunk_id"])
+                    written.append(
+                        gen._write_chunk(
+                            out, i, gen._chunk_frame(i, int(row["n_rows"]))
+                        )
+                    )
+                if written:
+                    yield pd.DataFrame({"path": written})
+
+        sdf = spark.createDataFrame(meta).repartition(len(sizes))
+        paths = [
+            r["path"]
+            for r in sdf.mapInPandas(_gen_udf, schema="path string").collect()
+        ]
+        assert len(paths) == len(sizes), (
+            f"distributed generation wrote {len(paths)} of {len(sizes)} chunks"
+        )
+        print(
+            f"wrote {self.args.num_rows} rows x {self.args.num_cols} cols to "
+            f"{out} ({len(paths)} executor-written parts)"
+        )
 
 
 class DefaultDataGen(DataGenBase):
@@ -238,7 +303,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     if not argv or argv[0] not in _REGISTERED:
         print(f"usage: gen_data.py [{'|'.join(_REGISTERED)}] [--args]", file=sys.stderr)
         raise SystemExit(1)
-    _REGISTERED[argv[0]](argv[1:]).write()
+    gen = _REGISTERED[argv[0]](argv[1:])
+    if gen.args.distributed:
+        from pyspark.sql import SparkSession
+
+        gen.write_distributed(SparkSession.builder.getOrCreate())
+    else:
+        gen.write()
 
 
 if __name__ == "__main__":

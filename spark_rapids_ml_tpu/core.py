@@ -446,26 +446,31 @@ class _TpuCaller(_TpuParams):
         cached = getattr(df, "_device_fit_inputs", None)
         if cached is not None and cached[0] == cache_key:
             return cached[1]
-        w_np = np.ones(n_rows, dtype=dtype)
+        # labels/weights are O(N) scalars — always at least float32: a
+        # bf16 from_device FEATURE array must not round them (integer
+        # class labels above 256 are not exact in bf16, silently
+        # corrupting label discovery and training targets)
+        ldtype = np.dtype(np.float32) if dtype.itemsize < 4 else dtype
+        w_np = np.ones(n_rows, dtype=ldtype)
         if weight_col is not None:
             w_np = np.concatenate(
                 [
-                    np.asarray(p[weight_col].to_numpy(), dtype=dtype)
+                    np.asarray(p[weight_col].to_numpy(), dtype=ldtype)
                     for p in df.partitions
                 ]
             )
-        mask = np.zeros(n_pad, dtype=dtype)
+        mask = np.zeros(n_pad, dtype=ldtype)
         mask[:n_rows] = w_np
         ws = jax.device_put(mask, data_sharding(mesh))
         ys = None
         if label_col is not None:
             y_np = np.concatenate(
                 [
-                    np.asarray(p[label_col].to_numpy(), dtype=dtype)
+                    np.asarray(p[label_col].to_numpy(), dtype=ldtype)
                     for p in df.partitions
                 ]
             )
-            y_pad = np.zeros(n_pad, dtype=dtype)
+            y_pad = np.zeros(n_pad, dtype=ldtype)
             y_pad[:n_rows] = y_np
             ys = jax.device_put(y_pad, data_sharding(mesh))
         inputs = FitInputs(

@@ -385,6 +385,12 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
                 f"narrower than the frame's feature dim ({dim}); the "
                 "seeded index would search truncated vectors"
             )
+        if prepared.n_items != rows:
+            raise ValueError(
+                f"prepared item count ({prepared.n_items}) != the frame's "
+                f"row count ({rows}); the seeded index would silently "
+                "serve results from a mismatched item set"
+            )
         self._staged_items = (self._staging_key(mesh, rows, dim), prepared)
         self._staged_queries.clear()
         if query_blocks:

@@ -284,12 +284,29 @@ def spectral_init(
     return spectral_from_layout(tails_pad, w_pad, n_components, seed)
 
 
+# layout-truncation tunables (env-overridable: hub-heavy graphs — e.g.
+# scale-free neighborhoods — can raise the cap or the quantile to keep
+# more hub edges at the cost of a wider per-epoch gather; the defaults
+# hold trustworthiness on i.i.d. AND power-law degree graphs, see
+# test_umap.test_hub_heavy_graph_layout_quality)
+def _layout_cap() -> int:
+    import os
+
+    return int(os.environ.get("SRML_UMAP_DEGREE_CAP", 36))
+
+
+def _layout_quantile() -> float:
+    import os
+
+    return float(os.environ.get("SRML_UMAP_DEGREE_QUANTILE", 0.98))
+
+
 def padded_head_layout(
     heads: np.ndarray,
     tails: np.ndarray,
     weights: np.ndarray,
     n: int,
-    cap: int = 36,
+    cap: int = 0,  # 0 = SRML_UMAP_DEGREE_CAP (default 36)
 ):
     """Static scatter-free edge layout for the SGD epochs: every undirected
     edge becomes two directed edges, grouped by head and padded to a fixed
@@ -321,8 +338,9 @@ def padded_head_layout(
     # of how many slots are real.  Nodes above the quantile lose only
     # their weakest edges (the weight-descending order below), the same
     # truncation the cap already applied to extreme hubs.
+    cap = cap or _layout_cap()
     nz = counts[counts > 0]
-    p98 = int(np.quantile(nz, 0.98)) if nz.size else 1
+    p98 = int(np.quantile(nz, _layout_quantile())) if nz.size else 1
     P = int(min(cap, max(8, p98, 1)))
     starts = np.cumsum(counts) - counts
     pos = np.arange(h2.size) - np.repeat(starts, counts)

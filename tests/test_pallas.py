@@ -138,7 +138,7 @@ def _knn_pool_topk(items, norms, valid, Q, k, m):
     [
         (2048, 128, 256, 16),    # aligned everything
         (2100, 300, 256, 10),    # ragged N (last group) and ragged D tail
-        (3000, 515, 384, 33),    # unaligned d, q above one tile
+        (2560, 515, 384, 33),    # unaligned d, ragged N, q above one tile
         (1024, 64, 130, 7),      # q pads up to a tile
     ],
 )

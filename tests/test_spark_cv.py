@@ -285,14 +285,14 @@ def test_cv_random_forest_single_pass_cluster_side():
     is patched to raise, so any driver collect fails loudly."""
     from spark_rapids_ml_tpu import RandomForestClassifier
 
-    X, _, y_cls = _data(n=300, d=5, seed=9)
+    X, _, y_cls = _data(n=200, d=4, seed=9)
     sdf, facade = _frames(X, y_cls)
 
     def _cv():
-        est = RandomForestClassifier(numTrees=5, maxDepth=4, seed=7)
+        est = RandomForestClassifier(numTrees=3, maxDepth=3, seed=7)
         grid = (
             ParamGridBuilder()
-            .addGrid(est.getParam("numTrees"), [3, 5])
+            .addGrid(est.getParam("numTrees"), [2, 3])
             .build()
         )
         return CrossValidator(

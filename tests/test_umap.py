@@ -226,3 +226,55 @@ def test_spectral_init_is_graph_smooth():
     c0, c1 = emb[labels == 0].mean(0), emb[labels == 1].mean(0)
     intra = np.mean([np.linalg.norm(emb[labels == c] - m, axis=1).mean() for c, m in ((0, c0), (1, c1))])
     assert np.linalg.norm(c0 - c1) > 1.5 * intra
+
+
+def test_hub_heavy_graph_layout_quality():
+    """Hub-heavy data (power-law radial density: a dense core whose points
+    become kNN hubs for the sparse shell) must keep trustworthiness — the
+    padded head layout truncates hub edges beyond the P98-degree pad width
+    (advisor round-4: validate beyond i.i.d. blobs).  Also checks the
+    SRML_UMAP_DEGREE_CAP tunable widens the layout."""
+    import os
+
+    from sklearn.manifold import trustworthiness
+
+    from spark_rapids_ml_tpu.ops.umap import padded_head_layout
+
+    rng = np.random.default_rng(6)
+    n, d = 300, 6
+    dirs = rng.standard_normal((n, d))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    r = rng.lognormal(mean=0.0, sigma=1.6, size=n)  # heavy-tailed radii
+    X = (dirs * r[:, None]).astype(np.float32)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+
+    def _fit_trust(cap, quantile):
+        os.environ["SRML_UMAP_DEGREE_CAP"] = str(cap)
+        os.environ["SRML_UMAP_DEGREE_QUANTILE"] = str(quantile)
+        try:
+            m = UMAP(n_neighbors=12, random_state=3, n_epochs=150).fit(df)
+        finally:
+            del os.environ["SRML_UMAP_DEGREE_CAP"]
+            del os.environ["SRML_UMAP_DEGREE_QUANTILE"]
+        return trustworthiness(X, m.embedding, n_neighbors=10)
+
+    t_default = _fit_trust(36, 0.98)
+    t_full = _fit_trust(200, 1.0)  # no hub truncation at all
+    # the claim under test: the P98/cap truncation does not degrade
+    # hub-heavy embeddings vs keeping every hub edge (measured here:
+    # 0.758 truncated vs 0.750 untruncated — heavy-tailed radial data is
+    # intrinsically hard to embed, the truncation is not the limiter)
+    assert t_default >= t_full - 0.03, (t_default, t_full)
+    assert t_default > 0.7, t_default
+
+    # the cap tunable must actually widen the padded layout
+    heads = np.repeat(np.arange(50), 40).astype(np.int64)
+    tails = rng.integers(0, 50, size=heads.size).astype(np.int64)
+    w = rng.random(heads.size).astype(np.float32) + 0.1
+    tp_default, _ = padded_head_layout(heads, tails, w, 50)
+    os.environ["SRML_UMAP_DEGREE_CAP"] = "80"
+    try:
+        tp_wide, _ = padded_head_layout(heads, tails, w, 50)
+    finally:
+        del os.environ["SRML_UMAP_DEGREE_CAP"]
+    assert tp_wide.shape[1] > tp_default.shape[1]
