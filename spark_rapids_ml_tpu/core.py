@@ -312,12 +312,18 @@ class _TpuCaller(_TpuParams):
             labels = []
         if weight_col is not None:
             weights = []
+        # labels/weights extract at >= float32 regardless of a low-precision
+        # FEATURE dtype (float32_inputs=False + f16/bf16 features): integer
+        # class labels above the half-precision mantissa are not exact and
+        # would silently corrupt label discovery — same rule as the
+        # from_device path (_build_fit_inputs_device)
+        ldtype = np.dtype(np.float32) if np.dtype(dtype).itemsize < 4 else dtype
         for part in df.partitions:
             feats.append(self._extract_partition_features(part, input_col, input_cols, dtype))
             if labels is not None:
-                labels.append(np.asarray(part[label_col].to_numpy(), dtype=dtype))
+                labels.append(np.asarray(part[label_col].to_numpy(), dtype=ldtype))
             if weights is not None:
-                weights.append(np.asarray(part[weight_col].to_numpy(), dtype=dtype))
+                weights.append(np.asarray(part[weight_col].to_numpy(), dtype=ldtype))
         return feats, labels, weights, dtype
 
     def _build_fit_inputs(
@@ -393,18 +399,20 @@ class _TpuCaller(_TpuParams):
                     (Xs, n_rows, n_cols, list(nonempty)),
                 )
         n_pad = Xs.shape[0]
+        # >= float32 for the O(N) label/weight vectors (see _pre_process_data)
+        ldtype = np.dtype(np.float32) if np.dtype(dtype).itemsize < 4 else dtype
         y_np = np.concatenate(labels) if labels is not None else None
         w_np = (
             np.concatenate(weights)
             if weights is not None
-            else np.ones(n_rows, dtype=dtype)
+            else np.ones(n_rows, dtype=ldtype)
         )
-        mask = np.zeros(n_pad, dtype=dtype)
+        mask = np.zeros(n_pad, dtype=ldtype)
         mask[:n_rows] = w_np
         ws = jax.device_put(mask, data_sharding(mesh))
         ys = None
         if y_np is not None:
-            y_pad = np.zeros(n_pad, dtype=dtype)
+            y_pad = np.zeros(n_pad, dtype=ldtype)
             y_pad[:n_rows] = y_np
             ys = jax.device_put(y_pad, data_sharding(mesh))
         pdesc = PartitionDescriptor.build(partition_rows, n_cols)

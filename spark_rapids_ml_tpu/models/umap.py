@@ -165,16 +165,40 @@ class UMAP(_UMAPParams, _TpuEstimator):
         sample_fraction = self.getSampleFraction()
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]):
+            import jax as _jax
+
             valid = np.asarray(inputs.weight) > 0
-            X = np.asarray(inputs.X)[valid]
-            y = np.asarray(inputs.y)[valid] if inputs.y is not None else None
             seed = params.get("random_state")
             seed = int(seed) & 0x7FFFFFFF if seed is not None else 42
-            if sample_fraction < 1.0:
-                rng = np.random.default_rng(seed)
-                keep = rng.random(X.shape[0]) < sample_fraction
-                X = X[keep]
-                y = y[keep] if y is not None else None
+            # device fast path: a from_device frame with no padding and no
+            # sampling never round-trips the feature array through the
+            # host link (the np.asarray fetch was 25 MB per fit at the
+            # bench shape, 0.3-0.6 s under tunnel congestion) — the kNN
+            # self-join consumes the device handle and raw_data_ stays a
+            # device array until save/serialize materializes it
+            # f32-only: a bf16/f16 frame would need a full-size f32 device
+            # COPY for raw_data_ (doubling HBM) — those take the host path.
+            # Note the trade the fast path makes: the fitted model's
+            # raw_data_ IS the frame's device array (no extra HBM, no
+            # fetch), so it stays resident while the model is alive;
+            # save/serialize materializes a host copy on demand.
+            device_fast = (
+                isinstance(inputs.X, _jax.Array)
+                and inputs.X.dtype == _jax.numpy.float32
+                and sample_fraction >= 1.0
+                and int(valid.sum()) == inputs.X.shape[0]
+            )
+            if device_fast:
+                X: Any = inputs.X
+                y = np.asarray(inputs.y)[valid] if inputs.y is not None else None
+            else:
+                X = np.asarray(inputs.X)[valid]
+                y = np.asarray(inputs.y)[valid] if inputs.y is not None else None
+                if sample_fraction < 1.0:
+                    rng = np.random.default_rng(seed)
+                    keep = rng.random(X.shape[0]) < sample_fraction
+                    X = X[keep]
+                    y = y[keep] if y is not None else None
             n = X.shape[0]
             if n == 0:
                 raise RuntimeError(
@@ -202,16 +226,8 @@ class UMAP(_UMAPParams, _TpuEstimator):
                 # When no row was filtered (no padding, no sampling) the
                 # search consumes the DEVICE-resident FitInputs.X directly
                 # instead of round-tripping it through the host link.
-                import jax as _jax
-
-                search_X: Any = X
-                if (
-                    isinstance(inputs.X, _jax.Array)
-                    and X.shape[0] == inputs.X.shape[0]
-                ):
-                    search_X = inputs.X
                 dists, ids = knn_search(
-                    search_X, np.arange(n, dtype=np.int64), search_X, k,
+                    X, np.arange(n, dtype=np.int64), X, k,
                     mesh, query_block=32768,
                 )
             a, b = params.get("a"), params.get("b")
@@ -258,16 +274,36 @@ class UMAPModel(_UMAPParams, _TpuModel):
         n_cols: int,
         dtype: str,
     ) -> None:
+        import jax as _jax
+
+        # raw_data_ may arrive as a DEVICE array (the from_device fit fast
+        # path): keep the handle — transform's prepare_items consumes it
+        # on device, and _get_model_attributes materializes a host copy
+        # only when persistence/serialization actually needs one
+        raw = (
+            raw_data_
+            if isinstance(raw_data_, _jax.Array)
+            else np.asarray(raw_data_)
+        )
         super().__init__(
             embedding_=np.asarray(embedding_),
-            raw_data_=np.asarray(raw_data_),
+            raw_data_=raw,
             n_cols=int(n_cols),
             dtype=str(dtype),
         )
         self.embedding_ = np.asarray(embedding_)
-        self.raw_data_ = np.asarray(raw_data_)
+        self.raw_data_ = raw
         self.n_cols = int(n_cols)
         self.dtype = str(dtype)
+
+    def _get_model_attributes(self) -> Dict[str, Any]:
+        attrs = self._model_attributes
+        if not isinstance(attrs.get("raw_data_"), np.ndarray):
+            # materialize the device-resident training set on first
+            # save/serialize; cached so repeat saves fetch once
+            attrs["raw_data_"] = np.asarray(attrs["raw_data_"])
+            self.raw_data_ = attrs["raw_data_"]
+        return attrs
 
     @property
     def embedding(self) -> np.ndarray:
