@@ -176,17 +176,22 @@ class UMAP(_UMAPParams, _TpuEstimator):
             # bench shape, 0.3-0.6 s under tunnel congestion) — the kNN
             # self-join consumes the device handle and raw_data_ stays a
             # device array until save/serialize materializes it
-            # f32-only: a bf16/f16 frame would need a full-size f32 device
-            # COPY for raw_data_ (doubling HBM) — those take the host path.
-            # Note the trade the fast path makes: the fitted model's
-            # raw_data_ IS the frame's device array (no extra HBM, no
-            # fetch), so it stays resident while the model is alive;
-            # save/serialize materializes a host copy on demand.
-            device_fast = (
+            # device-resident frame with no padding/sampling: the kNN
+            # self-join consumes the device handle for ANY dtype
+            # (prepare_items casts on device).  raw_data_ additionally
+            # stays a device array only for f32 frames — a bf16/f16
+            # frame would need a full-size f32 device COPY (doubling
+            # HBM), so those fetch raw_data_ to the host as before.
+            # Trade of the f32 path: raw_data_ IS the frame's array (no
+            # extra HBM, no fetch) and stays resident while the model is
+            # alive; save/serialize materializes a host copy on demand.
+            device_search = (
                 isinstance(inputs.X, _jax.Array)
-                and inputs.X.dtype == _jax.numpy.float32
                 and sample_fraction >= 1.0
                 and int(valid.sum()) == inputs.X.shape[0]
+            )
+            device_fast = (
+                device_search and inputs.X.dtype == _jax.numpy.float32
             )
             if device_fast:
                 X: Any = inputs.X
@@ -226,8 +231,9 @@ class UMAP(_UMAPParams, _TpuEstimator):
                 # When no row was filtered (no padding, no sampling) the
                 # search consumes the DEVICE-resident FitInputs.X directly
                 # instead of round-tripping it through the host link.
+                search_X: Any = inputs.X if device_search else X
                 dists, ids = knn_search(
-                    X, np.arange(n, dtype=np.int64), X, k,
+                    search_X, np.arange(n, dtype=np.int64), search_X, k,
                     mesh, query_block=32768,
                 )
             a, b = params.get("a"), params.get("b")

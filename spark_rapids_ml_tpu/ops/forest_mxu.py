@@ -58,6 +58,10 @@ from .forest_hist import (
 )
 from .precompile import aval, global_precompiler
 
+import logging
+
+logger = logging.getLogger("spark_rapids_ml_tpu.forest_mxu")
+
 _LANE = _ROW_TILE
 
 
@@ -736,6 +740,17 @@ def _deep_phase(
             classes.setdefault(cls_cap, []).append(
                 (t, b, int(starts[t, b]), seg_cap)
             )
+    if logger.isEnabledFor(logging.DEBUG):
+        real = int(counts.sum())
+        tile_rows = int(aligned.sum())
+        class_rows = sum(cap * len(segs) for cap, segs in classes.items())
+        logger.debug(
+            "deep geometry: %d real rows -> %d tile-aligned (%.2fx) -> "
+            "%d class-padded (%.2fx) across %d classes / %d segments",
+            real, tile_rows, tile_rows / max(real, 1),
+            class_rows, class_rows / max(real, 1),
+            len(classes), sum(len(s) for s in classes.values()),
+        )
 
     # --- submit every remaining geometry for parallel compilation ---------
     # The heavy kernels (_deep_step/_deep_leaf) are keyed ONLY by their

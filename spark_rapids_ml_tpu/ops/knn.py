@@ -1243,6 +1243,16 @@ def knn_search_prepared(
                 prepared.items, prepared.norm, prepared.pos, prepared.valid,
                 qd_b, mesh, k,
             )
+            # start the result transfers as soon as each block's compute
+            # finishes — the 13 MB/block fetch is the arm's dominant
+            # variance term under tunnel congestion, and an async copy
+            # overlaps it with the NEXT block's compute instead of paying
+            # it inside the blocking device_get
+            for h in handles:
+                try:
+                    h.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    break
             pending.append((handles, n_q))
 
         def _collect_a():

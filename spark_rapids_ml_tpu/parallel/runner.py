@@ -243,9 +243,18 @@ class DistributedFitSession:
         share = -(-max_rank_rows // local_dev) * local_dev
         n_pad = share * self.nranks
 
-        def _to_global(local_cols: int, fill: Optional[np.ndarray], is_2d: bool):
+        # labels/weights ride >= float32 buffers regardless of a low-
+        # precision FEATURE dtype — same rule as the single-controller
+        # ingest (core._pre_process_data): a bf16 buffer would round
+        # integer class labels above the half-precision mantissa
+        ldtype = np.dtype(np.float32) if np.dtype(dtype).itemsize < 4 else dtype
+
+        def _to_global(
+            local_cols: int, fill: Optional[np.ndarray], is_2d: bool,
+            buf_dtype=None,
+        ):
             shape = (share, local_cols) if is_2d else (share,)
-            buf = np.zeros(shape, dtype=dtype)
+            buf = np.zeros(shape, dtype=buf_dtype or dtype)
             if fill is not None and fill.shape[0]:
                 buf[: fill.shape[0]] = fill
             gshape = (n_pad, local_cols) if is_2d else (n_pad,)
@@ -268,16 +277,16 @@ class DistributedFitSession:
         w_loc = (
             np.concatenate(weights)
             if weights  # None or [] (empty rank) -> valid-row ones mask
-            else np.ones(n_loc, dtype=dtype)
+            else np.ones(n_loc, dtype=ldtype)
         )
-        ws = _to_global(0, w_loc, is_2d=False)
+        ws = _to_global(0, w_loc, is_2d=False, buf_dtype=ldtype)
 
         ys = None
         if labels is not None:
             y_loc = (
-                np.concatenate(labels) if labels else np.zeros(0, dtype=dtype)
+                np.concatenate(labels) if labels else np.zeros(0, dtype=ldtype)
             )
-            ys = _to_global(0, y_loc, is_2d=False)
+            ys = _to_global(0, y_loc, is_2d=False, buf_dtype=ldtype)
 
         return FitInputs(
             X=Xs,
