@@ -210,7 +210,7 @@ def _knn_topm_kernel(
 
 
 def _knn_topm_kernel_qres(
-    qn_ref, inorm_ref, qhi_ref, qlo_ref, it_ref, vals_ref, idx_ref,
+    qn_ref, inorm_ref, q_ref, it_ref, vals_ref, idx_ref,
     acc, ith, itl,
     *, m: int, m_pad: int, n_items: int, tile_i: int, d_true: int, kd: int,
     tq: int,
@@ -221,10 +221,14 @@ def _knn_topm_kernel_qres(
     the multi-GB item set crosses HBM ONCE per (j, b) instead of once per
     query tile (the (i, j, b) grid re-read it q_pad/tq times: 157 GB at
     the 400k x 3000 bench shape).  The item block's bf16 hi/lo split is
-    computed once per block (at i == 0) into scratch, and the QUERY hi/lo
-    split arrives precomputed (same bytes/elem as the f32 it replaces) —
-    the inner loop is exactly three MXU dots + the accumulate, no VPU
-    cast traffic.  Costs a (q_pad, tile_i) f32 accumulator slab in VMEM
+    computed once per block (at i == 0) into scratch; the QUERY hi/lo
+    split happens IN-KERNEL like _accum_dot's — precomputing it in XLA
+    was measured precision-UNSAFE on this backend: the terminal forces
+    --xla_allow_excess_precision=true, which legally cancels the
+    f32 -> bf16 -> f32 round-trip so q_lo folds to ZERO and the scan
+    silently degrades to ~1-pass bf16 (d2 abs err 0.14 vs 4e-4; caught
+    by the hardware audit vs f64 ground truth).  Mosaic performs the
+    casts as written.  Costs a (q_pad, tile_i) f32 accumulator slab in VMEM
     (32 MB at 8192 queries x 1024 items) because every query tile's
     accumulation is in flight at once — the wrapper gates on that budget
     and falls back to the (i, j, b) kernel past it."""
@@ -246,8 +250,9 @@ def _knn_topm_kernel_qres(
 
     single = d_true <= kd  # whole D in one K block: no cross-step state
 
-    q_hi = qhi_ref[:]
-    q_lo = qlo_ref[:]
+    q = q_ref[:]
+    q_hi = q.astype(jnp.bfloat16)
+    q_lo = (q - q_hi.astype(jnp.float32)).astype(jnp.bfloat16)
     it_hi = ith[:]
     it_lo = itl[:]
     dots = (
@@ -298,7 +303,7 @@ def _knn_count_kernel(
 
     @pl.when(kb == pl.num_programs(2) - 1)
     def _():
-        neg = _neg_d2(qn_ref, inorm_ref, acc, j, n_items, tile_i)
+        neg = _neg_d2(qn_ref, inorm_ref, acc[:], j, n_items, tile_i)
         cnt = jnp.sum(neg > t_ref[:], axis=1).astype(jnp.int32)
         out_ref[:] += cnt[:, None]
 
@@ -382,8 +387,6 @@ def knn_candidates_pallas(
     if use_qres:
         # query-resident-accumulator grid: item blocks cross HBM once per
         # (group, D-block) instead of once per query tile (kernel header)
-        q_hi = qp.astype(jnp.bfloat16)
-        q_lo = (qp - q_hi.astype(jnp.float32)).astype(jnp.bfloat16)
         vals, idxs = pl.pallas_call(
             functools.partial(
                 _knn_topm_kernel_qres,
@@ -394,7 +397,6 @@ def knn_candidates_pallas(
             in_specs=[
                 pl.BlockSpec((tq, 1), lambda j, b, i: (i, 0), memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, tile_i), lambda j, b, i: (0, j), memory_space=pltpu.VMEM),
-                pl.BlockSpec((tq, kb), lambda j, b, i: (i, b), memory_space=pltpu.VMEM),
                 pl.BlockSpec((tq, kb), lambda j, b, i: (i, b), memory_space=pltpu.VMEM),
                 pl.BlockSpec((tile_i, kb), lambda j, b, i: (j, b), memory_space=pltpu.VMEM),
             ],
@@ -423,7 +425,7 @@ def knn_candidates_pallas(
                 vmem_limit_bytes=100 << 20
             ),
             interpret=interpret,
-        )(qn, inorm, q_hi, q_lo, items)
+        )(qn, inorm, qp, items)
     else:
         vals, idxs = pl.pallas_call(
             functools.partial(

@@ -228,3 +228,39 @@ def test_bin_features_pallas_matches_xla(n, d, b, n_pad):
     assert got.shape == (d, n_pad)
     np.testing.assert_array_equal(got[:, :n], want)
     assert (got[:, n:] == 0).all(), "padding rows must be bin 0"
+
+
+def test_knn_audit_pair_runs_and_agrees():
+    """The SRML_KNN_AUDIT_COUNT=1 route (legacy candidates kernel + count
+    kernel, bitwise-paired) must still run — it is the ground-truth audit
+    for the default self-verify route — and agree with it on clean data.
+    Regression guard: the count kernel's _neg_d2 call broke when the
+    helper moved to value inputs and no default-CI test exercised the
+    pallas audit pairing."""
+    import spark_rapids_ml_tpu.ops.knn as knn_mod
+    from spark_rapids_ml_tpu.ops.pallas_knn import knn_count_pallas
+
+    rng = np.random.default_rng(21)
+    n, d, q, k = 1536, 128, 256, 9
+    items = rng.standard_normal((n, d)).astype(np.float32)
+    Q = rng.standard_normal((q, d)).astype(np.float32)
+    norms = (items**2).sum(axis=1)
+    valid = np.ones(n, bool)
+    m = max(_select_m(k, 1024, n), k)
+
+    cv, ci = knn_candidates_pallas(
+        jnp.asarray(items), jnp.asarray(norms), jnp.asarray(valid),
+        jnp.asarray(Q), k, m, n, interpret=KERNEL_INTERPRET, legacy=True,
+    )
+    fv, fpos, tu, sg = knn_mod._adaptive_merge(cv, ci, k)
+    sa = knn_count_pallas(
+        jnp.asarray(items), jnp.asarray(norms), jnp.asarray(valid),
+        jnp.asarray(Q), tu, n, interpret=KERNEL_INTERPRET,
+    )
+    np.testing.assert_array_equal(np.asarray(sg), np.asarray(sa))
+    # and the audit merge agrees with the self-verify route's results
+    fv_s, fpos_s, flags, _z = _adaptive_merge_self(cv, ci, k, m=m)
+    assert not np.asarray(flags).any()
+    np.testing.assert_allclose(
+        np.asarray(fv_s), np.sqrt(np.maximum(-np.asarray(fv), 0)), rtol=1e-5
+    )
