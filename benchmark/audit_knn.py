@@ -1,0 +1,79 @@
+# Hardware ground-truth audit of the adaptive kNN's verification contract.
+#
+# Runs BOTH verification routes at a substantial shape on the real device —
+# the default pool-resident self-verify and the SRML_KNN_AUDIT_COUNT=1
+# bitwise count pair — and scores each against float64 brute-force ground
+# truth for a query sample.  This is the check that caught the round-5
+# precision regression (XLA's --xla_allow_excess_precision folding a
+# precomputed bf16 hi/lo split to zero): the CPU test suite cannot see
+# Mosaic/XLA hardware lowering differences, so run this after ANY change
+# to ops/pallas_knn.py or the adaptive phases.
+#
+#   python benchmark/audit_knn.py [n_items] [d] [k]
+#
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/srml_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def main():
+    import os
+
+    import spark_rapids_ml_tpu.ops.knn as knn_mod
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 3000
+    k = int(sys.argv[3]) if len(sys.argv) > 3 else 200
+    qn = 8192
+
+    rng = np.random.default_rng(123)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    mesh = get_mesh()
+    p = knn_mod.prepare_items(X, np.arange(n, dtype=np.int64), mesh)
+    Q = X[:qn] + 1e-3  # near-duplicates force tight distances
+    qd = jnp.pad(jnp.asarray(Q), ((0, 0), (0, p.items.shape[1] - d)))
+    args = (p.items, p.norm, p.pos, p.valid, qd, mesh, k)
+
+    _, fp_s, flags, zeros = jax.device_get(
+        knn_mod.knn_block_adaptive_dispatch(*args)
+    )
+    os.environ["SRML_KNN_AUDIT_COUNT"] = "1"
+    try:
+        _, fp_a, sg, sa = jax.device_get(
+            knn_mod.knn_block_adaptive_dispatch(*args)
+        )
+    finally:
+        del os.environ["SRML_KNN_AUDIT_COUNT"]
+
+    ids_s, ids_a = p.ids[fp_s], p.ids[fp_a]
+    Xd = X.astype(np.float64)
+    tot_s = tot_a = 0.0
+    cnt = 0
+    for i in range(0, qn, 1024):  # f64 brute force is host-bound; sample
+        d2 = ((Xd - Q[i].astype(np.float64)) ** 2).sum(axis=1)
+        order = np.argsort(d2)[:k]
+        tot_s += len(np.intersect1d(ids_s[i], order)) / k
+        tot_a += len(np.intersect1d(ids_a[i], order)) / k
+        cnt += 1
+    print(
+        f"self-verify flags: {int((flags != zeros).sum())}   "
+        f"audit count mismatches: {int((sg != sa).sum())}"
+    )
+    print(
+        f"top-k set agreement vs f64 truth — self: {tot_s / cnt:.5f}   "
+        f"audit: {tot_a / cnt:.5f}"
+    )
+    ok = tot_s / cnt > 0.999 and tot_a / cnt > 0.999
+    print("AUDIT PASS" if ok else "AUDIT FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
