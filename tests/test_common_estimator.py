@@ -275,3 +275,58 @@ def test_from_numpy_scipy_sparse():
     df = DataFrame.from_numpy(sp.csr_matrix(Xd), num_partitions=2)
     model = TpuDummy().fit(df)
     np.testing.assert_allclose(model.mean, Xd.mean(axis=0), atol=1e-5)
+
+
+def test_low_precision_features_keep_float32_labels():
+    """A half/bfloat16 FEATURE dtype must never round labels: integer
+    values above the half-precision mantissa (e.g. 2049 in f16, 257 in
+    bf16) have to survive ingest exactly on all three paths — host
+    partitions, from_device frames, and the multicontroller global build
+    (parallel/runner.DistributedFitSession).  weightCol is unsupported by
+    every estimator (reference parity), so only the default ones-mask
+    weight dtype is assertable."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu import LinearRegression
+    from spark_rapids_ml_tpu.parallel.context import LocalControlPlane
+    from spark_rapids_ml_tpu.parallel.runner import DistributedFitSession
+
+    n, d = 32, 4
+    rng = np.random.default_rng(0)
+    X16 = rng.standard_normal((n, d)).astype(np.float16)
+    labels = (2048 + np.arange(n)).astype(np.float64)  # 2049 rounds in f16
+
+    est = LinearRegression(float32_inputs=False)
+    pdf = pd.DataFrame({"features": list(X16), "label": labels})
+    df = DataFrame.from_pandas(pdf, 2)
+    feats, labs, _ws, dtype = est._pre_process_data(df)
+    assert np.dtype(dtype) == np.float16  # features keep their precision
+    y = np.concatenate(labs)
+    assert y.dtype == np.float32
+    np.testing.assert_array_equal(y, labels)  # no rounding
+
+    inputs = est._build_fit_inputs(df)
+    np.testing.assert_array_equal(
+        np.asarray(inputs.y)[: inputs.n_rows], labels
+    )
+    assert np.asarray(inputs.weight).dtype == np.float32
+
+    # the multicontroller global build (rank 0 of 1 over the local mesh)
+    sess = DistributedFitSession(0, 1, LocalControlPlane())
+    inputs_mc = sess.build_fit_inputs(est, df)
+    np.testing.assert_array_equal(
+        np.asarray(inputs_mc.y)[: inputs_mc.n_rows], labels
+    )
+    assert np.asarray(inputs_mc.weight).dtype == np.float32
+
+    # from_device with a bf16 feature array
+    Xd = jax.device_put(rng.standard_normal((n, d)).astype(np.float32)).astype(
+        jnp.bfloat16
+    )
+    labels_b = (256 + np.arange(n)).astype(np.float64)  # 257 rounds in bf16
+    dfd = DataFrame.from_device(Xd, y=labels_b)
+    inputs2 = est._build_fit_inputs(dfd)
+    np.testing.assert_array_equal(
+        np.asarray(inputs2.y)[: inputs2.n_rows], labels_b
+    )
