@@ -28,6 +28,15 @@ if os.environ.get("SRML_TPU_TESTS") != "1":
     # same role as a restored build cache.  First run on a cold cache
     # pays full compiles; ci/test.sh prints the wall-clock either way.
     # SRML_TEST_NO_CACHE=1 forces cold-compile timings.
+    #
+    # KNOWN LIMIT of this jax/XLA build (not of the framework): ONE
+    # pytest process running the ENTIRE suite with --runslow (default +
+    # slow, ~310 tests, ~600 resident executables) segfaults inside XLA
+    # CPU compilation near the end (reproduced 3x at the same tests,
+    # with AND without this cache, 128 GB RAM free, map count far under
+    # the limit).  Run full coverage the way ci/test.sh does — the
+    # default suite and the slow remainder (--runslow -m slow) as two
+    # processes — which passes reliably.
     if os.environ.get("SRML_TEST_NO_CACHE") != "1":
         jax.config.update(
             "jax_compilation_cache_dir",
