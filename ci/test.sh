@@ -5,9 +5,14 @@ set -ex
 
 cd "$(dirname "$0")/.."
 
-# 1. lint / static checks (byte-compile everything; mypy/black optional in
-#    this image)
+# 1. lint / static checks: byte-compile everything (mypy/black optional in
+#    this image), then graftlint — the JAX/TPU invariant checker (R1-R5:
+#    hidden host syncs, recompile risk, unbound collective axis names,
+#    nondeterministic RNG/set-order, float64 in solver kernels; see
+#    docs/graftlint.md).  Fails on ANY finding and prints the per-rule
+#    count; use --baseline to land a new rule warn-only first.
 python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
+python -m tools.graftlint spark_rapids_ml_tpu benchmark
 
 # 2. native runtime build
 make -C native

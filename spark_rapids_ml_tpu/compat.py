@@ -1,0 +1,61 @@
+#
+# jax version-compatibility shims.
+#
+# The framework targets the moving jax API surface across the versions the
+# fleet actually runs (TPU-VM images pin different jax releases than dev
+# boxes): `shard_map` graduated from jax.experimental to the jax namespace
+# and renamed its replication-check kwarg (check_rep -> check_vma), and
+# `enable_x64` lives in jax.experimental on older releases.  Every module
+# imports these names from here instead of guessing which jax it is on.
+#
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import jax
+
+try:  # jax >= 0.6: top-level export, kwarg is check_vma
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental home, kwarg is check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """jax shard_map with the replication-check kwarg normalized to the
+    new-style name (check_vma) regardless of the installed jax."""
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
+
+
+def tpu_compiler_params(**kwargs: Any) -> Any:
+    """Pallas TPU compiler-params struct across the rename
+    (TPUCompilerParams -> CompilerParams)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kwargs)
+
+
+def enable_x64(enabled: bool = True) -> Any:
+    """Context manager enabling 64-bit jax types for its scope (jax
+    .enable_x64 where available, jax.experimental.enable_x64 otherwise)."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enabled)
+    if not enabled:
+        return contextlib.nullcontext()
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64()

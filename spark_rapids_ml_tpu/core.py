@@ -40,7 +40,7 @@ from .dataframe import DataFrame, FEATURE_BLOCK_ATTR, as_dataframe
 from .params import Param, Params, _TpuParams
 from .parallel.mesh import get_mesh, shard_rows, data_sharding
 from .parallel.partition import PartitionDescriptor
-from .utils import get_logger, stack_feature_cells
+from .utils import get_logger, materialize_feature_block
 
 
 def _is_pyspark_dataframe(dataset: Any) -> bool:
@@ -64,7 +64,9 @@ def _maybe_x64(dtype: Any):
     import contextlib
 
     if np.dtype(dtype) == np.float64:
-        return jax.enable_x64(True)
+        from .compat import enable_x64
+
+        return enable_x64(True)
     return contextlib.nullcontext()
 
 
@@ -114,17 +116,12 @@ def extract_partition_features(
     (transform-evaluate, kneighbors ingest) MUST use this instead of reading
     the column directly: sparse partitions carry a placeholder column whose
     cells are row positions, not features."""
-    if input_col is not None:
-        block = _partition_feature_block(part, input_col)
-        if block is not None and hasattr(block, "tocsr"):
-            if densify_sparse:
-                return np.asarray(block.toarray(), dtype=dtype)
-            return block
-        if block is not None:
-            return np.asarray(block, dtype=dtype)
-        return stack_feature_cells(part[input_col].tolist(), dtype)
-    assert input_cols is not None
-    return np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+    block = (
+        _partition_feature_block(part, input_col) if input_col is not None else None
+    )
+    return materialize_feature_block(
+        block, part, input_col, input_cols, dtype, densify_sparse=densify_sparse
+    )
 
 
 _SinglePdDataFrameBatchType = Tuple[pd.DataFrame, Optional[pd.DataFrame]]
@@ -266,24 +263,21 @@ class _TpuCaller(_TpuParams):
     def _extract_partition_features(
         self, part: pd.DataFrame, input_col: Optional[str], input_cols: Optional[List[str]], dtype: np.dtype
     ) -> np.ndarray:
-        if input_col is not None:
-            block = _partition_feature_block(part, input_col)
-            if block is not None and hasattr(block, "tocsr"):
-                if self._supports_sparse_input:
-                    return block  # CSR stays sparse through to ELL ingest
-                get_logger(type(self)).warning(
-                    "%s has no sparse path; densifying the CSR partition",
-                    type(self).__name__,
-                )
-                return np.asarray(block.toarray(), dtype=dtype)
-            if block is not None:
-                return np.asarray(block, dtype=dtype)
-            cells = part[input_col].tolist()
-            if len(cells) == 0:
-                return np.zeros((0, 0), dtype=dtype)
-            return stack_feature_cells(cells, dtype)
-        assert input_cols is not None
-        return np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+        block = (
+            _partition_feature_block(part, input_col) if input_col is not None else None
+        )
+        return materialize_feature_block(
+            block,
+            part,
+            input_col,
+            input_cols,
+            dtype,
+            densify_sparse=not self._supports_sparse_input,
+            on_densify=lambda: get_logger(type(self)).warning(
+                "%s has no sparse path; densifying the CSR partition",
+                type(self).__name__,
+            ),
+        )
 
     def _fit_label_col(self) -> Optional[str]:
         """Column to extract as ``FitInputs.y``, or None.  Supervised
@@ -552,8 +546,10 @@ class _TpuCaller(_TpuParams):
                 "Invoking TPU fit: %d rows x %d cols on %d-device mesh",
                 inputs.n_rows, inputs.n_cols, inputs.mesh.devices.size,
             )
+            from .sanitize import sanitize_scope
+
             with profiling.maybe_trace(type(self).__name__):
-                with profiling.phase("srml.fit"):
+                with profiling.phase("srml.fit"), sanitize_scope():
                     result = fit_func(inputs, dict(self._tpu_params))
         self._last_fit_phase_times = profiling.phase_times()
         return result
@@ -772,17 +768,16 @@ class _TpuModel(_TpuParams):
                 if input_col is not None
                 else None
             )
-            if block is not None and hasattr(block, "tocsr"):
-                if self._supports_sparse_input:
-                    feats = block  # model transform converts CSR -> ELL
-                else:
-                    feats = np.asarray(block.toarray(), dtype=dtype)
-            elif block is not None:
-                feats = np.asarray(block, dtype=dtype)
-            elif input_col is not None:
-                feats = stack_feature_cells(part[input_col].tolist(), dtype)
-            else:
-                feats = np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+            # sparse partitions stay CSR when the model has a sparse path
+            # (its transform converts CSR -> ELL)
+            feats = materialize_feature_block(
+                block,
+                part,
+                input_col,
+                input_cols,
+                dtype,
+                densify_sparse=not self._supports_sparse_input,
+            )
             new_part = part.copy()
             outputs = transform_fn(feats)
             for name, values in outputs.items():

@@ -64,7 +64,7 @@ def linreg_sufficient_stats(
         y2 = (y * y * w).sum()
         return LinregStats(wsum, x_mean, y_mean, G, c, y2)
 
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS

@@ -25,8 +25,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from ..compat import shard_map
 from ..parallel.mesh import DATA_AXIS
 
 

@@ -22,7 +22,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS, data_sharding, get_mesh
@@ -1079,7 +1079,28 @@ def distributed_kneighbors(
         allgather_bytes, alltoall_bytes, pack_arrays, unpack_arrays,
     )
 
-    mesh = mesh or get_mesh(None)
+    if mesh is None:
+        if nranks > 1 and jax.process_count() == 1:
+            # Thread-mocked ranks (the docstring's "threads" launcher: every
+            # rank lives in THIS process, so jax.process_count() == 1 while
+            # nranks > 1): carve DISJOINT per-rank submeshes.  This is the
+            # faithful topology — a real rank owns its own chips — and it is
+            # load-bearing on the virtual CPU mesh: XLA:CPU's cross_module
+            # rendezvous deadlocks when two multi-device programs from
+            # different threads interleave their per-device enqueue order on
+            # SHARED devices (reproduced: 4 threads x shard_map psum on one
+            # 8-device mesh wedge in seconds; disjoint submeshes run clean).
+            devs = jax.devices()
+            per = len(devs) // nranks
+            if per >= 1:
+                local = devs[rank * per : (rank + 1) * per]
+            else:
+                # more ranks than devices: one device per rank (single-
+                # device programs have no cross-program rendezvous)
+                local = [devs[rank % len(devs)]]
+            mesh = Mesh(np.array(local), (DATA_AXIS,))
+        else:
+            mesh = get_mesh(None)
     q_feats = [np.asarray(f, dtype=dtype) for f, _ in query_parts]
     q_ids = [np.asarray(i, np.int64) for _, i in query_parts]
     q_rows = [f.shape[0] for f in q_feats]

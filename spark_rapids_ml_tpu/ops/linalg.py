@@ -126,7 +126,7 @@ def _local_moments(
 
 def _sharded_moments(X: jax.Array, w: jax.Array, mesh, chunk: int):
     """(wsum, mean, scatter) via per-shard chunked scans + one psum."""
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def per_device(X_loc, w_loc):
@@ -365,12 +365,18 @@ def pca_fit(
     from .. import native
 
     wsum_d, mean_d, cov_d = covariance_kernel(X, w, mesh=mesh)
-    wsum = float(np.asarray(wsum_d))
-    mean = np.asarray(mean_d, dtype=np.float64)
-    cov = np.asarray(cov_d, dtype=np.float64)
+    # one batched explicit fetch (three implicit np.asarray/float coercions
+    # each paid their own device round-trip and tripped the SRML_SANITIZE
+    # transfer guard)
+    wsum_h, mean_h, cov_h = jax.device_get((wsum_d, mean_d, cov_d))
+    wsum = float(wsum_h)
+    # the host eigh deliberately runs in f64 — fetched host arrays, not
+    # device math (native.eigh_descending matches calSVD's f64 semantics)
+    mean = mean_h.astype(np.float64)  # graftlint: disable=R5 (host-side eigh input)
+    cov = cov_h.astype(np.float64)  # graftlint: disable=R5 (host-side eigh input)
     evals, comps = native.eigh_descending(cov)
     top = np.maximum(evals[:k], 0.0)
-    total = max(evals.sum(), np.finfo(np.float64).tiny)
+    total = max(evals.sum(), np.finfo(np.float64).tiny)  # graftlint: disable=R5 (host-side f64 epsilon)
     return (
         mean,
         comps[:k],

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..compat import tpu_compiler_params
 from .pallas_tpu import _round_up, pallas_enabled
 
 # tile geometry: TQ queries x TI items per grid cell, D consumed in KB-wide
@@ -421,7 +422,7 @@ def knn_candidates_pallas(
                 pltpu.VMEM((tile_i, kb), jnp.bfloat16),
                 pltpu.VMEM((tile_i, kb), jnp.bfloat16),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 vmem_limit_bytes=100 << 20
             ),
             interpret=interpret,
@@ -447,7 +448,7 @@ def knn_candidates_pallas(
             # (tq, tile_i) f32 temporaries at once; the default 16 MB
             # scoped budget caps the tile at (256, 1024) — larger query
             # tiles need the raised limit
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 vmem_limit_bytes=96 << 20
             ),
             interpret=interpret,

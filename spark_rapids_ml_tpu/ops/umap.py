@@ -494,6 +494,7 @@ def umap_fit_embedding(
     )
     if y is not None:
         codes = np.full(n, -1, dtype=np.int32)
+        # graftlint: disable=R5 (host-side label-finiteness check; f64 holds any label dtype exactly)
         finite = np.isfinite(np.asarray(y, dtype=np.float64))
         _, inv = np.unique(np.asarray(y)[finite], return_inverse=True)
         codes[finite] = inv.astype(np.int32)

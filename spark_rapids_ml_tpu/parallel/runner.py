@@ -122,7 +122,7 @@ def global_mesh() -> Mesh:
 
 def _encode_value(v: Any) -> Any:
     if isinstance(v, jax.Array):
-        v = np.asarray(v)
+        v = jax.device_get(v)  # explicit fetch: sanitize-scope clean
     if isinstance(v, np.ndarray):
         return {
             "__ndarray__": base64.b64encode(
@@ -324,7 +324,10 @@ class DistributedFitSession:
         df = DataFrame(list(partitions))
         inputs = self.build_fit_inputs(estimator, df)
         fit_func = estimator._get_tpu_fit_func(df, extra_params)
-        result = fit_func(inputs, dict(estimator._tpu_params))
+        from ..sanitize import sanitize_scope
+
+        with sanitize_scope():
+            result = fit_func(inputs, dict(estimator._tpu_params))
         self.control_plane.barrier()
         results = result if isinstance(result, list) else [result]
         return [encode_attrs(r) for r in results]

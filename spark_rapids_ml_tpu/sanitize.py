@@ -1,0 +1,74 @@
+#
+# Runtime sanitizer: the dynamic half of graftlint (tools/graftlint is the
+# static half — see docs/graftlint.md).
+#
+# SRML_SANITIZE=1 wraps every solver invocation (core._call_tpu_fit_func and
+# parallel/runner.DistributedFitSession.fit) in
+#
+#   - jax.transfer_guard_device_to_host("disallow"): any IMPLICIT
+#     device->host transfer inside a fit — np.asarray/float()/.item() on a
+#     device array, a np. reduction over a jnp result — raises instead of
+#     silently stalling the dispatch pipeline.  Explicit fetches
+#     (jax.device_get) stay allowed: batched end-of-fit materialization is
+#     the sanctioned pattern (graftlint R1).  NOTE: on the CPU backend
+#     device buffers ARE host memory, so this guard only bites on real
+#     TPU/GPU runs; CI still exercises the scope so the wiring cannot rot.
+#   - jax.debug_nans(True): a NaN produced anywhere in a jitted solver
+#     re-runs un-jitted and raises at the originating primitive.
+#
+# Host->device is NOT guarded: solvers deliberately take hyperparameters as
+# dynamic scalar args (uploading a scalar per fit is how they avoid a
+# recompile per value — graftlint R2), and those uploads would trip a
+# blanket "disallow".
+#
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import jax
+
+
+def enabled() -> bool:
+    """Whether SRML_SANITIZE=1 is set (read per call: tests toggle it)."""
+    return os.environ.get("SRML_SANITIZE", "0") == "1"
+
+
+@contextlib.contextmanager
+def sanitize_scope() -> Iterator[None]:
+    """Transfer-guard or NaN-check scope around one solver invocation; a
+    no-op unless SRML_SANITIZE=1.
+
+    The two checks are mutually exclusive BY CONSTRUCTION: debug_nans'
+    post-execution check fetches every jitted output (np.asarray in jax's
+    dispatch posthook) — an IMPLICIT device->host transfer that would trip
+    the guard itself on every fit.  So each backend runs the check that
+    works there: accelerators get the transfer guard (debug_nans explicitly
+    OFF inside the scope, even if enabled globally), the CPU backend gets
+    NaN checking (the guard is inert there anyway — device buffers ARE
+    host memory)."""
+    if not enabled():
+        yield
+        return
+    if jax.default_backend() == "cpu":
+        with jax.debug_nans(True):
+            yield
+    else:
+        with jax.debug_nans(False), jax.transfer_guard_device_to_host(
+            "disallow"
+        ):
+            yield
+
+
+def enable_global_debug_nans() -> bool:
+    """Suite-wide NaN checking (tests/conftest.py calls this when
+    SRML_SANITIZE=1): unlike the per-fit scope this also covers transform/
+    kneighbors kernels invoked outside fit dispatch.  The transfer guard is
+    NOT enabled globally — ingest and model persistence legitimately fetch
+    host copies between fits."""
+    if not enabled():
+        return False
+    jax.config.update("jax_debug_nans", True)
+    return True

@@ -13,13 +13,18 @@
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import os
+import threading
 from multiprocessing.pool import ThreadPool
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+# serializes parallel CV fold fits on the cpu backend (see one_fold)
+_CPU_FOLD_LOCK = threading.Lock()
 
 from .core import _TpuEstimator, _TpuModel, load as _load_any
 from .dataframe import DataFrame, as_dataframe
@@ -217,13 +222,31 @@ class CrossValidator(_ValidatorParams):
         def one_fold(fold: int):
             train, valid = datasets[fold]
             try:
-                if single_pass:
-                    models = [m for _, m in est.fitMultiple(train, epm)]
-                    combined = models[0]._combine(models)
-                    metrics = combined._transformEvaluate(valid, eva)
-                else:
-                    models = [m for _, m in est.fitMultiple(train, epm)]
-                    metrics = [eva.evaluate(m.transform(valid)) for m in models]
+                # On the cpu backend (virtual test mesh) fold fits are
+                # SERIALIZED: XLA:CPU's cross_module rendezvous deadlocks
+                # when two multi-device programs from different threads
+                # interleave enqueue order on shared devices, so concurrent
+                # fold fits over one mesh wedge the suite.  Accelerator
+                # backends keep true thread parallelism.  Safe to hold
+                # across the whole fold: single-controller fits never touch
+                # a control plane, so no cross-thread rendezvous exists.
+                import jax
+
+                guard = (
+                    _CPU_FOLD_LOCK
+                    if jax.default_backend() == "cpu"
+                    else contextlib.nullcontext()
+                )
+                with guard:
+                    if single_pass:
+                        models = [m for _, m in est.fitMultiple(train, epm)]
+                        combined = models[0]._combine(models)
+                        metrics = combined._transformEvaluate(valid, eva)
+                    else:
+                        models = [m for _, m in est.fitMultiple(train, epm)]
+                        metrics = [
+                            eva.evaluate(m.transform(valid)) for m in models
+                        ]
             finally:
                 if fold_cleanup is not None:
                     fold_cleanup(train, valid)

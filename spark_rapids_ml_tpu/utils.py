@@ -125,6 +125,41 @@ def stack_feature_cells(cells: Any, dtype: np.dtype) -> np.ndarray:
     return np.asarray(out, dtype=dtype)
 
 
+def materialize_feature_block(
+    block: Any,
+    part: Any,
+    input_col: Optional[str],
+    input_cols: Optional[List[str]],
+    dtype: np.dtype,
+    densify_sparse: bool = True,
+    on_densify: Optional[Any] = None,
+) -> np.ndarray:
+    """One partition's feature matrix from a stashed feature block (dense
+    2-D or sparse CSR, or None) with a column fallback — THE shared ingest
+    materialization: estimator ingest, model transform, and the standalone
+    extract_partition_features all route here (it was triplicated across
+    core.py before graftlint's duplicate-code finding).
+
+    `block` is the partition's pre-validated feature block from
+    core._partition_feature_block (None when absent or when reading
+    input_cols).  Sparse blocks stay CSR when densify_sparse=False;
+    otherwise they densify — the ONE sanctioned np.asarray(toarray())
+    site (graftlint R1 allowlists this function) — calling `on_densify`
+    first so callers can warn."""
+    if block is not None and hasattr(block, "tocsr"):
+        if not densify_sparse:
+            return block  # CSR stays sparse through to ELL ingest
+        if on_densify is not None:
+            on_densify()
+        return np.asarray(block.toarray(), dtype=dtype)
+    if block is not None:
+        return np.asarray(block, dtype=dtype)
+    if input_col is not None:
+        return stack_feature_cells(part[input_col].tolist(), dtype)
+    assert input_cols is not None
+    return np.asarray(part[input_cols].to_numpy(), dtype=dtype)
+
+
 def pad_rows(arr: np.ndarray, multiple: int) -> np.ndarray:
     """Zero-pad rows so arr.shape[0] is a multiple of `multiple` (static shapes
     for XLA; padded rows are masked by zero weights downstream)."""

@@ -48,6 +48,13 @@ if os.environ.get("SRML_TPU_TESTS") != "1":
 
 import pytest  # noqa: E402
 
+# SRML_SANITIZE=1 runs the whole suite under the runtime sanitizer: per-fit
+# transfer-guard scopes activate inside core/runner dispatch, and NaN
+# checking goes suite-wide here (sanitize.py documents the split).
+from spark_rapids_ml_tpu import sanitize as _sanitize  # noqa: E402
+
+_sanitize.enable_global_debug_nans()
+
 
 def pytest_addoption(parser):
     parser.addoption(

@@ -113,7 +113,7 @@ class TestMeshCollectives:
         assert DATA_AXIS in mesh.shape
 
     def test_psum_over_mesh_matches_numpy(self):
-        from jax import shard_map
+        from spark_rapids_ml_tpu.compat import shard_map
 
         mesh = get_mesh()
         X_host = np.arange(64, dtype=np.float32).reshape(16, 4)
@@ -129,7 +129,7 @@ class TestMeshCollectives:
         np.testing.assert_allclose(np.asarray(total), X_host.sum(axis=0))
 
     def test_all_gather_roundtrip(self):
-        from jax import shard_map
+        from spark_rapids_ml_tpu.compat import shard_map
 
         mesh = get_mesh()
         n_dev = mesh.devices.size

@@ -214,7 +214,6 @@ def test_distributed_kneighbors_binary_exchange_end_to_end():
     from sklearn.neighbors import NearestNeighbors as SkNN
 
     from spark_rapids_ml_tpu.ops.knn import distributed_kneighbors
-    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
 
     nranks = 4
     rng = np.random.default_rng(3)
@@ -226,13 +225,15 @@ def test_distributed_kneighbors_binary_exchange_end_to_end():
     # rank 2 owns NO queries
     q_split = [np.arange(0, 20), np.arange(20, 30), np.arange(0, 0), np.arange(30, 37)]
     bar = StringBarrier(nranks)
-    mesh = get_mesh()
 
     def fn(rank):
         ip = [(items[item_split[rank]], ids[item_split[rank]])]
         qp = [(queries[q_split[rank]], q_split[rank].astype(np.int64))]
+        # no mesh arg: thread-mocked ranks get DISJOINT per-rank submeshes
+        # (sharing one mesh across rank-threads deadlocks XLA:CPU's
+        # collective rendezvous — see distributed_kneighbors)
         return distributed_kneighbors(
-            ip, qp, k, rank, nranks, bar.plane(rank), mesh
+            ip, qp, k, rank, nranks, bar.plane(rank)
         )
 
     results = _run_ranks(nranks, fn)

@@ -1,0 +1,480 @@
+# graftlint rule tests: every rule R1-R5 must FIRE on a minimal bad snippet
+# and stay SILENT on the corrected version, pragmas must suppress, the
+# baseline must demote, and the real tree must lint clean (the zero-findings
+# gate that keeps the pass trustworthy — a linter the tree itself violates
+# trains everyone to ignore it).
+import os
+import textwrap
+
+import pytest
+
+from tools.graftlint import (
+    apply_baseline,
+    collect_pragmas,
+    lint_paths,
+    lint_source,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src: str, path: str = "pkg/mod.py", rules=None):
+    return lint_source(textwrap.dedent(src), path=path, rules=rules)
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- R1: host sync in hot path ------------------------------------------------
+
+R1_BAD_LOOP = """
+    import jax
+    import jax.numpy as jnp
+
+    def fit(a, n):
+        total = 0.0
+        for i in range(n):
+            x = jnp.sum(a) * i
+            total += float(x)
+        return total
+"""
+
+R1_GOOD_LOOP = """
+    import jax
+    import jax.numpy as jnp
+
+    def fit(a, n):
+        parts = []
+        for i in range(n):
+            parts.append(jnp.sum(a) * i)
+        return sum(float(v) for v in jax.device_get(parts))
+"""
+
+
+def test_r1_fires_on_float_in_loop():
+    findings = _lint(R1_BAD_LOOP)
+    assert _rules_of(findings) == ["R1"]
+    assert "device->host" in findings[0].message
+
+
+def test_r1_silent_on_batched_fetch():
+    assert _lint(R1_GOOD_LOOP) == []
+
+
+def test_r1_fires_on_asarray_in_jitted_body():
+    findings = _lint(
+        """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            y = jnp.sum(x)
+            return np.asarray(y)
+        """
+    )
+    assert "R1" in _rules_of(findings)
+
+
+def test_r1_fires_on_device_get_inside_loop():
+    findings = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def fit(a, n):
+            out = []
+            for i in range(n):
+                out.append(jax.device_get(jnp.sum(a) * i))
+            return out
+        """
+    )
+    assert _rules_of(findings) == ["R1"]
+
+
+def test_r1_untaints_through_shape_and_range():
+    # vals.shape[0] / range() yield host ints: the loop variable must not
+    # count as device data (regression: r taint via `range(vals.shape[0])`)
+    assert (
+        _lint(
+            """
+            import numpy as np
+            import jax
+            import jax.numpy as jnp
+
+            def check(vals):
+                ai = jnp.argsort(vals)
+                ai_h = jax.device_get(ai)
+                for r in range(vals.shape[0]):
+                    print(ai_h[r].tolist())
+            """
+        )
+        == []
+    )
+
+
+def test_r1_ignores_plain_numpy_loops():
+    assert (
+        _lint(
+            """
+            import numpy as np
+
+            def ingest(parts):
+                out = []
+                for p in parts:
+                    out.append(np.asarray(p, dtype=np.float32))
+                return np.concatenate(out)
+            """
+        )
+        == []
+    )
+
+
+# -- R2: recompile risk -------------------------------------------------------
+
+R2_BAD_PARAM = """
+    import jax
+
+    @jax.jit
+    def solve(x, n_iter):
+        return x * n_iter
+"""
+
+R2_GOOD_PARAM = """
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, static_argnames=("n_iter",))
+    def solve(x, n_iter):
+        return x * n_iter
+"""
+
+
+def test_r2_fires_on_unmarked_shape_param():
+    findings = _lint(R2_BAD_PARAM)
+    assert _rules_of(findings) == ["R2"]
+    assert "static_argnames" in findings[0].message
+
+
+def test_r2_silent_with_static_argnames():
+    assert _lint(R2_GOOD_PARAM) == []
+
+
+def test_r2_fires_on_python_if_over_tracer():
+    findings = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def pick(x, flag):
+            if flag:
+                return x
+            return -x
+        """
+    )
+    assert _rules_of(findings) == ["R2"]
+    assert "lax.cond" in findings[0].message
+
+
+def test_r2_allows_static_shape_and_structure_tests():
+    assert (
+        _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def pad(q, items):
+                if q.shape[1] != items.shape[1]:
+                    q = jnp.pad(q, ((0, 0), (0, items.shape[1] - q.shape[1])))
+                if q.ndim == 1:
+                    q = q[None, :]
+                return q
+            """
+        )
+        == []
+    )
+
+
+# -- R3: axis names bound through parallel/mesh -------------------------------
+
+
+def test_r3_fires_on_string_literal_axis():
+    findings = _lint(
+        """
+        import jax
+
+        def agg(x):
+            return jax.lax.psum(x, "data")
+        """
+    )
+    assert _rules_of(findings) == ["R3"]
+    assert "parallel/mesh" in findings[0].message
+
+
+def test_r3_fires_on_module_local_axis_string():
+    findings = _lint(
+        """
+        import jax
+
+        AXIS = "data"
+
+        def agg(x):
+            return jax.lax.psum(x, AXIS)
+        """
+    )
+    assert _rules_of(findings) == ["R3"]
+
+
+def test_r3_counts_nested_constructor_literal_once():
+    # P("data") nested in NamedSharding must be ONE finding, not two — a
+    # double count would also corrupt --baseline budgets
+    findings = _lint(
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shard(mesh):
+            return NamedSharding(mesh, P("data"))
+        """
+    )
+    assert _rules_of(findings) == ["R3"]
+    assert len(findings) == 1
+
+
+def test_r3_silent_on_mesh_bound_axis():
+    assert (
+        _lint(
+            """
+            import jax
+            from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+            def agg(x):
+                return jax.lax.psum(x, DATA_AXIS)
+            """
+        )
+        == []
+    )
+
+
+def test_r3_fires_on_partition_spec_literal():
+    findings = _lint(
+        """
+        from jax.sharding import PartitionSpec as P
+
+        def spec():
+            return P("data")
+        """
+    )
+    assert _rules_of(findings) == ["R3"]
+
+
+# -- R4: nondeterminism -------------------------------------------------------
+
+
+def test_r4_fires_on_legacy_global_rng():
+    findings = _lint(
+        """
+        import numpy as np
+
+        def sample(n):
+            return np.random.normal(size=n)
+        """
+    )
+    assert _rules_of(findings) == ["R4"]
+    assert "GLOBAL RNG" in findings[0].message
+
+
+def test_r4_fires_on_unseeded_default_rng():
+    findings = _lint(
+        """
+        import numpy as np
+
+        def sample(n):
+            rng = np.random.default_rng()
+            return rng.normal(size=n)
+        """
+    )
+    assert _rules_of(findings) == ["R4"]
+
+
+def test_r4_fires_on_module_scope_rng():
+    findings = _lint(
+        """
+        import jax
+
+        _KEY = jax.random.PRNGKey(0)
+        """
+    )
+    assert _rules_of(findings) == ["R4"]
+    assert "module scope" in findings[0].message
+
+
+def test_r4_fires_on_set_iteration():
+    findings = _lint(
+        """
+        def merge(items):
+            out = []
+            for x in set(items):
+                out.append(x)
+            return out
+        """
+    )
+    assert _rules_of(findings) == ["R4"]
+
+
+def test_r4_silent_on_seeded_rng_and_sorted_set():
+    assert (
+        _lint(
+            """
+            import numpy as np
+
+            def sample(n, seed):
+                rng = np.random.default_rng(seed)
+                vals = rng.normal(size=n)
+                return [v for v in sorted(set(vals.tolist()))]
+            """
+        )
+        == []
+    )
+
+
+# -- R5: float64 discipline in ops/ -------------------------------------------
+
+R5_BAD = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    def kernel(x):
+        return jnp.zeros(x.shape, dtype=np.float64)
+"""
+
+
+def test_r5_fires_on_float64_in_ops():
+    findings = _lint(R5_BAD, path="spark_rapids_ml_tpu/ops/fake.py")
+    assert _rules_of(findings) == ["R5"]
+    assert "f64" in findings[0].message or "float64" in findings[0].message
+
+
+def test_r5_scoped_to_ops_dirs():
+    # the same snippet outside ops/ is not R5's business
+    assert _lint(R5_BAD, path="spark_rapids_ml_tpu/models/fake.py") == []
+
+
+def test_r5_fires_on_dtype_string_and_builtin_float():
+    findings = _lint(
+        """
+        import numpy as np
+
+        def kernel(x):
+            a = np.zeros(3, dtype="float64")
+            b = np.zeros(3, dtype=float)
+            return a, b
+        """,
+        path="benchmark/ops/fake.py",
+    )
+    assert len(findings) == 2
+    assert _rules_of(findings) == ["R5"]
+
+
+def test_r5_silent_on_float32():
+    assert (
+        _lint(
+            """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def kernel(x):
+                return jnp.zeros(x.shape, dtype=np.float32)
+            """,
+            path="spark_rapids_ml_tpu/ops/fake.py",
+        )
+        == []
+    )
+
+
+# -- pragmas, baseline, rule selection ---------------------------------------
+
+
+def test_pragma_suppresses_on_line_and_line_above():
+    src = """
+        import numpy as np
+
+        def sample(n):
+            return np.random.normal(size=n)  # graftlint: disable=R4 (test fixture)
+    """
+    assert _lint(src) == []
+    src_above = """
+        import numpy as np
+
+        def sample(n):
+            # graftlint: disable=R4 (test fixture)
+            return np.random.normal(size=n)
+    """
+    assert _lint(src_above) == []
+
+
+def test_pragma_is_rule_specific():
+    src = """
+        import numpy as np
+
+        def sample(n):
+            return np.random.normal(size=n)  # graftlint: disable=R1 (wrong rule)
+    """
+    assert _rules_of(_lint(src)) == ["R4"]
+
+
+def test_pragma_reason_parses():
+    pragmas = collect_pragmas(
+        "x = 1  # graftlint: disable=R1, R5 (host-side math)\n"
+    )
+    assert pragmas == {1: {"R1", "R5"}}
+
+
+def test_rule_selection():
+    both = """
+        import numpy as np
+        import jax
+
+        def f(x, n):
+            np.random.seed(0)
+            for i in range(n):
+                y = jax.numpy.sum(x)
+                print(float(y))
+    """
+    assert _rules_of(_lint(both)) == ["R1", "R4"]
+    assert _rules_of(_lint(both, rules=["R4"])) == ["R4"]
+
+
+def test_baseline_demotes_then_catches_new(tmp_path):
+    findings = _lint(R1_BAD_LOOP, path="pkg/mod.py")
+    assert findings
+    baseline_file = tmp_path / "baseline.json"
+    counts = write_baseline(str(baseline_file), findings)
+    assert sum(counts.values()) == len(findings)
+    errors, warnings = apply_baseline(findings, counts)
+    assert errors == [] and len(warnings) == len(findings)
+    # one NEW finding beyond the baselined count becomes an error again
+    doubled = findings + findings
+    errors, warnings = apply_baseline(doubled, counts)
+    assert len(errors) == len(findings) and len(warnings) == len(findings)
+
+
+# -- the gate: the real tree is clean -----------------------------------------
+
+
+@pytest.mark.parametrize("pkg", ["spark_rapids_ml_tpu", "benchmark", "tests"])
+def test_tree_is_graftlint_clean(pkg):
+    findings = lint_paths([os.path.join(REPO, pkg)])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_reports_per_rule_counts(capsys):
+    from tools.graftlint.__main__ import main
+
+    rc = main([os.path.join(REPO, "spark_rapids_ml_tpu", "utils.py")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "R1[host-sync]=" in out and "clean" in out

@@ -1,5 +1,6 @@
 # Exact kNN correctness vs sklearn (strategy modeled on the reference's
 # test_nearest_neighbors.py).
+import jax
 import numpy as np
 import pandas as pd
 import pytest
@@ -159,9 +160,11 @@ def test_topk_approx_verified_exact():
     av, ai = _topk_approx_verified(vals, k)
     ev, ei = _grouped_topk_exact(vals, k)
     np.testing.assert_allclose(np.asarray(av), np.asarray(ev))
-    # same index SET per row (order among ties may differ)
+    # same index SET per row (order among ties may differ); fetch once —
+    # per-row np.asarray in the loop would sync per iteration (graftlint R1)
+    ai_h, ei_h = jax.device_get((ai, ei))
     for r in range(vals.shape[0]):
-        assert set(np.asarray(ai)[r].tolist()) == set(np.asarray(ei)[r].tolist())
+        assert set(ai_h[r].tolist()) == set(ei_h[r].tolist())
 
 
 def test_topk_approx_verified_ties():

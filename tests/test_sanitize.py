@@ -1,0 +1,97 @@
+# Runtime sanitizer (SRML_SANITIZE=1): the transfer-guard + debug-nans scope
+# must wrap solver invocations, the guarded fits must pass clean on the
+# virtual 8-device mesh (locking the KMeans/LinearRegression hot paths
+# transfer-free going forward), and NaN production inside the scope must
+# raise instead of propagating into model attributes.
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+
+from spark_rapids_ml_tpu import KMeans, LinearRegression
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.sanitize import enabled, sanitize_scope
+
+
+def _df(n=96, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    coef = rng.standard_normal(d).astype(np.float32)
+    y = (X @ coef + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    pdf = pd.DataFrame({"features": list(X), "label": y})
+    return DataFrame([pdf.iloc[: n // 2], pdf.iloc[n // 2 :]])
+
+
+def test_scope_is_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("SRML_SANITIZE", raising=False)
+    assert not enabled()
+    before = jax.config.jax_transfer_guard_device_to_host
+    with sanitize_scope():
+        assert jax.config.jax_transfer_guard_device_to_host == before
+
+
+def test_scope_installs_nan_check_on_cpu(monkeypatch):
+    monkeypatch.setenv("SRML_SANITIZE", "1")
+    assert enabled()
+    assert jax.default_backend() == "cpu"
+    # prior values, NOT literals: under a suite-wide SRML_SANITIZE=1 run the
+    # conftest turns debug_nans on globally, and the scope must restore TO
+    # that state, not to off
+    nans_before = jax.config.jax_debug_nans
+    with sanitize_scope():
+        assert jax.config.jax_debug_nans
+    assert jax.config.jax_debug_nans == nans_before
+
+
+def test_scope_installs_guard_on_accelerators(monkeypatch):
+    # the accelerator branch: transfer guard ON, debug_nans FORCED OFF —
+    # debug_nans' posthook fetches every jitted output (an implicit d2h
+    # transfer) and would trip the guard it shares a scope with
+    monkeypatch.setenv("SRML_SANITIZE", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    guard_before = jax.config.jax_transfer_guard_device_to_host
+    with sanitize_scope():
+        assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+        assert not jax.config.jax_debug_nans
+    assert jax.config.jax_transfer_guard_device_to_host == guard_before
+
+
+def test_scope_raises_on_nan(monkeypatch):
+    monkeypatch.setenv("SRML_SANITIZE", "1")
+    with pytest.raises(FloatingPointError):
+        with sanitize_scope():
+            jax.jit(lambda x: jax.numpy.log(x))(
+                jax.numpy.zeros(4) - 1.0
+            ).block_until_ready()
+
+
+def test_kmeans_fit_clean_under_sanitizer(monkeypatch, n_devices):
+    monkeypatch.setenv("SRML_SANITIZE", "1")
+    model = KMeans(k=3, maxIter=8, seed=11).setFeaturesCol("features").fit(_df())
+    centers = np.asarray(model.cluster_centers_)
+    assert centers.shape == (3, 5)
+    assert np.isfinite(centers).all()
+    assert np.isfinite(model.inertia_)
+
+
+def test_linreg_fit_clean_under_sanitizer(monkeypatch, n_devices):
+    monkeypatch.setenv("SRML_SANITIZE", "1")
+    model = (
+        LinearRegression(regParam=0.0, standardization=False)
+        .setFeaturesCol("features")
+        .fit(_df(seed=3))
+    )
+    assert np.isfinite(np.asarray(model.coefficients)).all()
+    assert np.isfinite(model.intercept)
+
+
+def test_linreg_elasticnet_fit_clean_under_sanitizer(monkeypatch):
+    # the CD solver is the other linreg hot path (while_loop + fori sweeps)
+    monkeypatch.setenv("SRML_SANITIZE", "1")
+    model = (
+        LinearRegression(regParam=0.1, elasticNetParam=0.5)
+        .setFeaturesCol("features")
+        .fit(_df(seed=5))
+    )
+    assert np.isfinite(np.asarray(model.coefficients)).all()

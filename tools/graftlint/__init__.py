@@ -1,0 +1,220 @@
+#
+# graftlint: AST-level JAX/TPU invariant checks for this codebase.
+#
+# The reference stack (cuML/NCCL) fails loudly when a worker misuses the
+# device; the jax/pjit rebuild fails silently — a stray np.asarray on a
+# device array becomes a hidden device->host sync, a Python-scalar jit arg
+# becomes a recompile stream, an axis-name typo explodes only at trace time
+# on a real mesh.  graftlint moves those failures to review time.  Rules:
+#
+#   R1 host-sync     np.asarray/.item()/float()/np reductions on values that
+#                    dataflow from jnp/jax.lax/jitted calls, inside loops or
+#                    jitted bodies; jax.device_get inside loops.
+#   R2 recompile     jit-wrapped callables taking shape/config-named params
+#                    without static_argnums/static_argnames; Python if/while
+#                    on non-static params inside a jitted body.
+#   R3 axis-name     lax collectives / PartitionSpec / Mesh axis names given
+#                    as string literals instead of names bound through
+#                    parallel/mesh (DATA_AXIS/MODEL_AXIS).
+#   R4 nondeterminism  legacy np.random global-state calls; unseeded
+#                    default_rng(); any RNG call at module scope; iteration
+#                    over set values (order feeds collectives/encodings).
+#   R5 dtype         float64 dtypes in ops/ solver kernels (TPU demotes f64
+#                    to slow emulation; numpy f64 scalars also silently
+#                    promote weak-typed jnp math).
+#
+# Suppression: `# graftlint: disable=R1 (reason)` on the finding line or the
+# line directly above.  Granted pragmas are audited in NOTES.md.
+#
+# The runtime counterpart (SRML_SANITIZE=1 transfer guard + NaN checks) lives
+# in spark_rapids_ml_tpu/sanitize.py; docs/graftlint.md documents both.
+#
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .rules import RULES, ModuleIndex, lint_tree
+
+__all__ = [
+    "Finding",
+    "lint_source",
+    "lint_paths",
+    "load_baseline",
+    "write_baseline",
+    "apply_baseline",
+    "RULE_NAMES",
+]
+
+RULE_NAMES = {
+    "R1": "host-sync",
+    "R2": "recompile",
+    "R3": "axis-name",
+    "R4": "nondeterminism",
+    "R5": "dtype",
+}
+
+# Findings sanctioned by construction, not by pragma.  Entries are
+# "<path-suffix>" (whole file) or "<path-suffix>::<function>".  Keep this
+# list SHORT — the point of the dedup work was shrinking it to single sites.
+ALLOWLIST: Dict[str, Tuple[str, ...]] = {
+    # the ONE sanctioned np.asarray(block.toarray()) ingest materialization
+    # (dense/sparse pandas blocks are host data; the dataflow pass would not
+    # taint them, but the entry documents the contract and guards a future
+    # device-backed block type)
+    "R1": ("spark_rapids_ml_tpu/utils.py::materialize_feature_block",),
+    # the axis-name binding site itself: DATA_AXIS/MODEL_AXIS are DEFINED
+    # here, so its own Mesh/PartitionSpec construction uses the literals
+    "R3": ("spark_rapids_ml_tpu/parallel/mesh.py",),
+}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s*\(([^)]*)\))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # "R1".."R5"
+    path: str
+    line: int
+    message: str
+    func: str = ""  # enclosing function qualname ("" at module scope)
+
+    @property
+    def name(self) -> str:
+        return RULE_NAMES[self.rule]
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        return f"{where}: {self.rule}[{self.name}] {self.message}"
+
+
+def _pragma_rules(line_text: str) -> Optional[set]:
+    m = _PRAGMA_RE.search(line_text)
+    if not m:
+        return None
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def collect_pragmas(source: str) -> Dict[int, set]:
+    """Line number -> set of disabled rules ('all' disables every rule)."""
+    out: Dict[int, set] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        rules = _pragma_rules(text)
+        if rules:
+            out[i] = rules
+    return out
+
+
+def _suppressed(f: Finding, pragmas: Dict[int, set]) -> bool:
+    for line in (f.line, f.line - 1):
+        rules = pragmas.get(line)
+        if rules and (f.rule in rules or "all" in rules):
+            return True
+    return False
+
+
+def _allowlisted(f: Finding) -> bool:
+    for entry in ALLOWLIST.get(f.rule, ()):
+        if "::" in entry:
+            suffix, func = entry.split("::", 1)
+            if f.path.endswith(suffix) and f.func == func:
+                return True
+        elif f.path.endswith(entry):
+            return True
+    return False
+
+
+def lint_source(
+    source: str, path: str = "<string>", rules: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    """Lint one module's source; returns unsuppressed findings sorted by
+    line.  `rules` restricts to a subset (default: all)."""
+    import ast
+
+    tree = ast.parse(source, filename=path)
+    index = ModuleIndex(tree, path)
+    selected = set(rules) if rules is not None else set(RULES)
+    raw = [
+        Finding(rule=r, path=path, line=line, message=msg, func=func)
+        for (r, line, msg, func) in lint_tree(tree, index, selected)
+    ]
+    pragmas = collect_pragmas(source)
+    return sorted(
+        (f for f in raw if not _suppressed(f, pragmas) and not _allowlisted(f)),
+        key=lambda f: (f.line, f.rule),
+    )
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs if d not in ("__pycache__", ".git")
+                )
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+
+
+def lint_paths(
+    paths: Iterable[str], rules: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_source(source, path=os.path.normpath(path), rules=rules))
+    return findings
+
+
+# -- baseline: land a new rule warn-only, promote to error later -------------
+
+def baseline_key(f: Finding) -> str:
+    return f"{f.path}::{f.rule}"
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict):
+        raise ValueError(f"baseline {path} must be a JSON object")
+    return {str(k): int(v) for k, v in data.items()}
+
+
+def write_baseline(path: str, findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[baseline_key(f)] = counts.get(baseline_key(f), 0) + 1
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(counts, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return counts
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (errors, warnings): per (path, rule), up to the
+    baselined count are warnings (pre-existing debt), the rest are errors.
+    Counts (not line numbers) key the match so unrelated edits don't churn
+    the baseline file."""
+    budget = dict(baseline)
+    errors: List[Finding] = []
+    warnings: List[Finding] = []
+    for f in findings:
+        k = baseline_key(f)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            warnings.append(f)
+        else:
+            errors.append(f)
+    return errors, warnings

@@ -1,0 +1,101 @@
+#
+# CLI: python -m tools.graftlint <paths...>
+#
+# Exit 0 when clean (or every finding is covered by --baseline), 1 on
+# findings, 2 on usage errors.  Always prints the per-rule finding count so
+# CI logs show coverage even on green runs (ci/test.sh step 1).
+#
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+from . import (
+    RULE_NAMES,
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX/TPU invariant checks (R1-R5) — see docs/graftlint.md",
+    )
+    parser.add_argument("paths", nargs="+", help="files or package dirs to lint")
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated subset, e.g. R1,R3 (default: all rules)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help="JSON baseline: findings up to the recorded per-(file, rule) "
+        "counts are demoted to warnings, so a new rule can land warn-only "
+        "before being promoted to an error",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        default=None,
+        metavar="FILE",
+        help="write the current findings as a baseline file and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULE_NAMES]
+        if unknown:
+            parser.error(f"unknown rule(s): {', '.join(unknown)}")
+
+    try:
+        findings = lint_paths(args.paths, rules=rules)
+    except (OSError, SyntaxError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        counts = write_baseline(args.write_baseline, findings)
+        print(
+            f"graftlint: wrote baseline of {len(findings)} finding(s) "
+            f"across {len(counts)} (file, rule) key(s) to {args.write_baseline}"
+        )
+        return 0
+
+    warnings: List = []
+    errors = findings
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"graftlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        errors, warnings = apply_baseline(findings, baseline)
+
+    for f in warnings:
+        print(f"warning: {f.render()}")
+    for f in errors:
+        print(f.render())
+
+    per_rule = {r: 0 for r in RULE_NAMES}
+    for f in findings:
+        per_rule[f.rule] += 1
+    summary = "  ".join(
+        f"{r}[{RULE_NAMES[r]}]={per_rule[r]}" for r in sorted(per_rule)
+    )
+    status = "clean" if not errors else f"{len(errors)} error finding(s)"
+    baselined = f", {len(warnings)} baselined warning(s)" if warnings else ""
+    print(f"graftlint: {summary}")
+    print(f"graftlint: {status}{baselined}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
