@@ -33,6 +33,16 @@ if [ "${SRML_CI_FULL:-0}" = "1" ]; then
     echo "CI budget: slow-marked remainder took $((SECONDS - t1))s"
 fi
 
+# 3b. focused gates for the kNN query-engine contracts (cheap; both files
+#     also run inside the full suite above — re-asserted here by name so a
+#     selective run or marker drift can never silently drop them):
+#     - interpret-mode Pallas kNN kernels, incl. the multi-K-block
+#       query-resident grid (revisited output dim must be innermost)
+#     - precompile executable cache hit/miss: a repeat same-shape search
+#       performs ZERO new compilations (profiling counters)
+python -m pytest tests/test_pallas.py -q -k knn
+python -m pytest tests/test_precompile.py -q
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

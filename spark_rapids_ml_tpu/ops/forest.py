@@ -584,6 +584,13 @@ def grow_forest(
 
     Falls back to per-tree grow_tree when the per-node feature-subset score
     buffer would be too large (max_features < D with a very wide D)."""
+    from .precompile import initialize_persistent_cache
+
+    # opt-in on-disk executable cache (SRML_COMPILE_CACHE): the level
+    # kernels are shape-keyed per (depth, class-count, chunk) geometry —
+    # the forest arms' dominant cold cost — and a warm disk cache turns a
+    # cold process's compiles into deserializes
+    initialize_persistent_cache()
     T, N, S = stats_t.shape
     D = Xb.shape[1]
     V = 1 if kind == "regression" else S

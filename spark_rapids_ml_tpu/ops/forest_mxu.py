@@ -997,6 +997,13 @@ def grow_forest_mxu(
     (raw target / class index per row) is required when max_depth exceeds
     the shallow slot budget — the deep phase rebuilds stats from it after
     the bucket sort."""
+    from .precompile import initialize_persistent_cache
+
+    # opt-in on-disk executable cache: this builder's ~480 geometries are
+    # the fleet's worst cold-compile case (rf_clf 50.4 s cold) — with
+    # SRML_COMPILE_CACHE set, a cold process deserializes what any earlier
+    # process compiled, and the pc.submit pool below only pays disk reads
+    initialize_persistent_cache()
     T, n_pad = w_trees.shape
     D = bins_fm.shape[0]
     S = base_stats.shape[0]

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ..compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import profiling
 from ..parallel.mesh import DATA_AXIS, data_sharding, get_mesh
 
 
@@ -99,6 +100,104 @@ def _grouped_topk(vals: jax.Array, k: int, group: int = 1024):
 # can shrink them to exercise the multi-chunk and running-merge branches
 _TILE_BUDGET = 128 << 20
 _COLLECT_MERGE_BUDGET = 1 << 30
+
+
+# ---------------------------------------------------------------------------
+# Pipelined query engine plumbing: a bounded dispatch/collect window over
+# query blocks (double-buffered by default on the exact route, deeper on the
+# adaptive route whose per-block host work is larger), with every dispatch
+# and collect recorded as a profiling event so the overlap is OBSERVABLE —
+# tests assert "block i+1 dispatched before block i collected" on the event
+# log instead of on wall-clock timing.
+# ---------------------------------------------------------------------------
+
+_PIPELINE_WINDOW_ENV = "SRML_KNN_PIPELINE_WINDOW"
+_FORCE_ADAPTIVE_ENV = "SRML_KNN_FORCE_ADAPTIVE"
+
+
+def _pipeline_window(default: int) -> int:
+    import os
+
+    try:
+        return max(1, int(os.environ.get(_PIPELINE_WINDOW_ENV, default)))
+    except ValueError:
+        return default
+
+
+def _force_adaptive() -> bool:
+    """SRML_KNN_FORCE_ADAPTIVE=1 routes knn_search_prepared through the
+    adaptive pipelined engine regardless of backend and shape eligibility —
+    a test/debug knob (the adaptive scheme is exact-with-fallback on every
+    backend; only its PROFITABILITY is TPU-shaped)."""
+    import os
+
+    return os.environ.get(_FORCE_ADAPTIVE_ENV, "") == "1"
+
+
+def _run_block_pipeline(n_blocks: int, dispatch, collect, window: int) -> None:
+    """Drive `dispatch(block_index)` / `collect(block_index)` over
+    `n_blocks` query blocks keeping at most `window` + 1 blocks in flight.
+    jax dispatch is async, so block b + 1..b + window compute on device
+    while block b's results cross the host link inside `collect`.  The
+    bound matters — dispatching everything up front would keep every padded
+    query block resident on device at once and OOM large searches."""
+    done = 0
+    for bi in range(n_blocks):
+        with profiling.phase("knn.dispatch"):
+            dispatch(bi)
+        profiling.record_event("knn.dispatch", block=bi)
+        if bi - done >= window:
+            with profiling.phase("knn.collect"):
+                collect(done)
+            profiling.record_event("knn.collect", block=done)
+            done += 1
+    while done < n_blocks:
+        with profiling.phase("knn.collect"):
+            collect(done)
+        profiling.record_event("knn.collect", block=done)
+        done += 1
+
+
+def _query_block_bucket(n_rows: int, query_block: int) -> int:
+    """Power-of-two query-block size (>= 64, <= query_block) — ONE rule
+    shared by the dispatch loop and the AOT warm path so both land on the
+    same compiled geometry."""
+    from .precompile import shape_bucket
+
+    return shape_bucket(min(query_block, n_rows), lo=64)
+
+
+def _cached_kernel(name: str, fn, *args, mesh: Mesh = None, **statics):
+    """Dispatch a jitted kernel through the process-wide AOT executable
+    cache (ops/precompile): keyed on (kernel name, per-arg shape/dtype,
+    mesh fingerprint, statics), compiled once per key — from the concrete
+    args, so shardings are captured — and reused by every later same-shape
+    call (repeat searches, benchmarks, other models' queries).  The mesh
+    rides the key by VALUE (get_mesh builds fresh Mesh objects per call)."""
+    from .precompile import global_precompiler
+
+    key = _kernel_cache_key(name, args, mesh, statics)
+    if mesh is not None:
+        statics["mesh"] = mesh
+    if not hasattr(fn, "lower"):
+        # plain callable (tests monkeypatch the jitted phases with spies):
+        # nothing to AOT-compile, call through
+        return fn(*args, **statics)
+    return global_precompiler().cached_call(key, fn, *args, **statics)
+
+
+def _kernel_cache_key(name: str, args, mesh, statics: dict):
+    """The ONE key derivation shared by dispatch-time _cached_kernel and the
+    warm_search_kernels submit path — a warmed executable must be the exact
+    entry the later dispatch looks up."""
+    from .precompile import mesh_fingerprint
+
+    return (
+        name,
+        tuple((tuple(a.shape), str(a.dtype)) for a in args),
+        mesh_fingerprint(mesh),
+        tuple(sorted(statics.items())),
+    )
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "tile_budget", "collect_budget"))
@@ -427,11 +526,14 @@ def _adaptive_candidates_sharded(items, item_norm, item_pos, valid, queries, mes
 
 def _adaptive_candidates(items, item_norm, item_pos, valid, queries, mesh, k, chunk):
     if mesh.shape[DATA_AXIS] == 1:
-        return _adaptive_candidates_single(
-            items, item_norm, item_pos, valid, queries, k, chunk
+        return _cached_kernel(
+            "knn_cand_single", _adaptive_candidates_single,
+            items, item_norm, item_pos, valid, queries, k=k, chunk=chunk,
         )
-    return _adaptive_candidates_sharded(
-        items, item_norm, item_pos, valid, queries, mesh, k, chunk
+    return _cached_kernel(
+        "knn_cand_sharded", _adaptive_candidates_sharded,
+        items, item_norm, item_pos, valid, queries,
+        mesh=mesh, k=k, chunk=chunk,
     )
 
 
@@ -604,6 +706,38 @@ _adaptive_dispatch_fused = partial(
 )(_adaptive_pallas_phases)
 
 
+def _adaptive_plan(n_pad: int, d_al: int, q_rows: int, mesh: Mesh, k: int,
+                   chunk: int = _ADAPTIVE_CHUNK):
+    """Route + geometry the adaptive dispatch at these shapes will take —
+    ONE derivation shared by knn_block_adaptive_dispatch and the AOT warm
+    path (warm_search_kernels), so a warmed executable is always the one
+    the dispatch later runs.  Returns ("pallas", m) for the fused Pallas
+    kernel or ("scan", clamped_chunk, m) for the XLA candidates scan."""
+    from .pallas_knn import pallas_knn_eligible
+
+    n_shards = max(1, mesh.shape[DATA_AXIS])
+    if n_pad % n_shards:
+        # guard BEFORE any stride/geometry derivation: the per-shard scan
+        # and the merge-stride m below are only sound for evenly sharded
+        # rows (prepare_items pads to a device multiple; reject hand-built
+        # item sets that skipped it instead of slicing unsoundly)
+        raise ValueError(
+            f"adaptive kNN requires items evenly sharded over the mesh: "
+            f"{n_pad} padded rows do not divide over {n_shards} shards"
+        )
+    if pallas_knn_eligible(n_shards, d_al, q_rows):
+        m = _select_m(k, 1024, n_pad)
+        if m <= _ADAPTIVE_MAX_M:
+            return ("pallas", m)
+    # per-shard row count; chunk never wider than the shard (the scan's
+    # dynamic_slice has static size, so an over-wide chunk would be a
+    # lowering error rather than a clamp)
+    n_loc = n_pad // n_shards
+    chunk = min(chunk, n_loc)
+    _, m = _scan_geometry(k, chunk, n_loc)
+    return ("scan", chunk, m)
+
+
 def knn_block_adaptive_dispatch(
     items, item_norm, item_pos, valid, qd, mesh, k,
     chunk: int = _ADAPTIVE_CHUNK,
@@ -621,28 +755,32 @@ def knn_block_adaptive_dispatch(
     on single-shard TPU meshes (ops/pallas_knn.py): the selection runs on
     the VMEM-resident distance tile instead of re-reading it from HBM m
     times.  The merge / count-verify / exact-fallback phases are identical
-    either way, so the exactness contract does not depend on the route."""
-    from .pallas_knn import pallas_knn_eligible
+    either way, so the exactness contract does not depend on the route.
 
+    Every jitted phase dispatches through the process AOT executable cache
+    (_cached_kernel): repeat searches at a seen geometry perform zero new
+    compilations, observable via the precompile.* profiling counters."""
     if qd.shape[1] != items.shape[1]:
         # tile-aligned item columns (prepare_items): zero-pad the query
         # side to match — exact no-op columns on both matmul operands
         qd = jnp.pad(qd, ((0, 0), (0, items.shape[1] - qd.shape[1])))
     n_pad = items.shape[0]
-    if pallas_knn_eligible(
-        mesh.shape[DATA_AXIS], items.shape[1], qd.shape[0]
-    ):
-        m = _select_m(k, 1024, n_pad)
-        if m <= _ADAPTIVE_MAX_M:
+    plan = _adaptive_plan(n_pad, items.shape[1], qd.shape[0], mesh, k, chunk)
+    if plan[0] == "pallas":
+        m = plan[1]
+        if _audit_count_enabled():
             # audit mode keeps the separate dispatches (its count kernel
-            # pairs bitwise with the legacy candidates kernel); the
-            # default self-verify route fuses everything into one jit
-            run = (
-                _adaptive_pallas_phases
-                if _audit_count_enabled()
-                else _adaptive_dispatch_fused
+            # pairs bitwise with the legacy candidates kernel); no AOT
+            # caching on the debug route
+            return _adaptive_pallas_phases(
+                items, item_norm, valid, qd, k=k, m=m, n_items=n_pad
             )
-            return run(items, item_norm, valid, qd, k=k, m=m, n_items=n_pad)
+        # the default self-verify route fuses everything into one jit
+        return _cached_kernel(
+            "knn_fused", _adaptive_dispatch_fused,
+            items, item_norm, valid, qd, k=k, m=m, n_items=n_pad,
+        )
+    _, chunk, m = plan
     cv, ci = _adaptive_candidates(
         items, item_norm, item_pos, valid, qd, mesh, k, chunk
     )
@@ -653,11 +791,12 @@ def knn_block_adaptive_dispatch(
     # the scan pool's per-group blocks are m wide (G-group top-m laid out
     # contiguously by _group_topm; the layout survives the chunk moveaxis
     # and the multi-shard all_gather, both of which concatenate whole
-    # group blocks).  _scan_geometry is the same derivation the scan used,
-    # with n_loc the per-shard row count the sharded scan sees.
-    n_loc = items.shape[0] // max(1, mesh.shape[DATA_AXIS])
-    _, m = _scan_geometry(k, chunk, n_loc)
-    return _adaptive_merge_self(cv, ci, k, m=m)
+    # group blocks).  _adaptive_plan derived m with _scan_geometry — the
+    # same derivation the scan itself used, with n_loc the per-shard row
+    # count the sharded scan sees.
+    return _cached_kernel(
+        "knn_merge_self", _adaptive_merge_self, cv, ci, k=k, m=m
+    )
 
 
 def knn_block_adaptive_collect(
@@ -666,17 +805,19 @@ def knn_block_adaptive_collect(
     """Fetch a dispatched block's results and rerun the (near-empty) set of
     verification-failing rows through the exact kernel (pow2-padded so
     compiled fallback shapes stay bounded)."""
+    from .precompile import shape_bucket
+
     fv, fpos, sg, sa = handles
     fail = np.flatnonzero(np.asarray(sa) != np.asarray(sg))
     d_out, p_out = np.array(fv), np.array(fpos)  # fv is distances already
     if fail.size:
-        b = 64
-        while b < fail.size:
-            b *= 2
+        b = shape_bucket(fail.size)
         qf = np.zeros((b, qd.shape[1]), dtype=qd.dtype)
         qf[: fail.size] = np.asarray(qd)[fail]
-        d_f, p_f = knn_block_kernel(
-            items, item_norm, item_pos, valid, jnp.asarray(qf), mesh, k
+        d_f, p_f = _cached_kernel(
+            "knn_block", knn_block_kernel,
+            items, item_norm, item_pos, valid, jnp.asarray(qf),
+            mesh=mesh, k=k,
         )
         d_out[fail] = np.asarray(d_f)[: fail.size]
         p_out[fail] = np.asarray(p_f)[: fail.size]
@@ -1228,55 +1369,59 @@ def knn_search_prepared(
     # bucket the block size to a power of two (>=64, <=query_block) so
     # varying partition sizes reuse a handful of compiled kernels instead of
     # recompiling per distinct query count
-    block = 64
-    while block < min(query_block, q.shape[0]):
-        block *= 2
+    block = _query_block_bucket(q.shape[0], query_block)
+    starts = list(range(0, q.shape[0], block))
+
+    def _pad_block(qb, n_q):
+        if n_q == block:
+            return qb
+        if isinstance(qb, jax.Array):
+            return jnp.pad(qb, ((0, block - n_q), (0, 0)))
+        return np.concatenate(
+            [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)], axis=0
+        )
+
     # TPU + a large resident shard: the adaptive grouped-select path
     # (knn_block_adaptive_*) — ~3x the exact chunk-scan's throughput at the
     # 400k x 3000 k=200 benchmark shape; exact up to ~1e-6-relative
     # computational ties at the kth distance (see knn_block_adaptive — ties
     # within that sliver are ordered arbitrarily by f32 exact sorts too,
     # and anything missing by more than a tie's width triggers the exact
-    # per-row fallback).  All blocks'
-    # device phases dispatch ahead through a bounded window; the host then
-    # collects verification outcomes in order, so the 3 tunnel round-trips
-    # per block overlap with later blocks' compute instead of serializing
-    # (the serialized form made UMAP's 50k-item graph build sync-bound).
+    # per-row fallback).  Both routes run the SAME pipelined engine
+    # (_run_block_pipeline): all blocks' device phases dispatch ahead
+    # through a bounded window, the host collects results in order, and the
+    # per-block host round-trips overlap with later blocks' compute instead
+    # of serializing (the serialized form made UMAP's 50k-item graph build
+    # sync-bound, and the serialize-per-block fetch was the dominant
+    # variance term of the kNN bench arm under tunnel congestion).
     n_loc = prepared.items.shape[0] // max(1, mesh.shape[DATA_AXIS])
-    if jax.default_backend() == "tpu" and _adaptive_eligible(k, n_loc):
+    if (
+        jax.default_backend() == "tpu" and _adaptive_eligible(k, n_loc)
+    ) or _force_adaptive():
         out_d, out_i = [], []
         pending: list = []
-        window = 4
         fallback_q: list = []  # (block_index, row_indices) deferred reruns
 
-        def _dispatch_a(start):
+        def _dispatch_a(bi):
+            start = starts[bi]
             qb = q[start : start + block]
-            n_q = qb.shape[0]
-            if n_q < block:
-                if isinstance(qb, jax.Array):
-                    qb = jnp.pad(qb, ((0, block - n_q), (0, 0)))
-                else:
-                    qb = np.concatenate(
-                        [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)]
-                    )
-            qd_b = jnp.asarray(qb)
+            qd_b = jnp.asarray(_pad_block(qb, qb.shape[0]))
             handles = knn_block_adaptive_dispatch(
                 prepared.items, prepared.norm, prepared.pos, prepared.valid,
                 qd_b, mesh, k,
             )
             # start the result transfers as soon as each block's compute
-            # finishes — the 13 MB/block fetch is the arm's dominant
-            # variance term under tunnel congestion, and an async copy
-            # overlaps it with the NEXT block's compute instead of paying
-            # it inside the blocking device_get
+            # finishes — an async copy overlaps the 13 MB/block fetch with
+            # the NEXT block's compute instead of paying it inside the
+            # blocking device_get
             for h in handles:
                 try:
                     h.copy_to_host_async()
                 except (AttributeError, RuntimeError):
                     break
-            pending.append((handles, n_q))
+            pending.append((handles, qb.shape[0]))
 
-        def _collect_a():
+        def _collect_a(bi):
             handles, n_q = pending.pop(0)
             # ONE batched fetch per block (4 separate np.asarray calls would
             # pay 4 tunnel round-trips); failing rows are only QUEUED here —
@@ -1287,88 +1432,189 @@ def knn_search_prepared(
             ids_host[np.isinf(d_host)] = -1
             fail = np.flatnonzero(sa_h[:n_q] != sg_h[:n_q])
             if fail.size:
-                fallback_q.append((len(out_d), fail))
+                # device_get hands back READ-ONLY views; the deferred
+                # exact-fallback rerun writes the failing rows in place, so
+                # flagged blocks (and only they) pay a copy here
+                d_host = np.array(d_host)
+                fallback_q.append((bi, fail))
             out_d.append(d_host)
             out_i.append(ids_host)
 
-        for start in range(0, q.shape[0], block):
-            _dispatch_a(start)
-            if len(pending) > window:
-                _collect_a()
-        while pending:
-            _collect_a()
+        _run_block_pipeline(
+            len(starts), _dispatch_a, _collect_a, _pipeline_window(4)
+        )
 
         if fallback_q:
             # one exact rerun for EVERY verification-failing row of the
             # whole search (a handful by the _select_m bound)
-            rows = np.concatenate(
-                [bi * block + fr for bi, fr in fallback_q]
-            )
-            b = 64
-            while b < rows.size:
-                b *= 2
-            qf = np.zeros((b, q.shape[1]), dtype=dtype)
-            qf[: rows.size] = q[rows]
-            d_f, p_f = knn_block_kernel(
-                prepared.items, prepared.norm, prepared.pos, prepared.valid,
-                jnp.asarray(qf), mesh, k,
-            )
-            d_f = np.asarray(d_f)[: rows.size]
-            ids_f = prepared.ids[np.asarray(p_f)[: rows.size]]
-            ids_f[np.isinf(d_f)] = -1
-            at = 0
-            for bi, fr in fallback_q:
-                out_d[bi][fr] = d_f[at : at + fr.size]
-                out_i[bi][fr] = ids_f[at : at + fr.size]
-                at += fr.size
-        return np.concatenate(out_d)[:, :k_eff], np.concatenate(out_i)[:, :k_eff]
+            with profiling.phase("knn.fallback"):
+                from .precompile import shape_bucket
 
-    # overlap compute with host transfers via a BOUNDED in-flight window
-    # (jax execution is async): block b+window computes while block b's
-    # (Q, k) results cross the host link.  The bound matters — dispatching
-    # everything up front would keep every padded query block resident on
-    # device at once and OOM large searches.
-    window = 2
-    starts = list(range(0, q.shape[0], block))
+                rows = np.concatenate(
+                    [bi * block + fr for bi, fr in fallback_q]
+                )
+                qf = np.zeros((shape_bucket(rows.size), q.shape[1]), dtype=dtype)
+                qf[: rows.size] = q[rows]
+                d_f, p_f = _cached_kernel(
+                    "knn_block", knn_block_kernel,
+                    prepared.items, prepared.norm, prepared.pos,
+                    prepared.valid, jnp.asarray(qf), mesh=mesh, k=k,
+                )
+                d_f = np.asarray(d_f)[: rows.size]
+                ids_f = prepared.ids[np.asarray(p_f)[: rows.size]]
+                ids_f[np.isinf(d_f)] = -1
+                at = 0
+                for bi, fr in fallback_q:
+                    out_d[bi][fr] = d_f[at : at + fr.size]
+                    out_i[bi][fr] = ids_f[at : at + fr.size]
+                    at += fr.size
+        with profiling.phase("knn.merge"):
+            return (
+                np.concatenate(out_d)[:, :k_eff],
+                np.concatenate(out_i)[:, :k_eff],
+            )
+
+    # exact chunk-scan route, same pipelined engine: block b+window computes
+    # while block b's (Q, k) results cross the host link.  The bound
+    # matters — dispatching everything up front would keep every padded
+    # query block resident on device at once and OOM large searches.
     pending: list = []
     out_d, out_i = [], []
 
-    def _dispatch(start):
+    def _dispatch(bi):
+        start = starts[bi]
         qb = q[start : start + block]
         n_q = qb.shape[0]
-        if n_q < block:
-            if isinstance(qb, jax.Array):
-                qb = jnp.pad(qb, ((0, block - n_q), (0, 0)))
-            else:
-                qb = np.concatenate(
-                    [qb, np.zeros((block - n_q, q.shape[1]), dtype=dtype)],
-                    axis=0,
-                )
-        d, pos = knn_block_kernel(
+        d, pos = _cached_kernel(
+            "knn_block", knn_block_kernel,
             prepared.items, prepared.norm, prepared.pos, prepared.valid,
-            jnp.asarray(qb), mesh, k,
+            jnp.asarray(_pad_block(qb, n_q)), mesh=mesh, k=k,
             # read at call time so tests can shrink the budgets to exercise
             # the multi-chunk and running-merge branches
             tile_budget=_TILE_BUDGET, collect_budget=_COLLECT_MERGE_BUDGET,
         )
+        for h in (d, pos):
+            try:
+                h.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                break
         pending.append((d, pos, n_q))
 
-    def _collect():
+    def _collect(bi):
         d, pos, n_q = pending.pop(0)
-        d_host = np.asarray(d[:n_q])
+        d_host, pos_host = jax.device_get((d, pos))
+        d_host = d_host[:n_q]
         # map device positions -> user ids on the host (int64-safe); slots
         # the kernel could not fill (k > valid items) carry inf distance by
         # construction — mark them with the -1 sentinel the out-of-core
         # merge and callers rely on
-        ids_host = prepared.ids[np.asarray(pos[:n_q])]
+        ids_host = prepared.ids[pos_host[:n_q]]
         ids_host[np.isinf(d_host)] = -1
         out_d.append(d_host)
         out_i.append(ids_host)
 
-    for start in starts:
-        _dispatch(start)
-        if len(pending) > window:
-            _collect()
-    while pending:
-        _collect()
-    return np.concatenate(out_d)[:, :k_eff], np.concatenate(out_i)[:, :k_eff]
+    _run_block_pipeline(len(starts), _dispatch, _collect, _pipeline_window(2))
+    with profiling.phase("knn.merge"):
+        return (
+            np.concatenate(out_d)[:, :k_eff],
+            np.concatenate(out_i)[:, :k_eff],
+        )
+
+
+def warm_search_kernels(
+    prepared: PreparedItems,
+    k: int,
+    mesh: Mesh,
+    n_queries: int = None,
+    d_query: int = None,
+    query_block: int = 8192,
+    dtype=np.float32,
+) -> list:
+    """Submit ahead-of-time compilations for the kernel geometries a later
+    knn_search_prepared over this prepared item set will dispatch, so XLA
+    compiles on the precompile worker pool WHILE the caller extracts and
+    stages its query partitions, instead of serially inside the first query
+    block (kNN cold_sec was 4.3 s, almost all of it this compile).  Keys are
+    derived by the same _kernel_cache_key the dispatch path uses, so the
+    first dispatch lands on the warmed executable; returns the submitted
+    keys (empty when the active route cannot be warmed, e.g. audit mode).
+
+    `n_queries` sizes the query-block bucket (default: a full query_block —
+    the steady-state production shape); `d_query` is the UNPADDED query
+    width the exact route sees (default: the prepared item width)."""
+    from .precompile import aval, global_precompiler
+
+    if _audit_count_enabled():
+        return []
+    pc = global_precompiler()
+    block = _query_block_bucket(n_queries or query_block, query_block)
+    n_pad, d_al = prepared.items.shape
+    n_shards = max(1, mesh.shape[DATA_AXIS])
+    if n_pad % n_shards:
+        return []  # the dispatch path will raise; nothing sound to warm
+    n_loc = n_pad // n_shards
+    keys = []
+    if (
+        jax.default_backend() == "tpu" and _adaptive_eligible(k, n_loc)
+    ) or _force_adaptive():
+        # the adaptive dispatch zero-pads queries to the (tile-aligned)
+        # item width before its jits, so the warmed aval uses d_al
+        q_aval = aval((block, d_al), dtype)
+        plan = _adaptive_plan(n_pad, d_al, block, mesh, k)
+        if plan[0] == "pallas":
+            m = plan[1]
+            args = (prepared.items, prepared.norm, prepared.valid, q_aval)
+            statics = dict(k=k, m=m, n_items=n_pad)
+            key = _kernel_cache_key("knn_fused", args, None, statics)
+            pc.submit(key, _adaptive_dispatch_fused, *args, **statics)
+            keys.append(key)
+        else:
+            _, chunk, m = plan
+            args = (
+                prepared.items, prepared.norm, prepared.pos,
+                prepared.valid, q_aval,
+            )
+            statics = dict(k=k, chunk=chunk)
+            if n_shards == 1:
+                key = _kernel_cache_key("knn_cand_single", args, None, statics)
+                pc.submit(key, _adaptive_candidates_single, *args, **statics)
+            else:
+                key = _kernel_cache_key("knn_cand_sharded", args, mesh, statics)
+                pc.submit(
+                    key, _adaptive_candidates_sharded, *args,
+                    mesh=mesh, **statics,
+                )
+            keys.append(key)
+            # the scan route's merge is a SECOND jit (the pallas route fuses
+            # it): derive the candidate-pool geometry the scan will emit and
+            # warm it too, or the first block still pays a serial compile.
+            # The multi-shard scan's all_gather emits REPLICATED pool arrays
+            # (NamedSharding(mesh, P())) — the warmed executable must be
+            # compiled for that placement or it rejects its inputs at run
+            # time and falls back to a serial jit compile.
+            G, _m = _scan_geometry(k, chunk, n_pad // n_shards)
+            n_chunks = -(-(n_pad // n_shards) // chunk)
+            pool = n_shards * n_chunks * (chunk // G) * m
+            from jax.sharding import NamedSharding
+
+            rep = NamedSharding(mesh, P()) if n_shards > 1 else None
+            margs = tuple(
+                jax.ShapeDtypeStruct((block, pool), dt, sharding=rep)
+                for dt in (np.float32, np.dtype(prepared.pos.dtype))
+            )
+            mstatics = dict(k=k, m=m)
+            mkey = _kernel_cache_key("knn_merge_self", margs, None, mstatics)
+            pc.submit(mkey, _adaptive_merge_self, *margs, **mstatics)
+            keys.append(mkey)
+        return keys
+    q_aval = aval((block, d_query or d_al), dtype)
+    args = (
+        prepared.items, prepared.norm, prepared.pos, prepared.valid, q_aval,
+    )
+    statics = dict(
+        k=k, tile_budget=_TILE_BUDGET, collect_budget=_COLLECT_MERGE_BUDGET
+    )
+    key = _kernel_cache_key("knn_block", args, mesh, statics)
+    pc.submit(key, knn_block_kernel, *args, mesh=mesh, **statics)
+    keys.append(key)
+    return keys

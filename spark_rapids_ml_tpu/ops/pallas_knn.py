@@ -37,6 +37,7 @@
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -49,8 +50,11 @@ from .pallas_tpu import _round_up, pallas_enabled
 # tile geometry: TQ queries x TI items per grid cell, D consumed in KB-wide
 # blocks.  VMEM at (256, 1024, 512): 2x double-buffered q/item blocks
 # (2*(256+1024)*512*4 = 5.2 MB) + the f32 accumulator tile (1 MB) + norm
-# slivers — comfortably inside the ~15 MB scoped budget.
-_TILE_Q = 256
+# slivers — comfortably inside the ~15 MB scoped budget.  TQ and the
+# query-resident K-block cap are hardware-tuning knobs (SRML_KNN_TILE_Q /
+# SRML_KNN_TILE_D, read once at import) so TPU generations with different
+# VMEM/MXU balances can be swept without code edits.
+_TILE_Q = int(os.environ.get("SRML_KNN_TILE_Q", "256"))
 _TILE_I = 1024
 _TILE_D = 512
 
@@ -59,17 +63,12 @@ _TILE_D = 512
 # duplicated here to keep the import DAG acyclic)
 _MIN_ALIGN_ROWS = 1 << 15
 
-# VMEM budget for the query-resident accumulator slab (q_pad x tile_i f32);
-# past it the (i, j, b) kernel's per-tile scratch is used instead.  32 MB
-# covers the 8192-query bench block at tile_i=1024 and leaves >half of the
-# v5e's 128 MB VMEM for blocks, hi/lo scratch and epilogue temporaries.
-_ACC_SCRATCH_BUDGET = 32 << 20
-
 # K-block cap for the query-resident kernel (the whole D when it fits):
 # (tile_i, kb) f32 in-blocks double-buffered + the bf16 hi/lo scratch cost
-# ~(4 + 4 + 2 + 2) bytes x tile_i x kb = 36 MB at (1024, 3072), which with
-# the 32 MB accumulator slab stays inside the raised 100 MB scoped budget.
-_TILE_D_QRES = 3072
+# ~(4 + 4 + 2 + 2) bytes x tile_i x kb = 36 MB at (1024, 3072), which stays
+# inside the raised 100 MB scoped budget alongside the (TQ, TI) accumulator
+# tile and the epilogue temporaries.
+_TILE_D_QRES = int(os.environ.get("SRML_KNN_TILE_D", "3072"))
 
 
 def pallas_align_dims(n_rows: int, d: int, n_dev: int):
@@ -216,59 +215,76 @@ def _knn_topm_kernel_qres(
     *, m: int, m_pad: int, n_items: int, tile_i: int, d_true: int, kd: int,
     tq: int,
 ):
-    """Query-resident-accumulator variant: grid (j, b, i) with the QUERY
-    tile innermost, so the (tile_i, kd) item block's index map (j, b) is
-    constant across the whole i sweep — Mosaic skips the repeated DMA and
-    the multi-GB item set crosses HBM ONCE per (j, b) instead of once per
-    query tile (the (i, j, b) grid re-read it q_pad/tq times: 157 GB at
-    the 400k x 3000 bench shape).  The item block's bf16 hi/lo split is
-    computed once per block (at i == 0) into scratch; the QUERY hi/lo
-    split happens IN-KERNEL like _accum_dot's — precomputing it in XLA
-    was measured precision-UNSAFE on this backend: the terminal forces
-    --xla_allow_excess_precision=true, which legally cancels the
-    f32 -> bf16 -> f32 round-trip so q_lo folds to ZERO and the scan
-    silently degrades to ~1-pass bf16 (d2 abs err 0.14 vs 4e-4; caught
-    by the hardware audit vs f64 ground truth).  Mosaic performs the
-    casts as written.  Costs a (q_pad, tile_i) f32 accumulator slab in VMEM
-    (32 MB at 8192 queries x 1024 items) because every query tile's
-    accumulation is in flight at once — the wrapper gates on that budget
-    and falls back to the (i, j, b) kernel past it."""
+    """Query-resident variant: grid (j, i, b) — item group, query tile,
+    K (D) block, with the K block INNERMOST.
+
+    Grid contract (the load-bearing property): the output block map
+    (j, 0, i) ignores b, so every output block is revisited once per K
+    block.  Pallas TPU only defines revisited output blocks when the
+    revisiting dimension is innermost — consecutive visits keep the block
+    VMEM-resident and flush it exactly once, after the b == nb-1 epilogue
+    writes it.  (The previous (j, b, i) grid revisited outputs with b NOT
+    innermost: every intermediate visit copied stale double-buffered VMEM
+    over the same HBM region with no ordering guarantee against the final
+    epilogue DMA — undefined behavior whenever nb > 1.)
+
+    Single-K-block case (nb == 1, covers the d<=3072 bench shapes): the
+    item block's index map (j, b=0) is constant across the whole innermost
+    i sweep, so Mosaic skips the repeated DMA and the multi-GB item set
+    crosses HBM ONCE per group — the property the old grid bought (the
+    plain (i, j, b) kernel re-reads it q_pad/tq times: 157 GB at the
+    400k x 3000 bench shape).  The bf16 hi/lo split of the resident block
+    is computed once (at i == 0) into scratch.
+
+    Multi-K-block case (nb > 1, D > the VMEM cap): the item block map
+    (j, b) changes every step, so item blocks are re-fetched per query
+    tile — correctness costs item-side HBM traffic here, and the hi/lo
+    split is computed inline per block (the i == 0 scratch would be stale:
+    it would hold block nb-1 from the previous sweep).  Accumulation uses
+    a per-tile (tq, tile_i) f32 scratch zeroed at b == 0 — no q_pad-sized
+    slab, so the route no longer needs a query-count budget gate.
+
+    The QUERY hi/lo split happens IN-KERNEL like _accum_dot's —
+    precomputing it in XLA was measured precision-UNSAFE on this backend:
+    the terminal forces --xla_allow_excess_precision=true, which legally
+    cancels the f32 -> bf16 -> f32 round-trip so q_lo folds to ZERO and
+    the scan silently degrades to ~1-pass bf16 (d2 abs err 0.14 vs 4e-4;
+    caught by the hardware audit vs f64 ground truth).  Mosaic performs
+    the casts as written."""
     import jax.experimental.pallas as pl
 
     j = pl.program_id(0)
-    b = pl.program_id(1)
-    i = pl.program_id(2)
-
-    @pl.when(i == 0)
-    def _():
-        # no D-tail masking here: the qres route picks kb to DIVIDE the
-        # padded width, and _aligned_items/qp zero-pad their columns, so
-        # every block read is in-bounds zero-padded data
-        it = it_ref[:]
-        hi = it.astype(jnp.bfloat16)
-        ith[:] = hi
-        itl[:] = (it - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    i = pl.program_id(1)
+    b = pl.program_id(2)
 
     single = d_true <= kd  # whole D in one K block: no cross-step state
+
+    # no D-tail masking in either case: the qres route picks kb to DIVIDE
+    # the padded width, and _aligned_items/qp zero-pad their columns, so
+    # every block read is in-bounds zero-padded data
+    if single:
+        @pl.when(i == 0)
+        def _():
+            it = it_ref[:]
+            hi = it.astype(jnp.bfloat16)
+            ith[:] = hi
+            itl[:] = (it - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+        it_hi = ith[:]
+        it_lo = itl[:]
+    else:
+        it = it_ref[:]
+        it_hi = it.astype(jnp.bfloat16)
+        it_lo = (it - it_hi.astype(jnp.float32)).astype(jnp.bfloat16)
 
     q = q_ref[:]
     q_hi = q.astype(jnp.bfloat16)
     q_lo = (q - q_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    it_hi = ith[:]
-    it_lo = itl[:]
     dots = (
         jnp.dot(q_hi, it_hi.T, preferred_element_type=jnp.float32)
         + jnp.dot(q_hi, it_lo.T, preferred_element_type=jnp.float32)
         + jnp.dot(q_lo, it_hi.T, preferred_element_type=jnp.float32)
     )
-    if not single:
-        rows = pl.ds(i * tq, tq)
-
-        @pl.when(b == 0)
-        def _():
-            acc[rows, :] = jnp.zeros((tq, acc.shape[1]), acc.dtype)
-
-        acc[rows, :] += dots
 
     def _epilogue(a):
         neg = _neg_d2(qn_ref, inorm_ref, a, j, n_items, tile_i)
@@ -278,9 +294,15 @@ def _knn_topm_kernel_qres(
         _epilogue(dots)
     else:
 
-        @pl.when(b == pl.num_programs(1) - 1)
+        @pl.when(b == 0)
         def _():
-            _epilogue(acc[pl.ds(i * tq, tq), :])
+            acc[:] = jnp.zeros_like(acc)
+
+        acc[:] += dots
+
+        @pl.when(b == pl.num_programs(2) - 1)
+        def _():
+            _epilogue(acc[:])
 
 
 def _knn_count_kernel(
@@ -345,7 +367,7 @@ def knn_candidates_pallas(
     d_pad = _round_up(d, 128)
     q_pad = _round_up(Q, tq)
     m_pad = _round_up(m, 8)
-    use_qres = not legacy and q_pad * tile_i * 4 <= _ACC_SCRATCH_BUDGET
+    use_qres = not legacy
     if use_qres:
         # one K block spanning as much of D as VMEM allows (hardware A/B:
         # 6 x 512 K blocks 0.57 s -> one 3072 block 0.455 s per bench
@@ -386,41 +408,49 @@ def knn_candidates_pallas(
         jax.ShapeDtypeStruct((ng, m_pad, q_pad), jnp.int32),
     ]
     if use_qres:
-        # query-resident-accumulator grid: item blocks cross HBM once per
-        # (group, D-block) instead of once per query tile (kernel header)
+        # query-resident grid (j, i, b), K blocks innermost: output blocks
+        # are revisited CONSECUTIVELY across b (defined Pallas semantics for
+        # nb > 1), and at nb == 1 the item block stays VMEM-resident across
+        # the whole i sweep — items cross HBM once per group (kernel header)
         vals, idxs = pl.pallas_call(
             functools.partial(
                 _knn_topm_kernel_qres,
                 m=m, m_pad=m_pad, n_items=n_items, tile_i=tile_i,
                 d_true=d_blk, kd=kb, tq=tq,
             ),
-            grid=(ng, d_blk // kb, q_pad // tq),
+            grid=(ng, q_pad // tq, d_blk // kb),
             in_specs=[
-                pl.BlockSpec((tq, 1), lambda j, b, i: (i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, tile_i), lambda j, b, i: (0, j), memory_space=pltpu.VMEM),
-                pl.BlockSpec((tq, kb), lambda j, b, i: (i, b), memory_space=pltpu.VMEM),
-                pl.BlockSpec((tile_i, kb), lambda j, b, i: (j, b), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tq, 1), lambda j, i, b: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, tile_i), lambda j, i, b: (0, j), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tq, kb), lambda j, i, b: (i, b), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tile_i, kb), lambda j, i, b: (j, b), memory_space=pltpu.VMEM),
             ],
             out_specs=[
                 pl.BlockSpec(
-                    (1, m_pad, tq), lambda j, b, i: (j, 0, i),
+                    (1, m_pad, tq), lambda j, i, b: (j, 0, i),
                     memory_space=pltpu.VMEM,
                 ),
                 pl.BlockSpec(
-                    (1, m_pad, tq), lambda j, b, i: (j, 0, i),
+                    (1, m_pad, tq), lambda j, i, b: (j, 0, i),
                     memory_space=pltpu.VMEM,
                 ),
             ],
             out_shape=out_shape,
             scratch_shapes=[
-                # the accumulator slab only exists when D spans multiple
-                # K blocks; at nb == 1 the dots feed the epilogue directly
+                # per-tile accumulator, only live when D spans multiple K
+                # blocks; at nb == 1 the dots feed the epilogue directly
+                # and the scratch degenerates to one min-tile
                 pltpu.VMEM(
-                    (q_pad, tile_i) if d_blk > kb else (8, 128),
-                    jnp.float32,
+                    (tq, tile_i) if d_blk > kb else (8, 128), jnp.float32
                 ),
-                pltpu.VMEM((tile_i, kb), jnp.bfloat16),
-                pltpu.VMEM((tile_i, kb), jnp.bfloat16),
+                # resident item hi/lo cache, only read at nb == 1 (the
+                # multi-block case recomputes inline; see kernel header)
+                pltpu.VMEM(
+                    (tile_i, kb) if d_blk <= kb else (8, 128), jnp.bfloat16
+                ),
+                pltpu.VMEM(
+                    (tile_i, kb) if d_blk <= kb else (8, 128), jnp.bfloat16
+                ),
             ],
             compiler_params=tpu_compiler_params(
                 vmem_limit_bytes=100 << 20

@@ -34,6 +34,8 @@ from typing import Any, List, Sequence
 
 import numpy as np
 
+from .. import profiling
+
 # per-frame chunk bound: Spark's allGather rides the RPC channel
 # (spark.rpc.message.maxSize default 128 MiB); 8 MiB keeps each frame far
 # under the limit with base64 overhead (same bound as knn._allgather_large)
@@ -115,17 +117,20 @@ def allgather_bytes(
 ) -> List[bytes]:
     """Broadcast allGather of one binary payload per rank (every receiver
     materializes every rank's payload — use for data all sides need, e.g.
-    the query broadcast).  Chunked under the transport frame limit."""
-    use_bytes = hasattr(cp, "allGatherBytes")
-    mine = _chunks(payload, chunk)
-    counts = [int(c) for c in cp.allGather(str(len(mine)))]
-    parts: List[List[bytes]] = [[] for _ in counts]
-    for r in range(max(counts)):
-        got = _send(cp, mine[r] if r < len(mine) else b"", use_bytes)
-        for s, g in enumerate(got):
-            if r < counts[s]:
-                parts[s].append(_recv(g, use_bytes))
-    return [b"".join(p) for p in parts]
+    the query broadcast).  Chunked under the transport frame limit.
+    Wall-clock lands in the "exchange.allgather" profiling phase so
+    control-plane time is separable from device compute in fit reports."""
+    with profiling.phase("exchange.allgather"):
+        use_bytes = hasattr(cp, "allGatherBytes")
+        mine = _chunks(payload, chunk)
+        counts = [int(c) for c in cp.allGather(str(len(mine)))]
+        parts: List[List[bytes]] = [[] for _ in counts]
+        for r in range(max(counts)):
+            got = _send(cp, mine[r] if r < len(mine) else b"", use_bytes)
+            for s, g in enumerate(got):
+                if r < counts[s]:
+                    parts[s].append(_recv(g, use_bytes))
+        return [b"".join(p) for p in parts]
 
 
 def alltoall_bytes(
@@ -148,20 +153,22 @@ def alltoall_bytes(
     owning rank)."""
     if len(dests) != nranks:
         raise ValueError(f"need {nranks} destination payloads, got {len(dests)}")
-    use_bytes = hasattr(cp, "allGatherBytes")
-    frames = [_chunks(d, chunk) for d in dests]
-    meta = json.dumps([len(f) for f in frames])
-    all_meta = [json.loads(s) for s in cp.allGather(meta)]  # [src][dest]
-    # canonical send order: dest-major concatenation of each source's chunks
-    my_seq = [c for f in frames for c in f]
-    # position range of (src -> me) chunks inside src's send sequence
-    lo = [sum(all_meta[s][:rank]) for s in range(nranks)]
-    hi = [lo[s] + all_meta[s][rank] for s in range(nranks)]
-    rounds = max(sum(m) for m in all_meta)
-    mine: List[List[bytes]] = [[] for _ in range(nranks)]
-    for r in range(rounds):
-        got = _send(cp, my_seq[r] if r < len(my_seq) else b"", use_bytes)
-        for s in range(nranks):
-            if lo[s] <= r < hi[s]:
-                mine[s].append(_recv(got[s], use_bytes))
-    return [b"".join(p) for p in mine]
+    with profiling.phase("exchange.alltoall"):
+        use_bytes = hasattr(cp, "allGatherBytes")
+        frames = [_chunks(d, chunk) for d in dests]
+        meta = json.dumps([len(f) for f in frames])
+        all_meta = [json.loads(s) for s in cp.allGather(meta)]  # [src][dest]
+        # canonical send order: dest-major concatenation of each source's
+        # chunks
+        my_seq = [c for f in frames for c in f]
+        # position range of (src -> me) chunks inside src's send sequence
+        lo = [sum(all_meta[s][:rank]) for s in range(nranks)]
+        hi = [lo[s] + all_meta[s][rank] for s in range(nranks)]
+        rounds = max(sum(m) for m in all_meta)
+        mine: List[List[bytes]] = [[] for _ in range(nranks)]
+        for r in range(rounds):
+            got = _send(cp, my_seq[r] if r < len(my_seq) else b"", use_bytes)
+            for s in range(nranks):
+                if lo[s] <= r < hi[s]:
+                    mine[s].append(_recv(got[s], use_bytes))
+        return [b"".join(p) for p in mine]

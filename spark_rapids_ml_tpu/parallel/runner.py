@@ -322,11 +322,13 @@ class DistributedFitSession:
                 "or SRML_SPARK_COLLECT=1 (driver-local fit)."
             )
         df = DataFrame(list(partitions))
-        inputs = self.build_fit_inputs(estimator, df)
-        fit_func = estimator._get_tpu_fit_func(df, extra_params)
+        from .. import profiling
         from ..sanitize import sanitize_scope
 
-        with sanitize_scope():
+        with profiling.phase("runner.build_inputs"):
+            inputs = self.build_fit_inputs(estimator, df)
+        fit_func = estimator._get_tpu_fit_func(df, extra_params)
+        with sanitize_scope(), profiling.phase("runner.fit"):
             result = fit_func(inputs, dict(estimator._tpu_params))
         self.control_plane.barrier()
         results = result if isinstance(result, list) else [result]
@@ -338,6 +340,15 @@ def distributed_session(
     rank: int, nranks: int, control_plane: Optional[ControlPlane] = None
 ) -> Iterator[DistributedFitSession]:
     cp = control_plane or LocalControlPlane()
+    # Opt-in on-disk executable cache (SRML_COMPILE_CACHE): every executor
+    # process of a barrier job — and every LATER job at the same kernel
+    # geometries — deserializes executables a sibling already compiled
+    # instead of recompiling them, the fleet-wide cold_sec lever (rf_clf
+    # was 50.4 s cold, almost all XLA compilation).  Best-effort no-op
+    # when the env var is unset or jax already has a cache configured.
+    from ..ops.precompile import initialize_persistent_cache
+
+    initialize_persistent_cache()
     with TpuContext(rank, nranks, cp):
         yield DistributedFitSession(rank, nranks, cp)
 

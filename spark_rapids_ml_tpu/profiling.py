@@ -19,6 +19,12 @@
 #     equivalent of running the reference benchmarks with NCCL_DEBUG=INFO.
 #   - with_benchmark(name, fn): wall-clock helper with the same shape as the
 #     reference's benchmark/utils.py:42-50.
+#   - incr_counter/counters: PROCESS-wide monotonic counters (the precompile
+#     subsystem's compile/hit/miss accounting — its worker threads must be
+#     able to report into the same registry the main thread reads).
+#   - record_event/events: a per-thread ORDERED event log for asserting
+#     pipeline interleavings (e.g. "block i+1 dispatched before block i
+#     collected" in the kNN query engine) without timing-dependent tests.
 #
 
 from __future__ import annotations
@@ -53,6 +59,72 @@ def reset_phase_times() -> None:
 def phase_times() -> Dict[str, float]:
     """Seconds per named phase recorded on this thread since the last reset."""
     return dict(_registry())
+
+
+# -- process-wide counters ---------------------------------------------------
+# Unlike the phase registry these are NOT thread-local: the precompile worker
+# pool compiles on daemon threads while fits read the counters from the main
+# thread, so one locked registry is the only consistent view.
+
+_counters_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def incr_counter(name: str, amount: int = 1) -> None:
+    """Add `amount` to the process-wide counter `name` (created at 0)."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + amount
+
+
+def counter(name: str) -> int:
+    with _counters_lock:
+        return _counters.get(name, 0)
+
+
+def counters(prefix: str = "") -> Dict[str, int]:
+    """Snapshot of all counters (optionally filtered by name prefix)."""
+    with _counters_lock:
+        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def reset_counters(prefix: str = "") -> None:
+    """Zero counters matching `prefix` (tests; production code never resets —
+    the counters are monotonic so deltas are always well-defined)."""
+    with _counters_lock:
+        for k in [k for k in _counters if k.startswith(prefix)]:
+            del _counters[k]
+
+
+# -- per-thread ordered event log --------------------------------------------
+# Bounded so a long-lived process that never drains the log cannot grow it
+# without limit; the cap is far above any one search's dispatch/collect count.
+
+_EVENT_CAP = 4096
+
+
+def _event_log() -> list:
+    log = getattr(_tls, "events", None)
+    if log is None:
+        log = []
+        _tls.events = log
+    return log
+
+
+def record_event(name: str, **meta: Any) -> None:
+    """Append (name, meta) to this thread's ordered event log (dropped
+    silently past the cap — the log is observability, never control flow)."""
+    log = _event_log()
+    if len(log) < _EVENT_CAP:
+        log.append((name, meta))
+
+
+def events(prefix: str = "") -> list:
+    """This thread's events in record order, optionally prefix-filtered."""
+    return [(n, m) for n, m in _event_log() if n.startswith(prefix)]
+
+
+def reset_events() -> None:
+    _event_log().clear()
 
 
 @contextlib.contextmanager

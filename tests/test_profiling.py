@@ -58,3 +58,40 @@ def test_maybe_trace_noop_without_env(monkeypatch):
     monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
     with profiling.maybe_trace("x"):
         pass
+
+
+def test_counters_are_process_wide_and_resettable():
+    import threading
+
+    profiling.reset_counters("test.ctr")
+    profiling.incr_counter("test.ctr.a")
+    profiling.incr_counter("test.ctr.a", 2)
+
+    # increments from another thread land in the same registry (the
+    # precompile worker-pool contract)
+    t = threading.Thread(target=lambda: profiling.incr_counter("test.ctr.b"))
+    t.start()
+    t.join()
+    assert profiling.counter("test.ctr.a") == 3
+    assert profiling.counters("test.ctr") == {
+        "test.ctr.a": 3,
+        "test.ctr.b": 1,
+    }
+    profiling.reset_counters("test.ctr")
+    assert profiling.counters("test.ctr") == {}
+
+
+def test_event_log_order_and_reset():
+    profiling.reset_events()
+    profiling.record_event("t.dispatch", block=0)
+    profiling.record_event("t.dispatch", block=1)
+    profiling.record_event("t.collect", block=0)
+    ev = profiling.events("t.")
+    assert ev == [
+        ("t.dispatch", {"block": 0}),
+        ("t.dispatch", {"block": 1}),
+        ("t.collect", {"block": 0}),
+    ]
+    assert profiling.events("t.collect") == [("t.collect", {"block": 0})]
+    profiling.reset_events()
+    assert profiling.events() == []
