@@ -3,6 +3,14 @@
 # score = trustworthiness of the embedding (bench_umap.py uses the same
 # sklearn.manifold metric).
 #
+# Same countermeasures PR 2 applied to bench_nearest_neighbors (which cut
+# the kNN arm's run-to-run spread from 31%): deterministic block-stashed
+# staging, an explicit warm-up iteration so the timed run measures
+# steady-state throughput off cached AOT executables, and phase-timing +
+# precompile/engine counter reporting so regressions are attributable
+# (umap.graph / umap.init / umap.layout / umap.transform mirror the knn.*
+# phase set).
+#
 
 from __future__ import annotations
 
@@ -44,29 +52,69 @@ class BenchmarkUMAP(BenchmarkBase):
         label_col: Optional[str],
     ) -> Dict[str, Any]:
         params = dict(self._class_params)
-        transform_df = transform_df or train_df
         if self.args.mode != "tpu":
             raise NotImplementedError(
                 "cpu mode needs umap-learn, which is not bundled; run --mode tpu"
             )
-        from spark_rapids_ml_tpu import UMAP
+        from spark_rapids_ml_tpu import UMAP, profiling
 
-        est = UMAP(**params, **self.num_workers_arg()).setFeaturesCol(features_col)
-        model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
-        out, transform_time = with_benchmark(
-            "transform", lambda: model.transform(transform_df)
+        # Deterministic staging: re-host the loaded frames as block-stashed
+        # f32 DataFrames (from_numpy pins ONE contiguous feature block per
+        # partition) so the fit's device fast path consumes a stable device
+        # handle and repeat fits stage identically — the column-stacked
+        # parquet frames re-extract fresh arrays per call.
+        X, _ = self.to_numpy(train_df, features_col, None)
+        train_bdf = DataFrame.from_numpy(X.astype(np.float32))
+        if transform_df is not None:
+            Q, _ = self.to_numpy(transform_df, features_col, None)
+            query_bdf = DataFrame.from_numpy(Q.astype(np.float32))
+            Xq = Q
+        else:
+            query_bdf = train_bdf
+            Xq = X
+
+        est = UMAP(**params, **self.num_workers_arg()).setFeaturesCol("features")
+        # explicit warm-up iteration: compiles every engine geometry (graph
+        # assembly, layout/transform steps, knn kernels) into the AOT
+        # executable cache — the timed run below then measures steady-state
+        # throughput with zero new compilations (precompile.* deltas) and a
+        # layout loop of ceil(n_epochs / SRML_UMAP_EPOCH_BLOCK) dispatches
+        warm_model, warmup_fit_time = with_benchmark(
+            "fit warmup", lambda: est.fit(train_bdf)
         )
+        _, warmup_transform_time = with_benchmark(
+            "transform warmup", lambda: warm_model.transform(query_bdf)
+        )
+        profiling.reset_phase_times()
+        counters0 = profiling.counters()
+        model, fit_time = with_benchmark("fit", lambda: est.fit(train_bdf))
+        out, transform_time = with_benchmark(
+            "transform", lambda: model.transform(query_bdf)
+        )
+        phases = {
+            name: round(sec, 4)
+            for name, sec in sorted(profiling.phase_times().items())
+        }
+        deltas = profiling.counter_deltas(counters0)
         # score the transform OUTPUT against the transform input so the timed
         # path is also the evaluated path
-        X, _ = self.to_numpy(transform_df, features_col, None)
         out_col = model.getOrDefault("outputCol")
         emb = np.concatenate(
             [np.asarray(list(p[out_col]), dtype=np.float64) for p in out.partitions if len(p)]
         )
-        score = self._trustworthiness(X, emb, params["n_neighbors"])
+        score = self._trustworthiness(Xq, emb, params["n_neighbors"])
         return {
             "fit_time": fit_time,
+            "warmup_fit_time": warmup_fit_time,
+            "warmup_transform_time": warmup_transform_time,
             "transform_time": transform_time,
             "total_time": fit_time + transform_time,
             "score": score,
+            "phase_times": phases,
+            "precompile_counters": {
+                k: v for k, v in deltas.items() if k.startswith("precompile")
+            },
+            "umap_counters": {
+                k: v for k, v in deltas.items() if k.startswith("umap")
+            },
         }

@@ -43,6 +43,23 @@ fi
 python -m pytest tests/test_pallas.py -q -k knn
 python -m pytest tests/test_precompile.py -q
 
+# 3c. focused gates for the sharded UMAP engine (also inside the full suite;
+#     re-asserted by name so marker drift can never silently drop them).
+#     Runs on the multi-device CPU mesh — conftest injects the 8-device
+#     flag, forced explicitly here so a stripped environment still gets it:
+#     - mesh-shape parity: fixed seed => same embedding on a 1-device and
+#       an 8-device mesh, and k=15 neighbor preservation within 1% of the
+#       single-device reference layout
+#     - epoch loop issues ceil(n_epochs / SRML_UMAP_EPOCH_BLOCK) dispatches
+#       and repeat same-shape fits perform ZERO new compilations
+#     - graph assembly stays on device (single-upload transfer counters)
+#     plus a graftlint-clean re-check of the engine modules by name.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_umap_engine.py -q
+python -m tools.graftlint spark_rapids_ml_tpu/ops/umap.py \
+    spark_rapids_ml_tpu/models/umap.py spark_rapids_ml_tpu/ops/precompile.py \
+    spark_rapids_ml_tpu/parallel/mesh.py spark_rapids_ml_tpu/parallel/exchange.py
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

@@ -49,6 +49,20 @@ def tpu_compiler_params(**kwargs: Any) -> Any:
     return cls(**kwargs)
 
 
+def threefry_2x32(key_data: Any, counters: Any) -> Any:
+    """Raw counter-mode threefry: hash a uint32 counter array under a (2,)
+    uint32 key.  The UMAP layout engine derives its per-edge firing draws
+    from GLOBAL element counters so any shard of the grid draws the same
+    values a single device would (seed-deterministic across mesh shapes).
+    The callable moved out of the public jax.random namespace across
+    releases; import it from here."""
+    try:  # older jax exported it publicly
+        from jax.random import threefry_2x32 as _tf  # type: ignore[attr-defined]
+    except ImportError:
+        from jax._src.prng import threefry_2x32 as _tf
+    return _tf(key_data, counters)
+
+
 def enable_x64(enabled: bool = True) -> Any:
     """Context manager enabling 64-bit jax types for its scope (jax
     .enable_x64 where available, jax.experimental.enable_x64 otherwise)."""

@@ -11,7 +11,11 @@
 # the reference's y= branch at umap.py:939-947) is supported.
 # Differences by design: the kNN graph is built by the mesh-distributed
 # exact kNN kernel instead of single-GPU cuML, so fit itself scales across
-# the mesh; "spectral" init is the Laplacian eigenmap of the fuzzy graph;
+# the mesh; graph assembly and the SGD layout epochs are mesh-parallel too
+# (on-device symmetrize/dedupe/pad + head-block-sharded scan-batched
+# epochs, ops/umap.py / docs/umap_engine.md — fixed seed gives the same
+# embedding on any mesh shape); "spectral" init is the Laplacian eigenmap
+# of the fuzzy graph;
 # transform initializes at the weighted neighbor mean then runs the
 # n_epochs//3 (or 100/30) SGD refinement epochs against the frozen training
 # embedding, as cuml/umap-learn transform does.
@@ -242,8 +246,10 @@ class UMAP(_UMAPParams, _TpuEstimator):
                     float(params["spread"]), float(params["min_dist"])
                 )
             logger.info("UMAP graph built: n=%d k=%d (a=%.3f b=%.3f)", n, k, a, b)
+            # the same mesh that served the kNN self-join drives the
+            # sharded layout epochs: each device owns a head block of the
+            # padded edge layout (ops/umap.optimize_layout_sharded)
             embedding = umap_fit_embedding(
-                X,
                 ids,
                 dists,
                 n_components=int(params["n_components"]),
@@ -258,6 +264,7 @@ class UMAP(_UMAPParams, _TpuEstimator):
                 negative_sample_rate=int(params["negative_sample_rate"]),
                 seed=seed,
                 y=y,
+                mesh=mesh,
             )
             return {
                 "embedding_": embedding.astype(np.float32),

@@ -167,37 +167,12 @@ def _query_block_bucket(n_rows: int, query_block: int) -> int:
     return shape_bucket(min(query_block, n_rows), lo=64)
 
 
-def _cached_kernel(name: str, fn, *args, mesh: Mesh = None, **statics):
-    """Dispatch a jitted kernel through the process-wide AOT executable
-    cache (ops/precompile): keyed on (kernel name, per-arg shape/dtype,
-    mesh fingerprint, statics), compiled once per key — from the concrete
-    args, so shardings are captured — and reused by every later same-shape
-    call (repeat searches, benchmarks, other models' queries).  The mesh
-    rides the key by VALUE (get_mesh builds fresh Mesh objects per call)."""
-    from .precompile import global_precompiler
-
-    key = _kernel_cache_key(name, args, mesh, statics)
-    if mesh is not None:
-        statics["mesh"] = mesh
-    if not hasattr(fn, "lower"):
-        # plain callable (tests monkeypatch the jitted phases with spies):
-        # nothing to AOT-compile, call through
-        return fn(*args, **statics)
-    return global_precompiler().cached_call(key, fn, *args, **statics)
-
-
-def _kernel_cache_key(name: str, args, mesh, statics: dict):
-    """The ONE key derivation shared by dispatch-time _cached_kernel and the
-    warm_search_kernels submit path — a warmed executable must be the exact
-    entry the later dispatch looks up."""
-    from .precompile import mesh_fingerprint
-
-    return (
-        name,
-        tuple((tuple(a.shape), str(a.dtype)) for a in args),
-        mesh_fingerprint(mesh),
-        tuple(sorted(statics.items())),
-    )
+# AOT executable-cache dispatch + key derivation now live in ops/precompile
+# (shared with the sharded UMAP layout engine); the local names are kept —
+# every dispatch site and the warm_search_kernels submit path key through
+# the same helpers.
+from .precompile import cached_kernel as _cached_kernel
+from .precompile import kernel_cache_key as _kernel_cache_key
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "tile_budget", "collect_budget"))

@@ -297,3 +297,34 @@ def global_precompiler() -> Precompiler:
     if _global is None:
         _global = Precompiler()
     return _global
+
+
+def kernel_cache_key(name: str, args, mesh, statics: dict):
+    """The ONE key derivation shared by dispatch-time cached_kernel and the
+    AOT warm paths (e.g. knn.warm_search_kernels) — a warmed executable must
+    be the exact entry the later dispatch looks up."""
+    return (
+        name,
+        tuple((tuple(a.shape), str(a.dtype)) for a in args),
+        mesh_fingerprint(mesh),
+        tuple(sorted(statics.items())),
+    )
+
+
+def cached_kernel(name: str, fn, *args, mesh=None, **statics):
+    """Dispatch a jitted kernel through the process-wide AOT executable
+    cache: keyed on (kernel name, per-arg shape/dtype, mesh fingerprint,
+    statics), compiled once per key — from the concrete args, so shardings
+    are captured — and reused by every later same-shape call (repeat
+    searches and fits, benchmarks, other models' queries).  The mesh rides
+    the key by VALUE (get_mesh builds fresh Mesh objects per call).  Shared
+    by the kNN query engine (ops/knn.py) and the sharded UMAP layout engine
+    (ops/umap.py)."""
+    key = kernel_cache_key(name, args, mesh, statics)
+    if mesh is not None:
+        statics["mesh"] = mesh
+    if not hasattr(fn, "lower"):
+        # plain callable (tests monkeypatch the jitted phases with spies):
+        # nothing to AOT-compile, call through
+        return fn(*args, **statics)
+    return global_precompiler().cached_call(key, fn, *args, **statics)

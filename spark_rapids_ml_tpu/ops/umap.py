@@ -3,22 +3,38 @@
 #
 # TPU-native replacement for cuML's UMAP fit/transform (used by the reference
 # at umap.py:926 and :1159).  The algorithm follows the published UMAP
-# formulation (McInnes et al.); the implementation is shaped for XLA:
+# formulation (McInnes et al.); the implementation is shaped for XLA and,
+# since the sharded-engine rework, for the DEVICE MESH:
 #
 #   - kNN graph from ops/knn.py (exact, mesh-distributed)
 #   - smooth-kNN calibration (rho/sigma) as a vectorized fixed-iteration
 #     bisection over all points at once
-#   - edge list kept dense (n * k edges); the optimization loop is a
-#     lax.fori over epochs in one jit: per epoch every edge is considered
-#     with probability proportional to its weight (the epochs_per_sample
-#     schedule expressed as a bernoulli mask), attraction + negative-sample
-#     repulsion gradients accumulate via segment_sum scatter-adds
+#   - ON-DEVICE GRAPH ASSEMBLY: symmetrize/dedupe/pad runs as jnp sort +
+#     searchsorted + gather kernels, so the fuzzy graph never round-trips
+#     through the host (the only host sync is one scalar — the P98 degree
+#     that fixes the static pad width)
+#   - MESH-PARALLEL LAYOUT: the padded head layout is sharded over
+#     DATA_AXIS (each device owns a contiguous head block, the embedding is
+#     replicated, per-epoch updates are combined with one tiled all-gather
+#     through parallel/exchange.allgather_rows); edge firing draws come
+#     from counter-based threefry keyed on GLOBAL padded positions, so a
+#     fixed seed produces the same embedding on any mesh shape
+#   - SCAN-BATCHED EPOCHS: SRML_UMAP_EPOCH_BLOCK epochs run per jitted step
+#     via lax.scan, and every step dispatches through the process-wide AOT
+#     executable cache (ops/precompile.cached_kernel) — repeat same-shape
+#     fits perform zero new compilations
 #   - init: "random", or "spectral" = normalized-Laplacian eigenmap of the
 #     fuzzy graph via deflated subspace iteration (as cuml/umap-learn)
+#
+# Phase timers mirror the knn.* set: umap.graph / umap.init / umap.layout /
+# umap.transform; process counters: umap.h2d_transfers / umap.h2d_bytes
+# (host->device uploads — the graph must ride the link ONCE) and
+# umap.layout.dispatches / umap.transform.dispatches (epoch-step launches).
 #
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -26,6 +42,18 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from .. import profiling
+from ..compat import shard_map, threefry_2x32
+from ..parallel.mesh import (
+    DATA_AXIS,
+    Mesh,
+    col_sharding,
+    get_mesh,
+    padded_row_count,
+    replicated_sharding,
+)
+from jax.sharding import PartitionSpec as PSpec
 
 
 def find_ab_params(spread: float, min_dist: float) -> Tuple[float, float]:
@@ -150,6 +178,7 @@ def _laplacian_eigenmap_kernel(
     tails_pad: jax.Array,  # (n, P) int32 head-grouped directed neighbors
     w_pad: jax.Array,      # (n, P) symmetric weights (0 = padding)
     key: jax.Array,
+    valid_count: jax.Array,  # () rows beyond this are padding (zeroed in x0)
     c: int,
     n_iter: int = 50,
 ) -> jax.Array:
@@ -160,14 +189,18 @@ def _laplacian_eigenmap_kernel(
     layout (gather + axis sum) — the edge-list scatter-add formulation this
     replaces cost ~120M scalar scatter updates for a 50k x 15 graph at 50
     iterations, the single slowest phase of the round-2 UMAP fit.  The
-    trivial eigenvector D^1/2*1 is projected out each iteration."""
+    trivial eigenvector D^1/2*1 is projected out each iteration.
+
+    Padding rows (>= valid_count; zero-degree self-loops by construction)
+    are zeroed in the random start and stay exactly zero through every
+    SpMV, so they never perturb the subspace the real graph converges to."""
     n, P = tails_pad.shape
     deg = w_pad.sum(axis=1)
     dinv = 1.0 / jnp.sqrt(jnp.maximum(deg, 1e-12))
     wn = w_pad * dinv[:, None] * dinv[tails_pad]
     # trivial top eigenvector of A_hat (unit-normalized)
     v0 = jnp.sqrt(jnp.maximum(deg, 0.0))
-    v0 = v0 / jnp.linalg.norm(v0)
+    v0 = v0 / jnp.maximum(jnp.linalg.norm(v0), 1e-12)
 
     # Component-sliced SpMV in (P, n) layout: the natural (n, P, c) form
     # puts c (= 2-3 components) in the minor dimension, which TPU tiles pad
@@ -177,7 +210,7 @@ def _laplacian_eigenmap_kernel(
     # per-component x[:, j][tails] form scalarizes into c single-element
     # gather chains — 2.6 s for the 50-iteration loop at 50k x 15 where
     # the row-gather form runs it in ~0.5 s; same lesson as the SGD layout
-    # epochs below).
+    # epochs).
     tails_T = tails_pad.T  # (P, n)
     wn_T = wn.T
     P_, n_ = tails_T.shape
@@ -196,7 +229,8 @@ def _laplacian_eigenmap_kernel(
             r, y, left_side=False, lower=True, transpose_a=True
         )
 
-    x0 = orthonormalize(jax.random.normal(key, (n, c)))
+    row_valid = jnp.arange(n) < valid_count
+    x0 = orthonormalize(jax.random.normal(key, (n, c)) * row_valid[:, None])
 
     def cond(state):
         i, _x, res = state
@@ -217,14 +251,25 @@ def _laplacian_eigenmap_kernel(
     return x
 
 
+@jax.jit
+def _spectral_scale_noise(emb: jax.Array, key: jax.Array) -> jax.Array:
+    """10-box rescale + tiny symmetry-breaking jitter, on device (umap-learn
+    scales its spectral init the same way)."""
+    scale = jnp.maximum(jnp.abs(emb).max(), 1e-12)
+    noise = 1e-4 * jax.random.normal(key, emb.shape)
+    return (emb / scale * 10.0 + noise).astype(jnp.float32)
+
+
 def dedupe_undirected(
     knn_ids: np.ndarray, W: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Directed (n, k) adjacency -> undirected (ii, jj, ww) edge list with
-    each pair kept once.  umap-learn operates on the deduped symmetric COO
-    graph; keeping both directed copies of a mutual edge would give it two
-    head-grouped slots PER ENDPOINT and so double its SGD firing rate (and
-    double its spectral weight)."""
+    each pair kept once (host-side REFERENCE implementation; the fit path
+    assembles the same layout on device — see build_head_layout_device).
+    umap-learn operates on the deduped symmetric COO graph; keeping both
+    directed copies of a mutual edge would give it two head-grouped slots
+    PER ENDPOINT and so double its SGD firing rate (and double its spectral
+    weight)."""
     n, k = knn_ids.shape
     heads = np.repeat(np.arange(n, dtype=np.int64), k)
     tails = knn_ids.astype(np.int64).reshape(-1)
@@ -249,34 +294,31 @@ def dedupe_undirected(
 
 
 def spectral_from_layout(
-    tails_pad: np.ndarray,
-    w_pad: np.ndarray,
+    tails_pad,
+    w_pad,
     n_components: int,
     seed: int,
 ) -> np.ndarray:
     """Spectral embedding from an already-built padded head-grouped layout
-    (shared with the SGD epochs — one dedupe + one layout per fit).
-    Returns (n, c) scaled to the same 10-box umap-learn uses."""
-    emb = np.asarray(
-        _laplacian_eigenmap_kernel(
-            jnp.asarray(tails_pad),
-            jnp.asarray(w_pad),
-            jax.random.PRNGKey(seed),
-            c=int(n_components),
-        )
+    (host or device arrays).  Returns (n, c) scaled to the same 10-box
+    umap-learn uses."""
+    tails_dev = _h2d(tails_pad, np.int32)
+    w_dev = _h2d(w_pad, np.float32)
+    key = jax.random.PRNGKey(seed)
+    emb = _laplacian_eigenmap_kernel(
+        tails_dev,
+        w_dev,
+        key,
+        jnp.int32(tails_dev.shape[0]),
+        c=int(n_components),
     )
-    scale = np.abs(emb).max() or 1.0
-    emb = (emb / scale * 10.0).astype(np.float32)
-    emb += np.random.default_rng(seed).normal(scale=1e-4, size=emb.shape).astype(
-        np.float32
-    )
-    return emb
+    return np.asarray(_spectral_scale_noise(emb, jax.random.fold_in(key, 0x5CA1E)))
 
 
 def spectral_init(
     knn_ids: np.ndarray, W: np.ndarray, n_components: int, seed: int
 ) -> np.ndarray:
-    """Spectral embedding of the fuzzy graph (standalone entry: dedupe +
+    """Spectral embedding of the fuzzy graph (standalone host entry: dedupe +
     layout + subspace iteration)."""
     ii, jj, ww = dedupe_undirected(knn_ids, W)
     n = knn_ids.shape[0]
@@ -284,21 +326,29 @@ def spectral_init(
     return spectral_from_layout(tails_pad, w_pad, n_components, seed)
 
 
-# layout-truncation tunables (env-overridable: hub-heavy graphs — e.g.
-# scale-free neighborhoods — can raise the cap or the quantile to keep
-# more hub edges at the cost of a wider per-epoch gather; the defaults
-# hold trustworthiness on i.i.d. AND power-law degree graphs, see
-# test_umap.test_hub_heavy_graph_layout_quality)
+# engine tunables (env-overridable):
+#   SRML_UMAP_DEGREE_CAP / SRML_UMAP_DEGREE_QUANTILE — layout truncation:
+#     hub-heavy graphs (e.g. scale-free neighborhoods) can raise the cap or
+#     the quantile to keep more hub edges at the cost of a wider per-epoch
+#     gather; the defaults hold trustworthiness on i.i.d. AND power-law
+#     degree graphs (test_umap.test_hub_heavy_graph_layout_quality)
+#   SRML_UMAP_EPOCH_BLOCK — epochs fused per jitted layout step (lax.scan);
+#     the epoch loop issues ceil(n_epochs / block) dispatches total
+#   SRML_UMAP_TABLE — negative-sample table size per epoch
 def _layout_cap() -> int:
-    import os
-
     return int(os.environ.get("SRML_UMAP_DEGREE_CAP", 36))
 
 
 def _layout_quantile() -> float:
-    import os
-
     return float(os.environ.get("SRML_UMAP_DEGREE_QUANTILE", 0.98))
+
+
+def _epoch_block() -> int:
+    return max(1, int(os.environ.get("SRML_UMAP_EPOCH_BLOCK", 50)))
+
+
+def _neg_table() -> int:
+    return int(os.environ.get("SRML_UMAP_TABLE", 256))
 
 
 def padded_head_layout(
@@ -308,13 +358,15 @@ def padded_head_layout(
     n: int,
     cap: int = 0,  # 0 = SRML_UMAP_DEGREE_CAP (default 36)
 ):
-    """Static scatter-free edge layout for the SGD epochs: every undirected
-    edge becomes two directed edges, grouped by head and padded to a fixed
-    per-node degree `cap` (padding slots point at the node itself with
-    weight 0, so they fire never and their diff is zero).  Hub nodes beyond
-    `cap` keep their strongest edges — the truncation umap-learn's
-    epochs_per_sample schedule approximates anyway (weak edges of high-
-    degree nodes fire rarely).
+    """Static scatter-free edge layout for the SGD epochs (host-side
+    REFERENCE implementation; the fit path builds the same layout on device
+    — see build_head_layout_device): every undirected edge becomes two
+    directed edges, grouped by head and padded to a fixed per-node degree
+    `cap` (padding slots point at the node itself with weight 0, so they
+    fire never and their diff is zero).  Hub nodes beyond `cap` keep their
+    strongest edges — the truncation umap-learn's epochs_per_sample
+    schedule approximates anyway (weak edges of high-degree nodes fire
+    rarely).
 
     Returns (tails_pad (n, P) int32, w_pad (n, P) f32)."""
     h2 = np.concatenate([heads, tails]).astype(np.int64)
@@ -352,6 +404,335 @@ def padded_head_layout(
     return tails_pad, w_pad
 
 
+# -- on-device graph assembly --------------------------------------------------
+# The host pipeline this replaces (dedupe_undirected + padded_head_layout,
+# both kept above as the reference implementation) fetched the (n, k) fuzzy
+# graph to the host, symmetrized/deduped/padded it in numpy, and re-uploaded
+# the ~(n, P) layout — a full round-trip of the graph through the host link
+# per fit.  Here the same three steps run as jnp kernels on the device the
+# calibration already produced W on: edge expansion with the dense transpose
+# lookup, ONE lexsort to head-major weight-descending order, and a gather
+# (not scatter) into the padded layout.  The single host sync is the P98
+# degree scalar that fixes the static pad width P.
+
+
+@jax.jit
+def _graph_edges(knn_ids: jax.Array, W: jax.Array):
+    """Directed (n, k) adjacency -> flat directed edge list covering BOTH
+    directions of every undirected pair exactly once per endpoint, with the
+    per-pair MAX weight (the dedupe_undirected contract).  A pair present in
+    both rows (mutual) would emit each direction twice — once forward from
+    its own row, once reversed from the partner's — so reversed copies of
+    mutual edges are dropped.
+
+    Returns (heads, tails, w, valid, wmax), each flat of size 2nk."""
+    n, k = knn_ids.shape
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=knn_ids.dtype)[:, None], (n, k))
+    # transpose lookup, dense: does i appear in j's neighbor list, and with
+    # what weight (same trick as fuzzy_simplicial_set)
+    neigh_of_j = knn_ids[knn_ids]          # (n, k, k)
+    w_of_j = W[knn_ids]                    # (n, k, k)
+    match = neigh_of_j == rows[:, :, None]
+    wT = jnp.where(match, w_of_j, 0.0).max(axis=2)
+    mutual = match.any(axis=2)
+    ws = jnp.maximum(W, wT)                # symmetric per-pair weight
+    self_e = knn_ids == rows
+    valid_f = (ws > 0.0) & ~self_e
+    valid_r = valid_f & ~mutual
+    heads = jnp.concatenate([rows.reshape(-1), knn_ids.reshape(-1)])
+    tails = jnp.concatenate([knn_ids.reshape(-1), rows.reshape(-1)])
+    w2 = jnp.concatenate([ws.reshape(-1), ws.reshape(-1)])
+    valid = jnp.concatenate([valid_f.reshape(-1), valid_r.reshape(-1)])
+    return heads, tails, w2, valid, W.max()
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def _edge_order(heads, tails, w2, valid, wmax, epochs_total, quantile, n_pad):
+    """Head-major weight-descending edge order + per-head group geometry.
+
+    Also applies the epoch-schedule prune (edges with w < wmax/n_epochs can
+    never fire; dropping them here keeps them out of the pad-width budget)
+    and computes the degree quantile that fixes the static pad width P —
+    the ONE scalar the host needs before the gather kernel can be shaped."""
+    keep = valid & (w2 * epochs_total >= wmax)
+    hkey = jnp.where(keep, heads, n_pad).astype(jnp.int32)  # dropped -> end
+    order = jnp.lexsort((-w2, hkey))
+    sh = hkey[order]
+    st = tails[order].astype(jnp.int32)
+    sw = w2[order]
+    node_ids = jnp.arange(n_pad, dtype=sh.dtype)
+    starts = jnp.searchsorted(sh, node_ids)
+    ends = jnp.searchsorted(sh, node_ids, side="right")
+    deg = (ends - starts).astype(jnp.int32)
+    # linear-interpolated quantile of the NONZERO degrees (np.quantile
+    # semantics): ascending degree sort puts the zero-degree rows first
+    degs = jnp.sort(deg)
+    nz = (deg > 0).sum()
+    pos = (n_pad - nz).astype(jnp.float32) + quantile * jnp.maximum(
+        nz - 1, 0
+    ).astype(jnp.float32)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, n_pad - 1)
+    hi = jnp.clip(lo + 1, 0, n_pad - 1)
+    frac = pos - lo.astype(jnp.float32)
+    qval = degs[lo].astype(jnp.float32) * (1.0 - frac) + degs[hi].astype(
+        jnp.float32
+    ) * frac
+    qval = jnp.where(nz > 0, qval, 1.0)
+    return st, sw, starts.astype(jnp.int32), deg, qval
+
+
+@partial(jax.jit, static_argnames=("P",))
+def _gather_layout(st, sw, starts, deg, wmax, P):
+    """Sorted edge list -> padded head-grouped (n_pad, P) layout by GATHER
+    (slot p of head h reads sorted position starts[h]+p), truncating each
+    head to its P strongest edges.  Empty slots self-point with weight 0 so
+    they never fire.  Weights come out normalized by wmax — the epoch
+    schedule's firing probability."""
+    n_pad = starts.shape[0]
+    slot = jnp.arange(P, dtype=jnp.int32)[None, :]
+    in_group = slot < jnp.minimum(deg, P)[:, None]
+    idx = jnp.clip(starts[:, None] + slot, 0, st.shape[0] - 1)
+    self_col = jnp.broadcast_to(
+        jnp.arange(n_pad, dtype=jnp.int32)[:, None], (n_pad, P)
+    )
+    tails_pad = jnp.where(in_group, st[idx], self_col)
+    w_pad = jnp.where(in_group, sw[idx] / jnp.maximum(wmax, 1e-12), 0.0)
+    return tails_pad, w_pad.astype(jnp.float32)
+
+
+def build_head_layout_device(
+    knn_ids_dev: jax.Array,  # (n, k) int32, on device
+    W: jax.Array,            # (n, k) f32 membership strengths, on device
+    n_pad: int,
+    n_epochs: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """On-device symmetrize + dedupe + pad: (n, k) fuzzy graph ->
+    (n_pad, P) head-grouped layout (wmax-normalized weights), rows >= n
+    padded with 0-weight self-loops.  All three kernels dispatch through
+    the AOT executable cache; the only host sync is the P98-degree scalar
+    that fixes the static pad width."""
+    from .precompile import cached_kernel
+
+    heads, tails, w2, valid, wmax = cached_kernel(
+        "umap_graph_edges", _graph_edges, knn_ids_dev, W
+    )
+    st, sw, starts, deg, qval = cached_kernel(
+        "umap_edge_order",
+        _edge_order,
+        heads,
+        tails,
+        w2,
+        valid,
+        wmax,
+        jnp.float32(max(n_epochs, 1)),
+        jnp.float32(_layout_quantile()),
+        n_pad=n_pad,
+    )
+    # ONE intentional scalar sync: the pad width must be a static shape, and
+    # it depends on the realized degree distribution.
+    # graftlint: disable=R1 (P is a static kernel shape; a 4-byte scalar fetch replaces the full-graph host round-trip this assembly removed)
+    p98 = int(np.asarray(qval))
+    P = int(min(_layout_cap(), max(8, p98, 1)))
+    tails_pad, w_pad = cached_kernel(
+        "umap_layout_gather", _gather_layout, st, sw, starts, deg, wmax, P=P
+    )
+    return tails_pad, w_pad
+
+
+# -- mesh-parallel scan-batched layout ----------------------------------------
+
+
+def _counter_uniform(key: jax.Array, counters: jax.Array) -> jax.Array:
+    """Uniforms in [0, 1) from counter-mode threefry: element e's draw is a
+    pure function of (key, counters[e]).  The layout engine feeds GLOBAL
+    padded grid positions as counters, so a device owning any column block
+    draws exactly the values a single device owning the whole grid would —
+    the mechanism behind "fixed seed => same embedding on every mesh size
+    sharing the padded geometry" (see mesh.padded_row_count).
+
+    threefry_2x32 splits its count array in HALF and hashes pairs
+    (count[i], count[i+half]) — element i's bits would depend on the array
+    SIZE, exactly the shard-shape dependence this function must not have.
+    Feeding each counter as both lanes (count ++ count) makes lane 0 of
+    element i a function of (key, counters[i]) alone."""
+    kd = jax.random.key_data(key).astype(jnp.uint32).reshape(2)
+    flat = counters.reshape(-1)
+    bits = threefry_2x32(kd, jnp.concatenate([flat, flat]))[: flat.size]
+    bits = bits.reshape(counters.shape)
+    return (bits >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+@partial(jax.jit, static_argnames=("mesh", "block", "table_size"))
+def _layout_step_sharded(
+    emb: jax.Array,        # (n_pad, c) f32, replicated
+    tails_T: jax.Array,    # (P, n_pad) int32, column-sharded head blocks
+    w_T: jax.Array,        # (P, n_pad) f32 in [0, 1], column-sharded
+    e0: jax.Array,         # () i32 first epoch of this block
+    epochs_total: jax.Array,   # () f32 whole-fit epoch count (alpha schedule)
+    valid_count: jax.Array,    # () i32 real rows (negative-sample range)
+    a: jax.Array,
+    b: jax.Array,
+    lr: jax.Array,
+    gamma: jax.Array,          # repulsion strength
+    neg_rate: jax.Array,       # negative_sample_rate as f32
+    seed: jax.Array,           # () i32
+    mesh: Mesh,
+    block: int,
+    table_size: int,
+) -> jax.Array:
+    """`block` SGD epochs in ONE dispatch: lax.scan over epochs inside a
+    shard_map over DATA_AXIS.  Each device owns a contiguous column block of
+    the transposed head layout (its head nodes), computes those nodes' new
+    embedding rows against the replicated embedding, and one tiled
+    all-gather per epoch rebuilds the full embedding everywhere.
+
+    Scatter-free as before (head updates reduce over the P axis; the
+    symmetric tail update is the head update of the reversed directed edge;
+    repulsion uses one shared negative table per epoch), and component-
+    sliced in (P, n) layout for full TPU lanes.  The 2x attraction constant
+    matches umap-learn's both-directions + move_other firing accounting
+    (see the reference layout's history).  Edge firing draws are counter-
+    based threefry over GLOBAL grid positions — mesh-shape independent."""
+    from ..parallel.exchange import allgather_rows
+
+    n_pad, c = emb.shape
+    M = table_size
+
+    def per_device(emb, tails_loc, w_loc, e0, epochs_total, valid_count,
+                   a, b, lr, gamma, neg_rate, seed):
+        Pw, n_loc = tails_loc.shape
+        col0 = jax.lax.axis_index(DATA_AXIS) * n_loc
+        flat_tails = tails_loc.reshape(-1)
+        # global flat position of every local (p, col) slot — the threefry
+        # counter grid.  uint32 bounds the addressable grid at P * n_pad <
+        # 2^32 (~119M rows at P=36; optimize_layout_sharded rejects more).
+        counters = (
+            jnp.arange(Pw, dtype=jnp.uint32)[:, None] * jnp.uint32(n_pad)
+            + jnp.uint32(col0)
+            + jnp.arange(n_loc, dtype=jnp.uint32)[None, :]
+        )
+        key0 = jax.random.PRNGKey(seed)
+
+        def epoch(emb, e):
+            key = jax.random.fold_in(key0, e)
+            k1, k2 = jax.random.split(key)
+            alpha = lr * (1.0 - e.astype(jnp.float32) / epochs_total)
+            comps = jax.lax.dynamic_slice(emb, (col0, 0), (n_loc, c)).T
+            tT = emb[flat_tails].T.reshape(c, Pw, n_loc)
+            diffs = [comps[j][None, :] - tT[j] for j in range(c)]
+            d2 = diffs[0] * diffs[0]
+            for dj in diffs[1:]:
+                d2 = d2 + dj * dj
+            fire = _counter_uniform(k1, counters) < w_loc
+            att = (-4.0 * a * b * d2 ** (b - 1.0)) / (1.0 + a * d2**b)
+            att = jnp.where(d2 > 0, att, 0.0) * fire
+
+            # shared negative table: replicated draw (same key, same shape
+            # on every device), scaled by each node's expected negative
+            # count — same expectation as per-edge sampling, dense compute
+            neg = jax.random.randint(
+                k2, (M,), 0, jnp.maximum(valid_count, 1)
+            )
+            tblT = emb[neg].T                            # (c, M) tiny
+            diffs_n = [comps[j][None, :] - tblT[j][:, None] for j in range(c)]
+            d2n = diffs_n[0] * diffs_n[0]                # (M, n_loc)
+            for dj in diffs_n[1:]:
+                d2n = d2n + dj * dj
+            rep = (2.0 * gamma * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
+            scale = neg_rate * fire.sum(axis=0).astype(emb.dtype) / M
+            new_cols = []
+            for cj, dj, dnj in zip(comps, diffs, diffs_n):
+                upd = jnp.clip(att * dj, -4.0, 4.0).sum(axis=0)
+                g_rep = jnp.clip(rep * dnj, -4.0, 4.0).sum(axis=0)
+                new_cols.append(cj + alpha * (upd + scale * g_rep))
+            new_loc = jnp.stack(new_cols, axis=1)        # (n_loc, c)
+            return allgather_rows(new_loc), None
+
+        emb_out, _ = jax.lax.scan(epoch, emb, e0 + jnp.arange(block))
+        return emb_out
+
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(
+            PSpec(),
+            PSpec(None, DATA_AXIS),
+            PSpec(None, DATA_AXIS),
+        ) + (PSpec(),) * 9,
+        out_specs=PSpec(),
+        check_vma=False,
+    )(emb, tails_T, w_T, e0, epochs_total, valid_count,
+      a, b, lr, gamma, neg_rate, seed)
+
+
+def optimize_layout_sharded(
+    emb: jax.Array,        # (n_pad, c) f32 initial embedding (device)
+    tails_pad: jax.Array,  # (n_pad, P) int32 head-grouped layout (device)
+    w_pad: jax.Array,      # (n_pad, P) f32 normalized weights (device)
+    valid_count: int,
+    mesh: Mesh,
+    a: float,
+    b: float,
+    n_epochs: int,
+    learning_rate: float,
+    repulsion_strength: float,
+    negative_sample_rate: int,
+    seed: int,
+    table_size: int = 0,   # 0 = SRML_UMAP_TABLE (default 256)
+) -> jax.Array:
+    """Mesh-parallel SGD layout driver: reshard the layout into column-
+    sharded head blocks, replicate the embedding, then launch
+    ceil(n_epochs / SRML_UMAP_EPOCH_BLOCK) scan-batched steps through the
+    AOT executable cache (at most two geometries: full block + remainder).
+    Each dispatch bumps the umap.layout.dispatches counter and logs an
+    ordered umap.layout.step event."""
+    from .precompile import cached_kernel
+
+    n_pad, P = tails_pad.shape
+    # the counter-based firing draws address the (P, n_pad) grid in uint32;
+    # past 2^32 counters would silently alias and correlate distinct edges'
+    # draws every epoch — refuse loudly instead
+    if P * n_pad >= 1 << 32:
+        raise ValueError(
+            f"layout grid P*n_pad = {P}*{n_pad} exceeds the uint32 counter "
+            "space of the seed-deterministic firing draws; lower "
+            "SRML_UMAP_DEGREE_CAP or shard the fit"
+        )
+    tails_T = jax.device_put(jnp.transpose(tails_pad), col_sharding(mesh))
+    w_T = jax.device_put(jnp.transpose(w_pad), col_sharding(mesh))
+    emb = jax.device_put(emb, replicated_sharding(mesh))
+    M = table_size or _neg_table()
+    block = _epoch_block()
+    epochs_total = jnp.float32(max(n_epochs, 1))
+    scal = (
+        jnp.int32(valid_count),
+        jnp.float32(a),
+        jnp.float32(b),
+        jnp.float32(learning_rate),
+        jnp.float32(repulsion_strength),
+        jnp.float32(negative_sample_rate),
+        jnp.int32(np.int64(seed) & 0x7FFFFFFF),
+    )
+    for e0 in range(0, n_epochs, block):
+        blk = min(block, n_epochs - e0)
+        emb = cached_kernel(
+            "umap_layout_step",
+            _layout_step_sharded,
+            emb,
+            tails_T,
+            w_T,
+            jnp.int32(e0),
+            epochs_total,
+            *scal,
+            mesh=mesh,
+            block=blk,
+            table_size=M,
+        )
+        profiling.incr_counter("umap.layout.dispatches")
+        profiling.record_event("umap.layout.step", e0=e0, block=blk)
+    return emb
+
+
 @partial(
     jax.jit,
     static_argnames=("n_epochs", "negative_sample_rate", "table_size"),
@@ -370,26 +751,11 @@ def optimize_layout_padded(
     seed: int,
     table_size: int = 256,
 ) -> jax.Array:
-    """Scatter-free SGD layout.  TPU scatter sustains ~10M updates/s, which
-    made the per-edge `.at[].add` epochs the UMAP bottleneck (round-1 bench:
-    0.26x floor).  Two reformulations remove every scatter:
-
-    - attraction runs in the padded head-grouped layout: the head side of
-      each edge is a free broadcast, per-edge gradients reduce onto their
-      head with a reshape-sum, and the symmetric tail update is the head
-      update of the reversed directed edge (the coefficient is symmetric in
-      d2, the difference antisymmetric).
-    - repulsion samples one shared `table_size` negative table per epoch
-      instead of S negatives per firing edge: every node repels the same
-      uniform table, scaled by its expected negative count
-      (S * fired_edges / M).  Same expectation as per-edge sampling, far
-      less variance in runtime: a dense VPU computation replaces an
-      (E, S) gather + scatter.
-    - everything runs COMPONENT-SLICED in (P, n) layout: the natural
-      (n, P, c) form puts c (2-3 output components) in the minor
-      dimension, which TPU tiles pad to 128 lanes — a 64x memory/compute
-      waste that made each epoch ~7 ms where the flat form runs ~1 ms.
-    """
+    """Single-device REFERENCE layout (the pre-sharding implementation,
+    kept as the quality baseline optimize_layout_sharded is tested
+    against).  Scatter-free SGD: attraction in the padded head-grouped
+    layout, one shared negative table per epoch, component-sliced (P, n)
+    compute; the whole epoch loop is one fori in one jit."""
     n, c = embedding.shape
     P = tails_pad.shape[1]
     M = table_size
@@ -457,17 +823,31 @@ def _calibrated_weights(
     return fuzzy_simplicial_set(knn_ids, knn_dists, rho, sigma, set_op_mix_ratio)
 
 
-@jax.jit
-def _scale_weights(w: jax.Array, wmax) -> jax.Array:
-    """Epoch-schedule weight normalization, on device (see the single-
-    upload note in umap_fit_embedding)."""
-    return (w / wmax).astype(jnp.float32)
+def _h2d(arr, dtype) -> jax.Array:
+    """Counted host->device upload: already-device arrays pass through (a
+    dtype cast stays on device); host arrays bump umap.h2d_transfers /
+    umap.h2d_bytes.  The counters make the single-upload contract testable
+    — a fit must move the (n, k) graph over the link at most once."""
+    if isinstance(arr, jax.Array):
+        return arr.astype(dtype) if arr.dtype != dtype else arr
+    host = np.asarray(arr, dtype)
+    profiling.incr_counter("umap.h2d_transfers")
+    profiling.incr_counter("umap.h2d_bytes", host.nbytes)
+    return jnp.asarray(host)
+
+
+@partial(jax.jit, static_argnames=("n_pad", "c"))
+def _random_init(seed, n_pad, c):
+    """Uniform [-10, 10] start, drawn on device at the padded shape (the
+    draw depends only on seed and n_pad, both mesh-shape independent)."""
+    return jax.random.uniform(
+        jax.random.PRNGKey(seed), (n_pad, c), jnp.float32, -10.0, 10.0
+    )
 
 
 def umap_fit_embedding(
-    X: np.ndarray,
-    knn_ids: np.ndarray,
-    knn_dists: np.ndarray,
+    knn_ids,
+    knn_dists,
     n_components: int,
     a: float,
     b: float,
@@ -480,101 +860,141 @@ def umap_fit_embedding(
     negative_sample_rate: int,
     seed: int,
     y: Optional[np.ndarray] = None,
+    mesh: Optional[Mesh] = None,
 ) -> np.ndarray:
-    """Host orchestration of the fit pipeline (graph + init + layout).
+    """Host orchestration of the fit pipeline (graph + init + layout),
+    device-resident end to end: the (n, k) kNN graph is uploaded ONCE
+    (counted), calibration/symmetrization/dedupe/pad all run as device
+    kernels, the spectral or random init is drawn on device, and the SGD
+    epochs run mesh-parallel in scan-batched AOT-cached steps.  One d2h
+    fetch at the end returns the (n, c) embedding.
+
     When ``y`` is given, runs the supervised path: the fuzzy set is
     intersected with the label partition before layout (the reference's
-    y= branch, umap.py:939-945)."""
-    n = X.shape[0]
-    W = _calibrated_weights(
-        jnp.asarray(knn_ids.astype(np.int32)),
-        jnp.asarray(knn_dists),
-        float(local_connectivity),
-        float(set_op_mix_ratio),
-    )
-    if y is not None:
-        codes = np.full(n, -1, dtype=np.int32)
-        # graftlint: disable=R5 (host-side label-finiteness check; f64 holds any label dtype exactly)
-        finite = np.isfinite(np.asarray(y, dtype=np.float64))
-        _, inv = np.unique(np.asarray(y)[finite], return_inverse=True)
-        codes[finite] = inv.astype(np.int32)
-        W = categorical_simplicial_set_intersection(
-            W, jnp.asarray(knn_ids.astype(np.int32)), jnp.asarray(codes)
+    y= branch, umap.py:939-945).
+
+    Determinism contract: with a fixed seed the returned embedding is
+    identical across all mesh sizes that divide ROW_PAD_LANES (= 64 —
+    every power-of-two TPU mesh up to 64 devices): those shapes share one
+    padded geometry, so init draws and per-edge firing draws are functions
+    of (seed, n) only.  Other mesh sizes are deterministic for their own
+    shape (docs/umap_engine.md)."""
+    n = knn_ids.shape[0]
+    if mesh is None:
+        mesh = get_mesh()
+    with profiling.phase("umap.graph"):
+        ids_dev = _h2d(knn_ids, np.int32)
+        dists_dev = _h2d(knn_dists, np.float32)
+        W = _calibrated_weights(
+            ids_dev,
+            dists_dev,
+            float(local_connectivity),
+            float(set_op_mix_ratio),
         )
-    if n_epochs is None:
-        n_epochs = 500 if n <= 10_000 else 200
-    W = np.asarray(W)
-    wmax = W.max() if W.size else 1.0
-    # ONE undirected dedupe + ONE padded layout feed both the spectral init
-    # and the SGD epochs.  Deduping before the layout matters beyond speed:
-    # a mutual edge left in both directed copies occupies two head-grouped
-    # slots per endpoint and fires at double its schedule (umap-learn
-    # works on the deduped symmetric graph).
-    ii, jj, ww = dedupe_undirected(knn_ids, W)
-    # prune edges too weak to ever fire under the resolved epoch schedule
-    # (the spectral init sees the pruned graph too — the dropped edges are
-    # < wmax/n_epochs, noise at eigenvector scale)
-    keep = ww / max(wmax, 1e-12) >= 1.0 / max(n_epochs, 1)
-    ii, jj, ww = ii[keep], jj[keep], ww[keep]
-    tails_pad, w_pad = padded_head_layout(ii, jj, ww, n)
-    # upload the padded layout ONCE: spectral init and the SGD epochs share
-    # the same (n, P) arrays, and a second jnp.asarray of the host copies
-    # re-paid the ~14 MB host-link transfer (0.15-0.35 s under tunnel
-    # congestion); the epoch-schedule normalization is an on-device scale
-    tails_dev = jnp.asarray(tails_pad)
-    w_dev = jnp.asarray(w_pad)
-    if init == "random":
-        emb = (
-            np.random.default_rng(seed)
-            .uniform(-10, 10, size=(n, n_components))
-            .astype(np.float32)
+        if y is not None:
+            codes = np.full(n, -1, dtype=np.int32)
+            # graftlint: disable=R5 (host-side label-finiteness check; f64 holds any label dtype exactly)
+            finite = np.isfinite(np.asarray(y, dtype=np.float64))
+            _, inv = np.unique(np.asarray(y)[finite], return_inverse=True)
+            codes[finite] = inv.astype(np.int32)
+            W = categorical_simplicial_set_intersection(
+                W, ids_dev, _h2d(codes, np.int32)
+            )
+        if n_epochs is None:
+            n_epochs = 500 if n <= 10_000 else 200
+        n_pad = padded_row_count(n, mesh)
+        tails_pad, w_pad = build_head_layout_device(
+            ids_dev, W, n_pad, int(n_epochs)
         )
-    else:
-        # "spectral": normalized-Laplacian eigenmap of the fuzzy graph, as
-        # umap-learn/cuml
-        emb = spectral_from_layout(tails_dev, w_dev, n_components, seed)
-    out = optimize_layout_padded(
-        jnp.asarray(emb),
-        tails_dev,
-        _scale_weights(w_dev, float(max(wmax, 1e-12))),
-        a,
-        b,
-        int(n_epochs),
-        float(learning_rate),
-        float(repulsion_strength),
-        int(negative_sample_rate),
-        seed,
-    )
-    return np.asarray(out)
+    with profiling.phase("umap.init"):
+        if init == "random":
+            emb = _random_init(
+                jnp.int32(np.int64(seed) & 0x7FFFFFFF),
+                n_pad=n_pad,
+                c=int(n_components),
+            )
+        else:
+            # "spectral": normalized-Laplacian eigenmap of the fuzzy graph,
+            # as umap-learn/cuml (plain jits — jax's own cache covers them)
+            key = jax.random.PRNGKey(int(np.int64(seed) & 0x7FFFFFFF))
+            emb = _spectral_scale_noise(
+                _laplacian_eigenmap_kernel(
+                    tails_pad, w_pad, key, jnp.int32(n), c=int(n_components)
+                ),
+                jax.random.fold_in(key, 0x5CA1E),
+            )
+    with profiling.phase("umap.layout"):
+        out = optimize_layout_sharded(
+            emb,
+            tails_pad,
+            w_pad,
+            n,
+            mesh,
+            a,
+            b,
+            int(n_epochs),
+            float(learning_rate),
+            float(repulsion_strength),
+            int(negative_sample_rate),
+            int(seed),
+        )
+        return np.asarray(out)[:n]
 
 
-@partial(jax.jit, static_argnames=("n_epochs", "negative_sample_rate"), donate_argnums=(0,))
-def optimize_transform_layout(
-    emb_q: jax.Array,      # (nq, c) query embedding (updated)
+# -- transform -----------------------------------------------------------------
+
+
+@jax.jit
+def _transform_prepare(ids_p, dists_p, train_emb, valid_count,
+                       local_connectivity):
+    """Device-resident transform staging in ONE dispatch: smooth-kNN
+    calibration, membership weights, the weighted-neighbor-mean init, and
+    the wmax-normalized firing weights (padding rows zeroed so they never
+    fire).  Replaces a host round-trip of the (bucket, k) weight matrix."""
+    bucket = ids_p.shape[0]
+    rho, sigma = smooth_knn_calibration(
+        dists_p, local_connectivity=local_connectivity
+    )
+    w = jnp.exp(-jnp.maximum(dists_p - rho[:, None], 0.0) / sigma[:, None])
+    row_valid = (jnp.arange(bucket) < valid_count)[:, None]
+    w = jnp.where(row_valid, w, 0.0)
+    wn = w / jnp.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+    init = jnp.einsum("nk,nkc->nc", wn, train_emb[ids_p]).astype(jnp.float32)
+    weights = (w / jnp.maximum(w.max(), 1e-12)).astype(jnp.float32)
+    return init, weights
+
+
+@partial(jax.jit, static_argnames=("block", "negative_sample_rate"))
+def _transform_step(
+    emb_q: jax.Array,      # (bucket, c) query embedding (updated)
     ref_emb: jax.Array,    # (nr, c) training embedding (FIXED)
-    tails: jax.Array,      # (nq, k) int32 reference neighbor indices
-    weights: jax.Array,    # (nq, k) membership strengths in [0, 1]
-    a: float,
-    b: float,
-    n_epochs: int,
-    learning_rate: float,
-    repulsion_strength: float,
+    tails: jax.Array,      # (bucket, k) int32 reference neighbor indices
+    weights: jax.Array,    # (bucket, k) firing weights in [0, 1]
+    e0: jax.Array,         # () i32 first epoch of this block
+    epochs_total: jax.Array,
+    a: jax.Array,
+    b: jax.Array,
+    lr: jax.Array,
+    gamma: jax.Array,
+    seed: jax.Array,
+    block: int,
     negative_sample_rate: int,
-    seed: int,
 ) -> jax.Array:
-    """Refinement epochs of cuml/umap-learn transform: the query points run
-    the same attract/repel SGD as fit, but only against the frozen training
-    embedding, and only the query side moves.  Each query's edge set IS its
-    k-neighbor row, so gradients reduce onto their query with a plain
-    axis-1 sum — scatter-free, like the padded fit layout."""
+    """`block` refinement epochs of cuml/umap-learn transform in one
+    dispatch (lax.scan): the query points run the same attract/repel SGD as
+    fit, but only against the frozen training embedding, and only the query
+    side moves.  Each query's edge set IS its k-neighbor row, so gradients
+    reduce onto their query with a plain axis-1 sum — scatter-free, like
+    the padded fit layout."""
     nr = ref_emb.shape[0]
     nq, k = tails.shape
+    S = negative_sample_rate
     key0 = jax.random.PRNGKey(seed)
 
-    def epoch(e, emb):
+    def epoch(emb, e):
         key = jax.random.fold_in(key0, e)
         k1, k2 = jax.random.split(key)
-        alpha = learning_rate * (1.0 - e / n_epochs)
+        alpha = lr * (1.0 - e.astype(jnp.float32) / epochs_total)
         fire = jax.random.uniform(k1, (nq, k)) < weights
         diff = emb[:, None, :] - ref_emb[tails]      # (nq, k, c)
         d2 = (diff * diff).sum(axis=2)
@@ -582,17 +1002,16 @@ def optimize_transform_layout(
         att = jnp.where(d2 > 0, att, 0.0) * fire
         upd = jnp.clip(att[:, :, None] * diff, -4.0, 4.0).sum(axis=1)
 
-        S = negative_sample_rate
         neg = jax.random.randint(k2, (nq, k, S), 0, nr)
         diff_n = emb[:, None, None, :] - ref_emb[neg]  # (nq, k, S, c)
         d2n = (diff_n * diff_n).sum(axis=3)
-        rep = (2.0 * repulsion_strength * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
+        rep = (2.0 * gamma * b) / ((0.001 + d2n) * (1.0 + a * d2n**b))
         rep = rep * fire[:, :, None]
         g_rep = jnp.clip(rep[:, :, :, None] * diff_n, -4.0, 4.0)
-        upd = upd + g_rep.sum(axis=(1, 2))
-        return emb + alpha * upd
+        return emb + alpha * (upd + g_rep.sum(axis=(1, 2))), None
 
-    return jax.lax.fori_loop(0, n_epochs, epoch, emb_q)
+    emb_out, _ = jax.lax.scan(epoch, emb_q, e0 + jnp.arange(block))
+    return emb_out
 
 
 def umap_transform_embedding(
@@ -612,58 +1031,68 @@ def umap_transform_embedding(
     """Embed new points: membership-weighted mean of training neighbors'
     embeddings, then (when a/b are given) the SGD refinement epochs that
     cuml/umap-learn transform runs — n_epochs//3, or 100/30 by data size,
-    against the frozen training embedding.
+    against the frozen training embedding.  The whole path is device-
+    resident: one counted upload of the query (bucket, k) graph, staging
+    and refinement as AOT-cached kernels, one d2h fetch of the result.
 
     The query count is padded to a power-of-two bucket (>=64) so the jitted
-    calibration/refinement kernels compile a bounded number of shapes across
-    partitions of varying size; pass ``train_embedding_dev`` (uploaded once
-    by the caller) to avoid re-transferring the training embedding per
-    partition."""
+    kernels compile a bounded number of shapes across partitions of varying
+    size; pass ``train_embedding_dev`` (uploaded once by the caller, e.g.
+    alongside knn_search_prepared staging) so query kNN + layout share one
+    device-resident dataset instead of re-transferring per partition."""
+    from .precompile import cached_kernel, shape_bucket
+
     nq, k = query_knn_ids.shape
     if nq == 0:
         return np.zeros((0, train_embedding.shape[1]), np.float32)
-    bucket = 64
-    while bucket < nq:
-        bucket *= 2
-    pad = bucket - nq
-    ids_p = np.pad(query_knn_ids, ((0, pad), (0, 0)))
-    dists_p = np.pad(query_knn_dists, ((0, pad), (0, 0)))
-    rho, sigma = smooth_knn_calibration(
-        jnp.asarray(dists_p), local_connectivity=local_connectivity
-    )
-    # np.array (not asarray): jax->numpy views are read-only and the
-    # padding rows are zeroed in place below
-    w = np.array(
-        jnp.exp(
-            -jnp.maximum(jnp.asarray(dists_p) - rho[:, None], 0.0) / sigma[:, None]
+    with profiling.phase("umap.transform"):
+        bucket = shape_bucket(nq, lo=64)
+        pad = bucket - nq
+        ids_dev = _h2d(np.pad(query_knn_ids, ((0, pad), (0, 0))), np.int32)
+        dists_dev = _h2d(
+            np.pad(query_knn_dists, ((0, pad), (0, 0))), np.float32
         )
-    )
-    wn = w / np.maximum(w.sum(axis=1, keepdims=True), 1e-12)
-    init = np.einsum("nk,nkc->nc", wn, train_embedding[ids_p]).astype(np.float32)
-    if a is None or b is None:
-        return init[:nq]
-    if n_epochs is None:
-        n_epochs = 100 if train_embedding.shape[0] <= 10_000 else 30
-    else:
-        n_epochs = max(int(n_epochs) // 3, 1)
-    tails = ids_p.astype(np.int32)              # (bucket, k)
-    wmax = w[:nq].max() if nq else 1.0
-    # padding rows get weight 0: their edges never fire
-    w[nq:] = 0.0
-    weights = (w / max(wmax, 1e-12)).astype(np.float32)  # (bucket, k)
-    if train_embedding_dev is None:
-        train_embedding_dev = jnp.asarray(train_embedding.astype(np.float32))
-    out = optimize_transform_layout(
-        jnp.asarray(init),
-        train_embedding_dev,
-        jnp.asarray(tails),
-        jnp.asarray(weights),
-        float(a),
-        float(b),
-        int(n_epochs),
-        float(learning_rate),
-        float(repulsion_strength),
-        int(negative_sample_rate),
-        int(seed),
-    )
-    return np.asarray(out[:nq])
+        if train_embedding_dev is None:
+            train_embedding_dev = _h2d(train_embedding, np.float32)
+        emb_q, weights = cached_kernel(
+            "umap_transform_prepare",
+            _transform_prepare,
+            ids_dev,
+            dists_dev,
+            train_embedding_dev,
+            jnp.int32(nq),
+            jnp.float32(local_connectivity),
+        )
+        if a is None or b is None:
+            return np.asarray(emb_q)[:nq]
+        if n_epochs is None:
+            n_epochs = 100 if train_embedding.shape[0] <= 10_000 else 30
+        else:
+            n_epochs = max(int(n_epochs) // 3, 1)
+        epochs_total = jnp.float32(max(n_epochs, 1))
+        scal = (
+            jnp.float32(a),
+            jnp.float32(b),
+            jnp.float32(learning_rate),
+            jnp.float32(repulsion_strength),
+            jnp.int32(np.int64(seed) & 0x7FFFFFFF),
+        )
+        block = _epoch_block()
+        for e0 in range(0, n_epochs, block):
+            blk = min(block, n_epochs - e0)
+            emb_q = cached_kernel(
+                "umap_transform_step",
+                _transform_step,
+                emb_q,
+                train_embedding_dev,
+                ids_dev,
+                weights,
+                jnp.int32(e0),
+                epochs_total,
+                *scal,
+                block=blk,
+                negative_sample_rate=int(negative_sample_rate),
+            )
+            profiling.incr_counter("umap.transform.dispatches")
+            profiling.record_event("umap.transform.step", e0=e0, block=blk)
+        return np.asarray(emb_q)[:nq]

@@ -133,6 +133,26 @@ def allgather_bytes(
         return [b"".join(p) for p in parts]
 
 
+# -- device-side collectives ---------------------------------------------------
+# The helpers above move HOST bytes over whatever allGather the cluster
+# control plane offers.  allgather_rows is their IN-MESH analog for code
+# running inside shard_map bodies (a jax collective over ICI/DCN): the UMAP
+# layout engine combines per-device head-block updates with one tiled
+# all-gather per epoch, the same "partial result per rank -> full result
+# everywhere" shape allgather_bytes gives the host planes.  Kept here so
+# every exchange primitive — host or device — lives in one module.
+
+
+def allgather_rows(x, axis_name: str = None):
+    """Concatenate per-device row blocks along axis 0 (lax.all_gather,
+    tiled).  Call ONLY inside a shard_map body bound over `axis_name`."""
+    import jax
+
+    from .mesh import DATA_AXIS
+
+    return jax.lax.all_gather(x, axis_name or DATA_AXIS, axis=0, tiled=True)
+
+
 def alltoall_bytes(
     cp: Any,
     rank: int,

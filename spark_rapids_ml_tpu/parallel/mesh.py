@@ -53,8 +53,38 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
 
 
+def col_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard axis 1 (columns) over the data axis.  The UMAP layout engine
+    keeps its edge arrays in transposed (P, n) component-sliced form (minor
+    dimension = nodes, for full TPU lanes); sharding the NODE axis there
+    means sharding columns, so each device owns a contiguous head block."""
+    return NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+# Row-pad multiple shared by sharded kernels whose RNG streams index GLOBAL
+# padded positions (the UMAP layout's counter-based threefry draws): padding
+# to lcm(64, n_shards) keeps the padded geometry — and therefore every
+# counter-derived draw — IDENTICAL across all mesh sizes that DIVIDE 64
+# (every power-of-two TPU mesh up to 64 devices), which is what makes
+# "fixed seed => same embedding on any such mesh" testable.  A mesh size
+# that does not divide 64 (e.g. 6) raises the lcm, changing the padded
+# geometry: still deterministic for that shape, just not bit-identical to
+# the power-of-two shapes.
+ROW_PAD_LANES = 64
+
+
+def padded_row_count(n: int, mesh: Optional[Mesh] = None) -> int:
+    """Rows padded up to a multiple of lcm(ROW_PAD_LANES, data-axis size)."""
+    import math
+
+    mult = ROW_PAD_LANES
+    if mesh is not None:
+        mult = math.lcm(mult, mesh.shape[DATA_AXIS])
+    return -(-max(n, 1) // mult) * mult
 
 
 def shard_rows(

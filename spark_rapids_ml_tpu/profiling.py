@@ -87,6 +87,19 @@ def counters(prefix: str = "") -> Dict[str, int]:
         return {k: v for k, v in _counters.items() if k.startswith(prefix)}
 
 
+def counter_deltas(before: Dict[str, int], prefix: str = "") -> Dict[str, int]:
+    """Nonzero differences of the current counters vs a `counters(prefix)`
+    snapshot — the benchmark/test idiom for "what moved during this fit"
+    without resetting the monotonic registry."""
+    now = counters(prefix)
+    keys = set(now) | set(before)
+    return {
+        k: now.get(k, 0) - before.get(k, 0)
+        for k in sorted(keys)
+        if now.get(k, 0) != before.get(k, 0)
+    }
+
+
 def reset_counters(prefix: str = "") -> None:
     """Zero counters matching `prefix` (tests; production code never resets —
     the counters are monotonic so deltas are always well-defined)."""

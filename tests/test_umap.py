@@ -161,6 +161,55 @@ def test_umap_supervised():
     )
 
 
+def test_umap_supervised_nan_and_unknown_labels():
+    # NaN labels are "unknown" (reference umap.py:939-947 passes them to
+    # cuML as unlabeled): edges touching them get the exp(-unknown_dist)
+    # downweight, not the exp(-far_dist) cross-class one, and the fit must
+    # stay finite end to end
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.umap import (
+        categorical_simplicial_set_intersection,
+    )
+
+    W = jnp.asarray(np.full((4, 2), 0.8, np.float32))
+    ids = jnp.asarray(np.array([[1, 2], [0, 3], [3, 0], [2, 1]], np.int32))
+    codes = jnp.asarray(np.array([0, 0, 1, -1], np.int32))  # -1 = unknown
+    out = np.asarray(categorical_simplicial_set_intersection(W, ids, codes))
+    raw = 0.8 * np.array(
+        [
+            [1.0, np.exp(-5.0)],          # 0-1 same, 0-2 differ
+            [1.0, np.exp(-1.0)],          # 1-0 same, 1-3 unknown
+            [np.exp(-1.0), np.exp(-5.0)], # 2-3 unknown, 2-0 differ
+            [np.exp(-1.0), np.exp(-1.0)], # 3-* unknown
+        ]
+    )
+    expect = raw / np.maximum(raw.max(axis=1, keepdims=True), 1e-12)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    # model-level: a label column carrying NaNs must fit finite
+    X, labels = _blob_data(n=120, d=6)
+    y = labels.astype(np.float64)
+    y[::5] = np.nan
+    df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    m = UMAP(n_neighbors=8, random_state=1, n_epochs=60).setLabelCol("label").fit(df)
+    assert m.embedding.shape == (120, 2)
+    assert np.all(np.isfinite(m.embedding))
+
+
+def test_umap_precomputed_knn_row_mismatch_message():
+    # the models/umap.py guard must name both row counts, and must fire
+    # BEFORE any layout work (a wrong-sized graph is a user error, not a
+    # shape crash deep in the engine)
+    X, _ = _blob_data(n=60)
+    df = DataFrame.from_numpy(X, num_partitions=2)
+    k = 5
+    ids = np.tile(np.arange(k), (40, 1))
+    dists = np.abs(np.random.default_rng(0).random((40, k))).cumsum(axis=1)
+    with pytest.raises(ValueError, match=r"precomputed_knn has 40 rows.*60"):
+        UMAP(n_neighbors=k, precomputed_knn=(ids, dists), random_state=0).fit(df)
+
+
 def test_umap_supervised_ignored_when_label_unset():
     # a label column present in the df but labelCol unset -> unsupervised
     X, labels = _blob_data(n=80, d=6)

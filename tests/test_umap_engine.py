@@ -1,0 +1,227 @@
+# Sharded UMAP engine contracts (ops/umap.py rework): on-device graph
+# assembly vs the host reference, mesh-shape determinism (the CI parity gate
+# runs this file on the 8-device CPU mesh), scan-batched dispatch counting,
+# single-upload accounting, quality parity against the single-device
+# reference layout, and zero-recompile repeat fits.
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu import UMAP, profiling
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.ops import umap as uops
+from spark_rapids_ml_tpu.parallel.mesh import get_mesh, padded_row_count
+
+
+def _blob_graph(n=320, d=8, k=12, seed=0):
+    """Deterministic tier-1 parity fixture: blob data + its exact kNN graph."""
+    rng = np.random.default_rng(seed)
+    centers = 10.0 * rng.normal(size=(3, d))
+    labels = rng.integers(0, 3, size=n)
+    X = (centers[labels] + rng.normal(size=(n, d))).astype(np.float32)
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    dists, ids = SkNN(n_neighbors=k).fit(X).kneighbors(X)
+    return X, ids.astype(np.int64), dists.astype(np.float32)
+
+
+def _fit_kwargs(n_epochs=120, seed=7):
+    return dict(
+        n_components=2,
+        a=1.577,
+        b=0.895,
+        n_epochs=n_epochs,
+        learning_rate=1.0,
+        init="spectral",
+        set_op_mix_ratio=1.0,
+        local_connectivity=1.0,
+        repulsion_strength=1.0,
+        negative_sample_rate=5,
+        seed=seed,
+    )
+
+
+def _neighbor_preservation(X, emb, k=15):
+    """Mean fraction of each point's k high-dim neighbors preserved among
+    its k embedding neighbors (the acceptance metric)."""
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    _, hi = SkNN(n_neighbors=k + 1).fit(X).kneighbors(X)
+    _, lo = SkNN(n_neighbors=k + 1).fit(emb).kneighbors(emb)
+    keep = 0.0
+    for a, b in zip(hi[:, 1:], lo[:, 1:]):
+        keep += len(set(a) & set(b)) / float(k)
+    return keep / len(X)
+
+
+def test_device_assembly_matches_host_reference():
+    """build_head_layout_device must produce the same padded head layout as
+    the host dedupe_undirected + padded_head_layout reference (same pad
+    width, and per node the same truncated edge set with the same
+    normalized weights)."""
+    X, ids, dists = _blob_graph(n=200, k=10, seed=3)
+    n = ids.shape[0]
+    n_epochs = 150
+    W = uops._calibrated_weights(
+        jnp.asarray(ids.astype(np.int32)), jnp.asarray(dists), 1.0, 1.0
+    )
+    # host reference: dedupe -> prune -> pad -> normalize
+    Wh = np.asarray(W)
+    wmax = Wh.max()
+    ii, jj, ww = uops.dedupe_undirected(ids, Wh)
+    keep = ww / max(wmax, 1e-12) >= 1.0 / n_epochs
+    tails_h, w_h = uops.padded_head_layout(ii[keep], jj[keep], ww[keep], n)
+    w_h = w_h / max(wmax, 1e-12)
+    # device path (padding rows beyond n are 0-weight self-loops)
+    n_pad = padded_row_count(n)
+    tails_d, w_d = uops.build_head_layout_device(
+        jnp.asarray(ids.astype(np.int32)), W, n_pad, n_epochs
+    )
+    tails_d, w_d = np.asarray(tails_d), np.asarray(w_d)
+    assert tails_d.shape == (n_pad, tails_h.shape[1])
+    assert np.all(w_d[n:] == 0.0)
+    assert np.all(tails_d[n:] == np.arange(n, n_pad)[:, None])
+    for i in range(n):
+        host_edges = {
+            (int(t), round(float(w), 5))
+            for t, w in zip(tails_h[i], w_h[i])
+            if w > 0
+        }
+        dev_edges = {
+            (int(t), round(float(w), 5))
+            for t, w in zip(tails_d[i], w_d[i])
+            if w > 0
+        }
+        assert host_edges == dev_edges, i
+
+
+def test_mesh_shape_parity_and_quality():
+    """The CI multi-device gate: a fixed seed must give the same embedding
+    on a 1-device and an 8-device mesh (counter-based threefry draws index
+    global positions, so sharding cannot change them), and the k=15
+    neighbor-preservation score must stay within 1% of the single-device
+    REFERENCE layout implementation (optimize_layout_padded)."""
+    X, ids, dists = _blob_graph()
+    n = ids.shape[0]
+    kwargs = _fit_kwargs()
+    emb_multi = uops.umap_fit_embedding(
+        ids, dists, mesh=get_mesh(), **kwargs
+    )
+    emb_single = uops.umap_fit_embedding(
+        ids, dists, mesh=get_mesh(1), **kwargs
+    )
+    assert emb_multi.shape == (n, 2)
+    np.testing.assert_allclose(emb_multi, emb_single, atol=1e-4)
+
+    # quality guard vs the pre-sharding reference: same graph + same init,
+    # epochs run through the old single-device fori layout
+    W = uops._calibrated_weights(
+        jnp.asarray(ids.astype(np.int32)), jnp.asarray(dists), 1.0, 1.0
+    )
+    n_pad = padded_row_count(n, get_mesh())
+    tails_pad, w_pad = uops.build_head_layout_device(
+        jnp.asarray(ids.astype(np.int32)), W, n_pad, kwargs["n_epochs"]
+    )
+    key = jax.random.PRNGKey(kwargs["seed"])
+    init = uops._spectral_scale_noise(
+        uops._laplacian_eigenmap_kernel(
+            tails_pad, w_pad, key, jnp.int32(n), c=2
+        ),
+        jax.random.fold_in(key, 0x5CA1E),
+    )
+    emb_ref = np.asarray(
+        uops.optimize_layout_padded(
+            init,
+            tails_pad,
+            w_pad,
+            kwargs["a"],
+            kwargs["b"],
+            kwargs["n_epochs"],
+            kwargs["learning_rate"],
+            kwargs["repulsion_strength"],
+            kwargs["negative_sample_rate"],
+            kwargs["seed"],
+        )
+    )[:n]
+    s_new = _neighbor_preservation(X, emb_multi)
+    s_ref = _neighbor_preservation(X, emb_ref)
+    assert abs(s_new - s_ref) < 0.01, (s_new, s_ref)
+
+
+def test_layout_dispatch_count_is_epoch_blocks(monkeypatch):
+    """The epoch loop must issue exactly ceil(n_epochs / EPOCH_BLOCK)
+    device dispatches (the scan-batching acceptance bound)."""
+    monkeypatch.setenv("SRML_UMAP_EPOCH_BLOCK", "40")
+    X, ids, dists = _blob_graph(n=128, k=8, seed=5)
+    c0 = profiling.counters("umap.layout")
+    uops.umap_fit_embedding(
+        ids, dists, mesh=get_mesh(), **_fit_kwargs(n_epochs=100)
+    )
+    delta = profiling.counter_deltas(c0, "umap.layout")
+    assert delta.get("umap.layout.dispatches", 0) == 3  # ceil(100 / 40)
+
+
+def test_fit_uploads_graph_once():
+    """Single-upload contract: a host-array fit moves exactly the (n, k)
+    ids + dists over the host link — the graph never round-trips back up
+    (the duplicate tails_pad/w_pad upload this engine removed), and the
+    supervised path adds only the label-code vector."""
+    X, ids, dists = _blob_graph(n=128, k=8, seed=9)
+    c0 = profiling.counters("umap.h2d")
+    uops.umap_fit_embedding(
+        ids, dists, mesh=get_mesh(), **_fit_kwargs(n_epochs=20)
+    )
+    d1 = profiling.counter_deltas(c0, "umap.h2d")
+    assert d1.get("umap.h2d_transfers", 0) == 2
+    assert d1.get("umap.h2d_bytes", 0) == ids.size * 4 + dists.size * 4
+
+    y = np.random.default_rng(0).integers(0, 3, size=len(X)).astype(np.float64)
+    c1 = profiling.counters("umap.h2d")
+    uops.umap_fit_embedding(
+        ids, dists, y=y, mesh=get_mesh(), **_fit_kwargs(n_epochs=20)
+    )
+    d2 = profiling.counter_deltas(c1, "umap.h2d")
+    assert d2.get("umap.h2d_transfers", 0) == 3  # + the label codes
+
+
+def test_repeat_fit_zero_new_compiles():
+    """The acceptance smoke mirroring tests/test_precompile.py for kNN: a
+    second same-shape UMAP.fit performs ZERO new compilations — every
+    engine kernel (graph assembly, layout steps, knn search) lands on a
+    cached AOT executable."""
+    X, _, _ = _blob_graph(n=256, k=10, seed=11)
+    df = DataFrame.from_numpy(X.astype(np.float64), num_partitions=2)
+    est = UMAP(n_neighbors=10, random_state=0, n_epochs=80)
+    m1 = est.fit(df)
+    c0 = profiling.counters("precompile")
+    m2 = est.fit(df)
+    c1 = profiling.counters("precompile")
+    assert c1.get("precompile.compile", 0) == c0.get("precompile.compile", 0)
+    assert c1.get("precompile.fallback", 0) == c0.get("precompile.fallback", 0)
+    assert c1.get("precompile.aot_hit", 0) > c0.get("precompile.aot_hit", 0)
+    np.testing.assert_allclose(m1.embedding_, m2.embedding_, atol=1e-5)
+
+
+def test_transform_device_path_deterministic_and_blocked(monkeypatch):
+    """The transform refinement must be scan-batched (ceil(epochs/block)
+    dispatches), deterministic across repeat calls, and bucket-padded so
+    the padding rows never leak into results."""
+    monkeypatch.setenv("SRML_UMAP_EPOCH_BLOCK", "16")
+    rng = np.random.default_rng(2)
+    nr, nq, k, c = 300, 100, 8, 2
+    train_emb = rng.normal(size=(nr, c)).astype(np.float32)
+    q_ids = rng.integers(0, nr, size=(nq, k))
+    q_dists = np.sort(rng.random(size=(nq, k)).astype(np.float32) + 0.05, axis=1)
+    kwargs = dict(
+        local_connectivity=1.0, a=1.577, b=0.895, n_epochs=96, seed=5
+    )  # 96 // 3 = 32 refinement epochs -> 2 blocks of 16
+    c0 = profiling.counters("umap.transform")
+    e1 = uops.umap_transform_embedding(q_ids, q_dists, train_emb, **kwargs)
+    d1 = profiling.counter_deltas(c0, "umap.transform")
+    assert d1.get("umap.transform.dispatches", 0) == 2
+    e2 = uops.umap_transform_embedding(q_ids, q_dists, train_emb, **kwargs)
+    assert e1.shape == (nq, c)
+    np.testing.assert_allclose(e1, e2, atol=1e-6)
+    assert np.all(np.isfinite(e1))
