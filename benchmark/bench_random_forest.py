@@ -2,6 +2,16 @@
 # RandomForest benchmarks (reference benchmark/bench_random_forest.py):
 # classifier scored by accuracy, regressor by RMSE.
 #
+# Same countermeasures PR 2/PR 3 applied to bench_nearest_neighbors and
+# bench_umap: deterministic block-stashed staging, an explicit warm-up fit
+# so the timed run measures steady-state throughput off cached AOT
+# executables (rf_clf's 50 s cold compile used to pollute cold_sec and hide
+# steady-state movement — it is now reported separately as
+# warmup_fit_time), and phase-timing + precompile/engine counter reporting
+# (forest.bin/hist/route/split phases, forest.levels.dispatches /
+# forest.level_syncs / forest.d2h_transfers and precompile.* deltas) so
+# regressions are attributable to a layer, not just a number.
+#
 
 from __future__ import annotations
 
@@ -12,8 +22,6 @@ import numpy as np
 from spark_rapids_ml_tpu.dataframe import DataFrame
 
 from .base import BenchmarkBase
-from .bench_linear_regression import _rmse
-from .bench_logistic_regression import _accuracy
 from .utils import with_benchmark
 
 
@@ -43,45 +51,90 @@ class _BenchmarkRandomForestBase(BenchmarkBase):
             from spark_rapids_ml_tpu import (
                 RandomForestClassifier,
                 RandomForestRegressor,
+                profiling,
             )
+
+            # Deterministic staging: re-host the loaded frames as
+            # block-stashed f32 DataFrames (from_numpy pins ONE contiguous
+            # feature block per partition) so repeat fits reuse the
+            # device-resident input cache and stage identically — the
+            # column-stacked parquet frames re-extract fresh arrays per call.
+            X, y = self.to_numpy(train_df, features_col, label_col)
+            train_bdf = DataFrame.from_numpy(X.astype(np.float32), y=y)
+            Xt, yt = self.to_numpy(transform_df, features_col, label_col)
+            query_bdf = DataFrame.from_numpy(Xt.astype(np.float32))
 
             cls = RandomForestClassifier if self._is_classifier else RandomForestRegressor
             est = (
                 cls(**params, **self.num_workers_arg())
-                .setFeaturesCol(features_col)
-                .setLabelCol(label_col)
+                .setFeaturesCol("features")
+                .setLabelCol("label")
             )
-            model, fit_time = with_benchmark("fit", lambda: est.fit(train_df))
+            # explicit warm-up fit: compiles every engine geometry (binning,
+            # level-block kernels, predict buckets) into the AOT executable
+            # cache; the timed runs below then measure steady-state
+            # throughput with zero new compilations (precompile.* deltas)
+            # and the scan-batched dispatch count (forest.levels.dispatches)
+            warm_model, warmup_fit_time = with_benchmark(
+                "fit warmup (cold)", lambda: est.fit(train_bdf)
+            )
+            _, warmup_transform_time = with_benchmark(
+                "transform warmup", lambda: warm_model.transform(query_bdf)
+            )
+            profiling.reset_phase_times()
+            counters0 = profiling.counters()
+            model, fit_time = with_benchmark("fit", lambda: est.fit(train_bdf))
             out, transform_time = with_benchmark(
-                "transform", lambda: model.transform(transform_df)
+                "transform", lambda: model.transform(query_bdf)
             )
+            phases = {
+                name: round(sec, 4)
+                for name, sec in sorted(profiling.phase_times().items())
+            }
+            deltas = profiling.counter_deltas(counters0)
             pred_col = model.getOrDefault("predictionCol")
-            score = (
-                _accuracy(out, label_col, pred_col)
-                if self._is_classifier
-                else _rmse(out, label_col, pred_col)
-            )
-        else:
-            from sklearn.ensemble import (
-                RandomForestClassifier as SkRFC,
-                RandomForestRegressor as SkRFR,
-            )
+            out_pd = out.toPandas()
+            if self._is_classifier:
+                score = float((out_pd[pred_col].to_numpy() == yt).mean())
+            else:
+                score = float(
+                    np.sqrt(np.mean((out_pd[pred_col].to_numpy() - yt) ** 2))
+                )
+            return {
+                "fit_time": fit_time,
+                "warmup_fit_time": warmup_fit_time,
+                "warmup_transform_time": warmup_transform_time,
+                "transform_time": transform_time,
+                "total_time": fit_time + transform_time,
+                "score": score,
+                "phase_times": phases,
+                "precompile_counters": {
+                    k: v for k, v in deltas.items() if k.startswith("precompile")
+                },
+                "forest_counters": {
+                    k: v for k, v in deltas.items() if k.startswith("forest")
+                },
+            }
+        from sklearn.ensemble import (
+            RandomForestClassifier as SkRFC,
+            RandomForestRegressor as SkRFR,
+        )
 
-            X, y = self.to_numpy(train_df, features_col, label_col)
-            sk_cls = SkRFC if self._is_classifier else SkRFR
-            sk = sk_cls(
-                n_estimators=params["numTrees"],
-                max_depth=params["maxDepth"],
-                random_state=params["seed"],
-            )
-            _, fit_time = with_benchmark("fit", lambda: sk.fit(X, y))
-            Xt, yt = self.to_numpy(transform_df, features_col, label_col)
-            pred, transform_time = with_benchmark("transform", lambda: sk.predict(Xt))
-            score = (
-                float(np.mean(yt == pred))
-                if self._is_classifier
-                else float(np.sqrt(np.mean((yt - pred) ** 2)))
-            )
+        X, y = self.to_numpy(train_df, features_col, label_col)
+        sk_cls = SkRFC if self._is_classifier else SkRFR
+        sk = sk_cls(
+            n_estimators=params["numTrees"],
+            max_depth=params["maxDepth"],
+            random_state=params["seed"],
+        )
+        _, fit_time = with_benchmark("fit", lambda: sk.fit(X, y))
+        Xt, yt = self.to_numpy(transform_df, features_col, label_col)
+        pred, transform_time = with_benchmark("transform", lambda: sk.predict(Xt))
+        score = (
+            float(np.mean(yt == pred))
+            if self._is_classifier
+            else float(np.sqrt(np.mean((yt - pred) ** 2)))
+        )
         return {
             "fit_time": fit_time,
             "transform_time": transform_time,

@@ -60,6 +60,23 @@ python -m tools.graftlint spark_rapids_ml_tpu/ops/umap.py \
     spark_rapids_ml_tpu/models/umap.py spark_rapids_ml_tpu/ops/precompile.py \
     spark_rapids_ml_tpu/parallel/mesh.py spark_rapids_ml_tpu/parallel/exchange.py
 
+# 3d. focused gates for the device-resident forest engine (also inside the
+#     full suite; re-asserted by name so marker drift can never silently
+#     drop them).  Runs on the 8-device CPU mesh, forced explicitly:
+#     - mesh parity: fixed seed => IDENTICAL forest (features, thresholds,
+#       leaf values) on a 1-device and an 8-device mesh fit
+#     - dispatch counting: ceil(levels / SRML_FOREST_LEVEL_BLOCK) engine
+#       dispatches, one early-stop flag sync per block, ONE forest fetch
+#       (forest.levels.dispatches / forest.level_syncs / forest.d2h_transfers)
+#     - zero-recompile repeat fit + repeat transform (precompile counters)
+#     - interpret-mode sharded+psum MXU histogram rule vs the numpy oracle
+#     plus a graftlint-clean re-check of the engine modules by name.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_forest_engine.py -q
+python -m tools.graftlint spark_rapids_ml_tpu/ops/forest.py \
+    spark_rapids_ml_tpu/ops/forest_hist.py spark_rapids_ml_tpu/ops/forest_mxu.py \
+    spark_rapids_ml_tpu/models/random_forest.py
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

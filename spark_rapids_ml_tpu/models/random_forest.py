@@ -49,15 +49,15 @@ from ..params import (
     _dummy,
     _TpuParams,
 )
+from .. import profiling
 from ..ops.forest import (
-    TreeArrays,
     bin_features,
     bin_features_feature_major,
     compute_bin_edges,
     compute_bin_edges_device,
-    forest_predict_kernel,
+    forest_predict_cached,
     grow_forest,
-    grow_tree,
+    warm_forest_kernels,
 )
 from ..utils import get_logger
 
@@ -205,13 +205,6 @@ def _per_tree_stats(stats, weight, key, n_trees, bootstrap):
     return stats[None, :, :] * w_t[:, :, None]
 
 
-@jax.jit
-def _bootstrap_row_weights(weight, key):
-    """One tree's Poisson bootstrap weights, sharded like the weight row."""
-    bw = jax.random.poisson(key, 1.0, weight.shape).astype(weight.dtype)
-    return weight * bw
-
-
 def _str_or_numerical(value: str) -> Union[str, float, int]:
     """'0.3' -> 0.3, '5' -> 5, else the string (reference utils helper
     used by the max_features mapping)."""
@@ -226,10 +219,13 @@ def _str_or_numerical(value: str) -> Union[str, float, int]:
 
 def _mxu_eligible(inputs, n_bins, max_features, max_depth, s_split) -> bool:
     """Whether the MXU histogram builder (ops/forest_mxu) serves this fit;
-    False -> the scatter path.  TPU scatter sustains ~10M updates/s, the
-    MXU path ~36 TF-equivalent.  The pallas kernel is single-chip (no
-    sharding rule yet): sharded fits keep the scatter path, which runs
-    correctly under GSPMD."""
+    False -> the mesh-parallel engine (ops/forest.grow_forest).  TPU
+    scatter sustains ~10M updates/s, the MXU path ~36 TF-equivalent.  The
+    histogram KERNEL has a mesh sharding rule
+    (forest_hist.node_histograms_sharded), but the full builder still
+    drives a single chip end-to-end (unsharded deep-phase payload sort), so
+    multi-device fits run the sharded scan-batched engine — no longer the
+    old host-driven per-level loop."""
     from ..ops import forest_mxu
 
     return (
@@ -541,49 +537,56 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
                 }
                 attrs.update(extra_attrs)
                 return attrs
-            # Lock-step forest growth (one host level-loop for ALL trees)
-            # unless the batched path's device buffers would be too large:
-            # the (combined, D) feature-subset scores at the deepest level,
-            # or the (T, N, S) per-tree stats tensor itself (a per-tree fit
-            # only ever holds one (N, S) stats array) — those cases fall
-            # back to per-tree growth.
-            Xb = get_bins("rm", edges)
-            subset_bytes = (
-                n_trees * (2**max_depth) * inputs.n_cols * 4
+            # Mesh-parallel engine growth (ops/forest.grow_forest): trees
+            # ride the scan-batched level-block kernels in CHUNKS sized so
+            # the (combined, D) per-node feature-subset scores at the
+            # deepest level and the (Tc, N, S) per-tree stats tensor each
+            # stay within budget.  The old per-tree grow_tree fallback —
+            # one host level-loop per tree plus five np.asarray device
+            # fetches per tree when stacking — is gone: a chunk of ONE
+            # tree still runs the batched engine with its single fetch.
+            n_pad = inputs.X.shape[0]
+            t_sub = (
+                max(1, (512 << 20) // max(1, (2**max_depth) * inputs.n_cols * 4))
                 if max_features < inputs.n_cols
-                else 0
+                else n_trees
             )
-            stats_bytes = n_trees * inputs.X.shape[0] * stats.shape[1] * 4
-            if subset_bytes <= (512 << 20) and stats_bytes <= (2 << 30):
+            t_stats = max(1, (2 << 30) // max(1, n_pad * stats.shape[1] * 4))
+            t_chunk = max(1, min(n_trees, t_sub, t_stats))
+            # stage the level-block kernel compiles on the precompile pool
+            # BEFORE binning runs, so XLA compiles while rows are binned.
+            # The tree count rides every kernel aval shape, so a partial
+            # final chunk is its own geometry — warm it too, or its blocks
+            # cold-compile serially at the end of the fit
+            warm_forest_kernels(
+                n_pad, inputs.n_cols, t_chunk, stats.shape[1],
+                mesh=inputs.mesh, dtype=stats.dtype, **grow_kwargs,
+            )
+            t_rem = n_trees % t_chunk
+            if t_rem:
+                warm_forest_kernels(
+                    n_pad, inputs.n_cols, t_rem, stats.shape[1],
+                    mesh=inputs.mesh, dtype=stats.dtype, **grow_kwargs,
+                )
+            Xb = get_bins("rm", edges)
+            parts = []
+            for t0 in range(0, n_trees, t_chunk):
+                tc = min(t_chunk, n_trees - t0)
                 key, kt = jax.random.split(key)
-                stats_t = _per_tree_stats(
-                    stats, inputs.weight, kt, n_trees, bootstrap
-                )
-                features, thresholds, leaf_values, node_counts, impurities = (
-                    grow_forest(Xb, stats_t, edges, seed=seed, **grow_kwargs)
-                )
-            else:
-                trees: List[TreeArrays] = []
-                for t in range(n_trees):
-                    key, kt = jax.random.split(key)
-                    if bootstrap:
-                        w_t = _bootstrap_row_weights(inputs.weight, kt)
-                    else:
-                        w_t = inputs.weight
-                    trees.append(
-                        grow_tree(
-                            Xb,
-                            stats * w_t[:, None],
-                            edges,
-                            seed=(seed + 7919 * t) & 0x7FFFFFFF,
-                            **grow_kwargs,
-                        )
+                stats_t = _per_tree_stats(stats, inputs.weight, kt, tc, bootstrap)
+                parts.append(
+                    grow_forest(
+                        Xb, stats_t, edges,
+                        seed=(seed + 7919 * t0) & 0x7FFFFFFF,
+                        mesh=inputs.mesh, **grow_kwargs,
                     )
-                features = np.stack([np.asarray(t.feature) for t in trees])
-                thresholds = np.stack([np.asarray(t.threshold) for t in trees])
-                leaf_values = np.stack([np.asarray(t.leaf_value) for t in trees])
-                node_counts = np.stack([np.asarray(t.n_samples) for t in trees])
-                impurities = np.stack([np.asarray(t.impurity) for t in trees])
+                )
+            if len(parts) == 1:
+                features, thresholds, leaf_values, node_counts, impurities = parts[0]
+            else:
+                features, thresholds, leaf_values, node_counts, impurities = (
+                    np.concatenate([p[i] for p in parts]) for i in range(5)
+                )
             logger.info("grew %d trees (depth<=%d, bins=%d)", n_trees, max_depth, n_bins)
             attrs = {
                 "features_": features,
@@ -609,12 +612,13 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
             # matrix crosses the link); multi-rank/CPU fits take the host
             # gather path.
             X_host = None
-            sample_dev = _binning_sample_device(inputs)
-            if sample_dev is not None:
-                edges = compute_bin_edges_device(sample_dev, n_bins)
-            else:
-                X_host = _binning_sample(inputs)
-                edges = compute_bin_edges(X_host, n_bins)
+            with profiling.phase("forest.bin"):
+                sample_dev = _binning_sample_device(inputs)
+                if sample_dev is not None:
+                    edges = compute_bin_edges_device(sample_dev, n_bins)
+                else:
+                    X_host = _binning_sample(inputs)
+                    edges = compute_bin_edges(X_host, n_bins)
 
             # Lazy per-route binning: the MXU route bins straight into the
             # feature-major int8 layout (bin_features_feature_major), the
@@ -633,16 +637,17 @@ class _RandomForestEstimator(_RandomForestParams, _TpuEstimatorSupervised):
                     return cached[1]
                 if any(held[0] is not e for held in bins_cache.values()):
                     bins_cache.clear()  # new edges: old matrices are dead
-                if layout == "fm":
-                    from ..ops.forest_hist import _ROW_TILE
+                with profiling.phase("forest.bin"):
+                    if layout == "fm":
+                        from ..ops.forest_hist import _ROW_TILE
 
-                    n = inputs.X.shape[0]
-                    n_pad = -(-n // _ROW_TILE) * _ROW_TILE
-                    out = bin_features_feature_major(
-                        inputs.X, jnp.asarray(e), n_pad=n_pad
-                    )
-                else:
-                    out = bin_features(inputs.X, jnp.asarray(e))
+                        n = inputs.X.shape[0]
+                        n_pad = -(-n // _ROW_TILE) * _ROW_TILE
+                        out = bin_features_feature_major(
+                            inputs.X, jnp.asarray(e), n_pad=n_pad
+                        )
+                    else:
+                        out = bin_features(inputs.X, jnp.asarray(e))
                 bins_cache[layout] = (e, out)
                 return out
 
@@ -760,17 +765,30 @@ class _RandomForestModelBase(_RandomForestParams, _TpuModelWithPredictionCol):
             )
         np_dtype = self._transform_dtype(self.dtype)
         f, t, v = self._forest_arrays()
-        feats_dev = jax.device_put(np.asarray(features, np_dtype))
+        n = features.shape[0]
+        # pad the batch to its power-of-two row bucket ONCE, outside the
+        # sub-model loop — a combined CV model would otherwise re-pad the
+        # identical feature matrix per tree slice
+        from ..ops.precompile import shape_bucket
+
+        b = shape_bucket(n)
+        feats_np = np.asarray(features, np_dtype)
+        if b != n:
+            feats_np = np.pad(feats_np, ((0, b - n), (0, 0)))
+        feats_dev = jax.device_put(feats_np)
         counts = getattr(self, "_tree_counts", None) or [self.features_.shape[0]]
         out, off = [], 0
         for c in counts:
             sl = slice(off, off + c)
             off += c
+            # cached-executable dispatch with power-of-two row bucketing:
+            # repeat transforms at any partition size reuse one executable
+            # per bucket instead of compiling per distinct batch length
             out.append(
-                forest_predict_kernel(
+                forest_predict_cached(
                     feats_dev, f[sl], t[sl], v[sl],
                     max_depth=int(self.max_depth),
-                )
+                )[:n]
             )
         # dispatch every sub-model's kernel first, then ONE batched fetch: a
         # per-slice np.asarray blocked dispatch on each device round-trip

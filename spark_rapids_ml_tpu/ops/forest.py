@@ -11,8 +11,6 @@
 #   - trees grow LEVEL-WISE with static shapes: at level L there are 2^L
 #     dense node slots; per-level histograms are segment-sums keyed by
 #     (node, bin), vmapped over features; split selection is a pure argmax
-#   - per-level kernels are jitted once per level shape and reused across
-#     every tree and every fit with the same geometry
 #   - rows carry an int32 node id; routing is a gather + compare per level
 #   - bootstrap = per-tree Poisson(1) row weights; featureSubsetStrategy =
 #     per-node Gumbel top-k feature masks
@@ -25,9 +23,37 @@
 # gather/compare steps vmapped over trees.  Node histograms at a level are
 # chunked (node_batch) so deep levels stay within HBM for wide features.
 #
+# Since the device-resident engine rework, forest growth (grow_forest) runs
+# as a MESH-PARALLEL, SCAN-BATCHED pipeline (see docs/forest_engine.md):
+#
+#   - MESH-PARALLEL HISTOGRAMS: the binned row matrix, per-tree stats and
+#     routing state are row-sharded over DATA_AXIS via shard_map; each
+#     device builds per-(tree, node, feature, bin) sums over its local
+#     shard and ONE psum per level chunk (parallel/exchange.psum_parts)
+#     yields the global histograms replicated everywhere.  Split selection
+#     runs replicated; routing stays local to each shard's rows.
+#   - SCAN-BATCHED LEVEL GROWTH: SRML_FOREST_LEVEL_BLOCK levels run per
+#     jitted dispatch (lax.scan inside the shard_map body); split results
+#     scatter into dense (T, M) device tree buffers INSIDE the kernel, so
+#     the host loop only checks a per-block any-split flag (on-device early
+#     stop mask) and the whole forest crosses the link in ONE device_get at
+#     the end.  forest.levels.dispatches / forest.level_syncs /
+#     forest.d2h_transfers counters make the collapse observable.
+#   - COLD-COMPILE ELIMINATION: every block kernel dispatches through the
+#     process-wide AOT executable cache (ops/precompile) keyed on
+#     power-of-two node/feat-chunk geometry; all of a fit's block
+#     geometries are submitted for parallel compilation at entry, and
+#     warm_forest_kernels stages them even earlier (during binning), so a
+#     repeat same-shape fit performs ZERO new compilations.
+#
+# The per-tree grow_tree path below is kept as the sequential REFERENCE
+# implementation (exercised by tests); estimator fits always batch trees
+# through the engine.
+#
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -35,6 +61,15 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as PSpec
+
+from .. import profiling
+from ..parallel.mesh import (
+    DATA_AXIS,
+    axis_sharding,
+    get_mesh,
+    replicated_sharding,
+)
 
 
 class TreeArrays(NamedTuple):
@@ -294,11 +329,16 @@ def _wide_split_search(
     max_features,
     min_samples_leaf,
     min_impurity_decrease,
+    combine_hist=None,
 ):
     """Shared body of the wide (pass-per-level) split search: ONE segment_sum
     pass over the rows per feature (ids = combined_node * n_bins + bin),
     chunked over FEATURES to bound the histogram buffer.  Used by
-    level_split_kernel_wide (tile=1) and forest_level_kernel (tile=T).
+    level_split_kernel_wide (tile=1) and the mesh-parallel level-block
+    engine (tile=T), which passes `combine_hist` = a psum over DATA_AXIS so
+    per-shard partial histograms become global sums (one collective per
+    feature chunk — one per level when the chunk covers all features)
+    before any gain math runs.
 
     Returns flat (bf, bb, split_ok, p_w, p_imp, p_val) over the combined
     node axis."""
@@ -309,8 +349,13 @@ def _wide_split_search(
 
     if max_features < D:
         # per-node exact-size random feature subset: threshold at the
-        # max_features-th largest of per-(node, feature) uniform scores
-        scores = jax.random.uniform(key, (combined, D))
+        # max_features-th largest of per-(node, feature) uniform scores.
+        # Drawn f32 EXPLICITLY: the default float dtype flips to f64 under
+        # an x64 fit, and AOT executables lowered on the precompile worker
+        # threads (outside the fit's enable_x64 scope) would then draw
+        # different subsets than an inline jit trace — the draw must not
+        # depend on precision scope or warm path
+        scores = jax.random.uniform(key, (combined, D), dtype=jnp.float32)
         kth = jax.lax.top_k(scores, max_features)[0][:, -1]
         fmask_full = scores >= kth[:, None]  # (combined, D)
 
@@ -334,6 +379,8 @@ def _wide_split_search(
             return carry, h
 
         _, hist = jax.lax.scan(step, 0, cols.T)  # (fc, S, combined*B)
+        if combine_hist is not None:
+            hist = combine_hist(hist)  # shard partials -> global sums
         hist = jnp.transpose(
             jnp.moveaxis(hist, 0, 1).reshape(S, feat_batch, combined, B),
             (0, 2, 1, 3),
@@ -515,54 +562,329 @@ def forest_predict_kernel(
     return per_tree.mean(axis=0)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("n_nodes", "n_bins", "feat_batch", "kind", "max_features"),
-)
-def forest_level_kernel(
-    Xb: jax.Array,        # (N, D) shared bins
-    stats: jax.Array,     # (T, N, S) per-tree stats (bootstrap-weighted)
-    rel_node: jax.Array,  # (T, N) int32, sentinel >= n_nodes when inactive
+def forest_predict_cached(
+    X: jax.Array,
+    feature: jax.Array,
+    threshold: jax.Array,
+    leaf_value: jax.Array,
+    max_depth: int,
+) -> jax.Array:
+    """forest_predict_kernel through the process-wide AOT executable cache,
+    with the row count padded to the shared power-of-two bucket — repeat
+    transforms at ANY partition size land on a handful of cached
+    executables instead of one compile per distinct batch length."""
+    from .precompile import cached_kernel, shape_bucket
+
+    n = X.shape[0]
+    b = shape_bucket(n)
+    Xp = jnp.pad(X, ((0, b - n), (0, 0))) if b != n else X
+    out = cached_kernel(
+        "forest_predict", forest_predict_kernel, Xp, feature, threshold,
+        leaf_value, max_depth=max_depth,
+    )
+    return out[:n]
+
+
+# ---------------------------------------------------------------------------
+# Device-resident mesh-parallel engine (the estimator growth path).
+# ---------------------------------------------------------------------------
+
+# inactive-row node id: far above any dense level's node range (depth <= 16
+# -> rel < 2^16) and never doubled (retired rows are WRITTEN the sentinel,
+# not routed), so it cannot overflow or collide across level blocks
+_SENTINEL = np.int32(1 << 20)
+
+
+def _p2floor(x: int) -> int:
+    """Largest power of two <= x (>= 1): node paddings and feature chunks
+    draw from this bucketed universe so kernel-geometry cache keys repeat
+    across levels, fits and datasets."""
+    return 1 << (max(1, int(x)).bit_length() - 1)
+
+
+def _level_block() -> int:
+    """Levels fused per engine dispatch (lax.scan)."""
+    return max(1, int(os.environ.get("SRML_FOREST_LEVEL_BLOCK", "4")))
+
+
+def _hist_budget_bytes() -> int:
+    """Per-chunk histogram buffer budget (MB) for the feature-chunked
+    split search."""
+    return int(os.environ.get("SRML_FOREST_HIST_MB", "256")) << 20
+
+
+def _feat_chunk(n_cols: int, combined: int, n_bins: int, s_dim: int) -> int:
+    """Power-of-two feature-chunk width keeping one (fc, S, combined*B)
+    histogram under the budget — bucketed (like the node counts) so the
+    executable-cache key universe stays small."""
+    fc = max(1, _hist_budget_bytes() // max(1, combined * n_bins * s_dim * 4))
+    return max(1, min(_p2floor(fc), _p2floor(n_cols)))
+
+
+def _forest_block_body(
+    Xb: jax.Array,       # (N_loc, D) binned rows (this shard's)
+    stats_t: jax.Array,  # (T, N_loc, S) bootstrap-weighted stats
+    rel: jax.Array,      # (T, N_loc) node-in-level ids; _SENTINEL = retired
     key: jax.Array,
-    n_nodes: int,
+    *,
+    l0: int,
+    block: int,
+    n_nodes_pad: int,
+    max_depth: int,
     n_bins: int,
     feat_batch: int,
     kind: str,
     max_features: int,
     min_samples_leaf: float,
     min_impurity_decrease: float,
+    axis_name: Optional[str] = None,
 ):
-    """One growth level for ALL trees at once: the (tree, node) pair is a
-    single combined node axis of size T*n_nodes, so the whole forest's
-    histograms are one segment_sum pass per feature and the host loop runs
-    max_depth iterations per FIT instead of per TREE (host round trips and
-    kernel dispatches dominated shallow-forest growth).
+    """`block` growth levels over (a shard of) the rows: per level one
+    feature-chunked histogram pass — psum-combined across shards when
+    `axis_name` binds a mesh axis — then replicated split selection and
+    local row routing, under ONE lax.scan.  Every level in the block runs
+    at the block's padded node count n_nodes_pad = 2^(top level); node
+    slots a shallower level does not populate carry zero stats and gate
+    themselves off through _split_ok, so their outputs are the dense
+    layout's leaf defaults."""
+    T, n_loc = rel.shape
+    S = stats_t.shape[2]
+    combined = T * n_nodes_pad
+    stats_flat = stats_t.reshape(T * n_loc, S).T  # (S, T*N_loc), S-leading
+    tree_base = (jnp.arange(T, dtype=jnp.int32) * n_nodes_pad)[:, None]
+    combine = None
+    if axis_name is not None:
+        from ..parallel.exchange import psum_parts
 
-    Returns the level_split_kernel tuple with a leading (T,) axis."""
-    T, N = rel_node.shape
-    S = stats.shape[2]
-    combined = T * n_nodes
-    active = rel_node < n_nodes
-    tree_base = (jnp.arange(T, dtype=jnp.int32) * n_nodes)[:, None]
-    rel_c = jnp.where(active, rel_node + tree_base, combined).reshape(-1)
-    # (S, T*N) scalar stat rows (S-leading: see _impurity_s0)
-    stats_s = jnp.where(
-        active.reshape(-1)[None, :], stats.reshape(T * N, S).T, 0.0
-    )
-    base_ids = jnp.where(rel_c < combined, rel_c, 0) * n_bins
-    out = _wide_split_search(
-        Xb, stats_s, base_ids, T, combined, key, n_bins, feat_batch, kind,
-        max_features, min_samples_leaf, min_impurity_decrease,
-    )
-    rs = lambda x: x.reshape(T, n_nodes, *x.shape[1:])
-    return tuple(rs(o) for o in out)
+        combine = lambda h: psum_parts(h, axis_name)  # noqa: E731
 
-@jax.jit
-def forest_route_kernel(Xb, rel_node, abs_node, best_feature, best_bin, split_ok):
-    """route_rows_kernel over the tree axis (shared Xb)."""
-    return jax.vmap(
-        lambda r, a, bf, bb, ok: route_rows_kernel(Xb, r, a, bf, bb, ok),
-    )(rel_node, abs_node, best_feature, best_bin, split_ok)
+    def level_step(rel_l, li):
+        active = rel_l < _SENTINEL
+        rel_c = jnp.where(active, rel_l + tree_base, combined).reshape(-1)
+        stats_m = jnp.where(active.reshape(-1)[None, :], stats_flat, 0.0)
+        base_ids = jnp.where(rel_c < combined, rel_c, 0) * n_bins
+        kl = jax.random.fold_in(key, li)
+        bf, bb, ok, p_w, p_imp, p_val = _wide_split_search(
+            Xb, stats_m, base_ids, T, combined, kl, n_bins, feat_batch,
+            kind, max_features, min_samples_leaf, min_impurity_decrease,
+            combine_hist=combine,
+        )
+        rs = lambda x: x.reshape(T, n_nodes_pad, *x.shape[1:])  # noqa: E731
+        bf_t, bb_t, pw_t, pi_t, pv_t = rs(bf), rs(bb), rs(p_w), rs(p_imp), rs(p_val)
+        # the forest's last level never splits (its nodes are the leaves)
+        ok_t = rs(ok) & (li < max_depth)
+        # route local rows; rows on leaf (or depth-capped) nodes retire
+        safe = jnp.where(active, rel_l, 0)
+        f_r = jnp.take_along_axis(bf_t, safe, axis=1)
+        b_r = jnp.take_along_axis(bb_t, safe, axis=1)
+        ok_r = jnp.take_along_axis(ok_t, safe, axis=1) & active
+        row_bin = jax.vmap(
+            lambda f: jnp.take_along_axis(
+                Xb, f[:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+        )(f_r)
+        go = (row_bin > b_r).astype(jnp.int32)
+        new_rel = jnp.where(ok_r, 2 * rel_l + go, _SENTINEL)
+        return new_rel, (bf_t, bb_t, ok_t, pw_t, pi_t, pv_t, ok_t.any())
+
+    return jax.lax.scan(
+        level_step, rel, l0 + jnp.arange(block, dtype=jnp.int32)
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "l0", "block", "n_nodes_pad", "max_depth", "n_bins", "feat_batch",
+        "kind", "max_features", "min_samples_leaf", "min_impurity_decrease",
+        "mesh",
+    ),
+)
+def _forest_block_kernel(
+    Xb: jax.Array,
+    stats_t: jax.Array,
+    rel: jax.Array,
+    feature: jax.Array,     # (T, M) int32 dense tree buffers (device)
+    threshold: jax.Array,   # (T, M) f32
+    leaf_value: jax.Array,  # (T, M, V) f32
+    counts: jax.Array,      # (T, M) f32 weighted sample counts
+    impurity: jax.Array,    # (T, M) f32
+    edges_dev: jax.Array,   # (D, B-1) f32 raw-space bin edges
+    key: jax.Array,
+    l0: int,
+    block: int,
+    n_nodes_pad: int,
+    max_depth: int,
+    n_bins: int,
+    feat_batch: int,
+    kind: str,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+    mesh=None,
+):
+    """One engine dispatch: `block` scan-batched levels (mesh-parallel via
+    shard_map when `mesh` is given, plain GSPMD otherwise) PLUS the dense
+    tree-buffer writes — split features, raw-space thresholds (the on-device
+    edges gather that used to be a per-level host write), leaf values and
+    export stats all land in the (T, M) device buffers, so the host only
+    ever reads the per-level any-split flags until the final single fetch."""
+    body = partial(
+        _forest_block_body,
+        l0=l0, block=block, n_nodes_pad=n_nodes_pad, max_depth=max_depth,
+        n_bins=n_bins, feat_batch=feat_batch, kind=kind,
+        max_features=max_features, min_samples_leaf=min_samples_leaf,
+        min_impurity_decrease=min_impurity_decrease,
+    )
+    if mesh is not None:
+        from ..compat import shard_map
+
+        rel, outs = shard_map(
+            partial(body, axis_name=DATA_AXIS),
+            mesh=mesh,
+            in_specs=(
+                PSpec(DATA_AXIS, None),        # Xb rows
+                PSpec(None, DATA_AXIS, None),  # stats rows
+                PSpec(None, DATA_AXIS),        # routing state rows
+                PSpec(),                       # key (replicated)
+            ),
+            out_specs=(PSpec(None, DATA_AXIS), PSpec()),
+            check_vma=False,
+        )(Xb, stats_t, rel, key)
+    else:
+        rel, outs = body(Xb, stats_t, rel, key)
+    bf_s, bb_s, ok_s, pw_s, pi_s, pv_s, flags = outs
+    D = Xb.shape[1]
+    e_cols = edges_dev.shape[1]
+    for i, level in enumerate(range(l0, l0 + block)):
+        n_nodes = 2**level
+        sl = slice(n_nodes - 1, 2 * n_nodes - 1)
+        bf_i = bf_s[i, :, :n_nodes]
+        bb_i = bb_s[i, :, :n_nodes]
+        ok_i = ok_s[i, :, :n_nodes]
+        feature = feature.at[:, sl].set(jnp.where(ok_i, bf_i, -1))
+        thr = jnp.where(
+            ok_i,
+            edges_dev[jnp.clip(bf_i, 0, D - 1), jnp.clip(bb_i, 0, e_cols - 1)],
+            0.0,
+        )
+        threshold = threshold.at[:, sl].set(thr.astype(threshold.dtype))
+        leaf_value = leaf_value.at[:, sl].set(
+            pv_s[i, :, :n_nodes].astype(leaf_value.dtype)
+        )
+        counts = counts.at[:, sl].set(
+            pw_s[i, :, :n_nodes].astype(counts.dtype)
+        )
+        impurity = impurity.at[:, sl].set(
+            pi_s[i, :, :n_nodes].astype(impurity.dtype)
+        )
+    return feature, threshold, leaf_value, counts, impurity, rel, flags
+
+
+@partial(jax.jit, static_argnames=("T", "N", "mesh"))
+def _init_rel(T: int, N: int, mesh=None):
+    """Root routing state, created ON DEVICE (an (T, N) host upload per fit
+    would ride the congested link) with the engine's canonical row sharding
+    so AOT executables lowered from warmed avals accept it."""
+    z = jnp.zeros((T, N), jnp.int32)
+    if mesh is not None:
+        z = jax.lax.with_sharding_constraint(z, axis_sharding(mesh, 1, 2))
+    return z
+
+
+@partial(jax.jit, static_argnames=("T", "M", "V", "mesh"))
+def _init_tree_buffers(T: int, M: int, V: int, mesh=None):
+    """Dense (T, M) device tree buffers at their leaf defaults, replicated
+    across the mesh (split selection is replicated, so every device writes
+    the same values)."""
+    bufs = (
+        jnp.full((T, M), -1, jnp.int32),
+        jnp.zeros((T, M), jnp.float32),
+        jnp.zeros((T, M, V), jnp.float32),
+        jnp.zeros((T, M), jnp.float32),
+        jnp.zeros((T, M), jnp.float32),
+    )
+    if mesh is not None:
+        rep = replicated_sharding(mesh)
+        bufs = tuple(jax.lax.with_sharding_constraint(b, rep) for b in bufs)
+    return bufs
+
+
+def _engine_blocks(max_depth: int):
+    """(l0, block, n_nodes_pad) per engine dispatch: levels grouped in
+    SRML_FOREST_LEVEL_BLOCK runs, each padded to its top level's node
+    count (power of two by construction)."""
+    lb = _level_block()
+    out = []
+    for l0 in range(0, max_depth + 1, lb):
+        l1 = min(l0 + lb, max_depth + 1)
+        out.append((l0, l1 - l0, 2 ** (l1 - 1)))
+    return out
+
+
+def warm_forest_kernels(
+    n_rows: int,
+    n_cols: int,
+    n_trees: int,
+    s_dim: int,
+    *,
+    max_depth: int,
+    n_bins: int,
+    kind: str,
+    max_features: int,
+    min_samples_leaf: float,
+    min_impurity_decrease: float,
+    mesh=None,
+    dtype=np.float32,
+) -> list:
+    """Submit ahead-of-time compilations for every level-block kernel a
+    grow_forest at this geometry will dispatch, so XLA compiles on the
+    precompile worker pool WHILE the caller bins features and builds
+    per-tree stats (rf_clf's 50 s cold start was almost entirely serial
+    level-kernel compiles).  Keys and statics are derived exactly like the
+    dispatch path's, and the avals carry the engine's canonical shardings,
+    so the first dispatch lands on the warmed executables.  Returns the
+    submitted keys (empty when warming is unsound, e.g. multi-process fits
+    or rows not padded to the mesh)."""
+    from .precompile import global_precompiler, kernel_cache_key
+
+    if jax.process_count() > 1:
+        return []
+    mesh = mesh or get_mesh(1)
+    if int(n_rows) % max(1, mesh.devices.size):
+        return []
+    T, N, S, D = int(n_trees), int(n_rows), int(s_dim), int(n_cols)
+    V = 1 if kind == "regression" else S
+    M = 2 ** (max_depth + 1) - 1
+    bins_dt = jnp.int8 if n_bins - 1 <= 127 else jnp.int32
+    rep = replicated_sharding(mesh)
+    sds = jax.ShapeDtypeStruct
+    avals = (
+        sds((N, D), bins_dt, sharding=axis_sharding(mesh, 0, 2)),
+        sds((T, N, S), jnp.dtype(dtype), sharding=axis_sharding(mesh, 1, 3)),
+        sds((T, N), jnp.int32, sharding=axis_sharding(mesh, 1, 2)),
+        sds((T, M), jnp.int32, sharding=rep),
+        sds((T, M), jnp.float32, sharding=rep),
+        sds((T, M, V), jnp.float32, sharding=rep),
+        sds((T, M), jnp.float32, sharding=rep),
+        sds((T, M), jnp.float32, sharding=rep),
+        sds((D, n_bins - 1), jnp.float32, sharding=rep),
+        sds((2,), jnp.uint32, sharding=rep),
+    )
+    pc = global_precompiler()
+    keys = []
+    for l0, block, npad in _engine_blocks(max_depth):
+        statics = dict(
+            l0=l0, block=block, n_nodes_pad=npad, max_depth=max_depth,
+            n_bins=n_bins, feat_batch=_feat_chunk(D, T * npad, n_bins, S),
+            kind=kind, max_features=int(max_features),
+            min_samples_leaf=float(min_samples_leaf),
+            min_impurity_decrease=float(min_impurity_decrease),
+        )
+        ck = kernel_cache_key("forest_level_block", avals, mesh, statics)
+        pc.submit(ck, _forest_block_kernel, *avals, mesh=mesh, **statics)
+        keys.append(ck)
+    return keys
 
 
 def grow_forest(
@@ -576,68 +898,142 @@ def grow_forest(
     min_samples_leaf: float,
     min_impurity_decrease: float,
     seed: int,
+    mesh=None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Grow ALL trees level-by-level in lock-step (host loop = max_depth+1
-    jitted forest-level kernels).  Returns stacked host arrays
-    (features (T, M), thresholds, leaf_values (T, M, V), n_samples,
-    impurities) in the same dense-tree layout as grow_tree.
+    """Grow ALL trees as a device-resident, mesh-parallel, scan-batched
+    engine: ceil((max_depth+1) / SRML_FOREST_LEVEL_BLOCK) level-block
+    dispatches (forest.levels.dispatches), each through the AOT executable
+    cache; per block the host reads ONLY the (block,)-bool any-split flags
+    (forest.level_syncs — the on-device early-stop mask), and the fitted
+    forest crosses the host link in ONE device_get at the end
+    (forest.d2h_transfers).  Returns stacked host arrays (features (T, M),
+    thresholds, leaf_values (T, M, V), n_samples, impurities) in the same
+    dense-tree layout as grow_tree.
 
-    Falls back to per-tree grow_tree when the per-node feature-subset score
-    buffer would be too large (max_features < D with a very wide D)."""
-    from .precompile import initialize_persistent_cache
+    `mesh` shards the histogram work: rows of Xb/stats_t/rel ride
+    DATA_AXIS, each device accumulates its shard's histograms and one psum
+    per level chunk combines them (parallel/exchange.psum_parts).
+    Multi-process fits (jax.process_count() > 1) run the identical math
+    through plain GSPMD lowering instead of explicit shard_map — see
+    docs/forest_engine.md for the determinism contract."""
+    from .precompile import (
+        global_precompiler,
+        initialize_persistent_cache,
+        kernel_cache_key,
+    )
 
-    # opt-in on-disk executable cache (SRML_COMPILE_CACHE): the level
-    # kernels are shape-keyed per (depth, class-count, chunk) geometry —
-    # the forest arms' dominant cold cost — and a warm disk cache turns a
-    # cold process's compiles into deserializes
+    # opt-in on-disk executable cache (SRML_COMPILE_CACHE): block kernels
+    # are shape-keyed per power-of-two geometry — the forest arms' dominant
+    # cold cost — and a warm disk cache turns a cold process's compiles
+    # into deserializes
     initialize_persistent_cache()
     T, N, S = stats_t.shape
     D = Xb.shape[1]
     V = 1 if kind == "regression" else S
     M = 2 ** (max_depth + 1) - 1
+    # the fixed retired-row sentinel must stay above every live node id
+    # (rel < 2^(depth+1) after the deepest routing step) or deep rows would
+    # silently read as retired — refuse loudly instead (the estimator's
+    # _MAX_SUPPORTED_DEPTH = 16 gate keeps real fits far below this)
+    assert 2 ** (max_depth + 1) < int(_SENTINEL), (
+        f"max_depth={max_depth} exceeds the engine's sentinel headroom"
+    )
+    single_ctrl = jax.process_count() == 1
+    if mesh is None and single_ctrl:
+        mesh = get_mesh(1)
+    smesh = mesh if single_ctrl else None
+    if smesh is not None:
+        assert N % max(1, smesh.devices.size) == 0, (
+            "rows must be padded to a multiple of the mesh size"
+        )
+        # canonical input shardings: repeat fits and warmed avals must
+        # present the block kernels identical placements (no-op device_put
+        # when the arrays already arrive row-sharded from binning)
+        Xb = jax.device_put(Xb, axis_sharding(smesh, 0, 2))
+        stats_t = jax.device_put(stats_t, axis_sharding(smesh, 1, 3))
+        rep = replicated_sharding(smesh)
+        edges_dev = jax.device_put(np.asarray(edges, np.float32), rep)
+        key = jax.device_put(jax.random.PRNGKey(seed), rep)
+    else:
+        edges_dev = jnp.asarray(np.asarray(edges, np.float32))
+        key = jax.random.PRNGKey(seed)
+    rel = _init_rel(T=T, N=N, mesh=smesh)
+    bufs = _init_tree_buffers(T=T, M=M, V=V, mesh=smesh)
+    args = [Xb, stats_t, rel, *bufs, edges_dev, key]
+    blocks = _engine_blocks(max_depth)
+    pc = global_precompiler()
+    plan = []
+    for l0, block, npad in blocks:
+        statics = dict(
+            l0=l0, block=block, n_nodes_pad=npad, max_depth=max_depth,
+            n_bins=n_bins, feat_batch=_feat_chunk(D, T * npad, n_bins, S),
+            kind=kind, max_features=int(max_features),
+            min_samples_leaf=float(min_samples_leaf),
+            min_impurity_decrease=float(min_impurity_decrease),
+        )
+        ck = kernel_cache_key(
+            "forest_level_block", tuple(args), smesh, statics
+        )
+        plan.append((ck, statics))
+        # parallel AOT compilation of every block from fit entry (sum of
+        # compiles -> max); dedups against warm_forest_kernels' submits
+        avals = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+            for a in args
+        )
+        pc.submit(ck, _forest_block_kernel, *avals, mesh=smesh, **statics)
+
+    top = max_depth
+    for (ck, statics), (l0, block, npad) in zip(plan, blocks):
+        with profiling.phase("forest.hist"):
+            out = pc.cached_call(
+                ck, _forest_block_kernel, *args, mesh=smesh, **statics
+            )
+        args[2:8] = [out[5], *out[:5]]
+        flags = out[6]
+        profiling.incr_counter("forest.levels.dispatches")
+        profiling.record_event("forest.level_block", l0=l0, block=block)
+        with profiling.phase("forest.route"):
+            # graftlint: disable=R1 (one tiny early-stop flag read per level BLOCK — the collapsed remnant of the old per-level six-array sync)
+            flags_h = np.asarray(jax.device_get(flags)).tolist()
+        profiling.incr_counter("forest.level_syncs")
+        stopped = False
+        for i, any_split in enumerate(flags_h):
+            if not any_split:
+                top = l0 + i
+                stopped = True
+                break
+        if stopped:
+            break
+
+    feature_d, threshold_d, leaf_d, nsamp_d, imp_d = args[3:8]
+    M_used = 2 ** (top + 1) - 1
+    with profiling.phase("forest.split"):
+        # ONE transfer for the whole forest (sliced to the levels actually
+        # grown); the per-level device_get round-trips this engine replaces
+        # dominated steady-state growth through a tunneled host link
+        f_h, t_h, v_h, n_h, i_h = jax.device_get(
+            (
+                feature_d[:, :M_used],
+                threshold_d[:, :M_used],
+                leaf_d[:, :M_used],
+                nsamp_d[:, :M_used],
+                imp_d[:, :M_used],
+            )
+        )
+    profiling.incr_counter("forest.d2h_transfers")
+    if M_used == M:
+        return f_h, t_h, v_h, n_h, i_h
     feature = np.full((T, M), -1, np.int32)
     threshold = np.zeros((T, M), np.float32)
     leaf_value = np.zeros((T, M, V), np.float32)
     n_samples = np.zeros((T, M), np.float32)
     impurity = np.zeros((T, M), np.float32)
-
-    rel = jnp.zeros((T, N), jnp.int32)
-    abs_node = jnp.zeros((T, N), jnp.int32)
-    key = jax.random.PRNGKey(seed)
-    for level in range(max_depth + 1):
-        n_nodes = 2**level
-        combined = T * n_nodes
-        key, kl = jax.random.split(key)
-        fc = max(1, (256 << 20) // (combined * n_bins * S * 4))
-        fc = min(D, 1 << (fc.bit_length() - 1))
-        bf, bb, ok, cnt, imp, val = forest_level_kernel(
-            Xb, stats_t, rel, kl,
-            n_nodes=n_nodes, n_bins=n_bins, feat_batch=fc, kind=kind,
-            max_features=max_features, min_samples_leaf=min_samples_leaf,
-            min_impurity_decrease=min_impurity_decrease,
-        )
-        if level == max_depth:
-            ok = jnp.zeros_like(ok)
-        # graftlint: disable=R1 (per-LEVEL batched fetch: the host tree builder consumes each level before growing the next)
-        bf_h, bb_h, ok_h, cnt_h, imp_h, val_h = jax.device_get(
-            (bf, bb, ok, cnt, imp, val)
-        )
-        base = 2**level - 1
-        sl = slice(base, base + n_nodes)
-        n_samples[:, sl] = cnt_h
-        impurity[:, sl] = imp_h
-        leaf_value[:, sl] = val_h
-        feature[:, sl] = np.where(ok_h, bf_h, -1)
-        threshold[:, sl] = np.where(
-            ok_h,
-            edges[
-                np.minimum(bf_h, D - 1), np.minimum(bb_h, edges.shape[1] - 1)
-            ],
-            0.0,
-        )
-        if not ok_h.any() or level == max_depth:
-            break
-        rel, abs_node = forest_route_kernel(Xb, rel, abs_node, bf, bb, ok)
+    feature[:, :M_used] = f_h
+    threshold[:, :M_used] = t_h
+    leaf_value[:, :M_used] = v_h
+    n_samples[:, :M_used] = n_h
+    impurity[:, :M_used] = i_h
     return feature, threshold, leaf_value, n_samples, impurity
 
 

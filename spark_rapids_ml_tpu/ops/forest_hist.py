@@ -286,6 +286,61 @@ def node_histograms_bucketed(
     )(bins_sub, node_rel, stats_s)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "t_pack", "nodes", "s_dim", "n_bins", "interpret"),
+)
+def node_histograms_sharded(
+    bins_sub: jax.Array,  # (F_pad, N_pad) int8 subset rows, row-sharded
+    node_rel: jax.Array,  # (T_pack, N_pad) int32 node-in-level ids
+    stats_s: jax.Array,   # (T_pack * S, N_pad) f32 weighted stat rows
+    mesh,
+    t_pack: int,
+    nodes: int,
+    s_dim: int,
+    n_bins: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """The MXU one-hot histogram kernel's SHARDING RULE: shard the row axis
+    over DATA_AXIS (shard_map), run node_histograms on each device's local
+    row tile, and combine the per-shard partial histograms with ONE psum
+    (parallel/exchange.psum_parts) — the same partial-sums-then-all-reduce
+    shape the scatter engine (ops/forest._forest_block_kernel) uses, so a
+    multi-chip fit can keep the MXU path instead of falling back.  Each
+    shard's row count must stay a multiple of _ROW_TILE, i.e. N_pad must be
+    a multiple of n_devices * _ROW_TILE.  Returns the REPLICATED
+    (F_pad, M_SLOTS, B) histogram."""
+    from ..compat import shard_map
+    from ..parallel.exchange import psum_parts
+    from ..parallel.mesh import DATA_AXIS
+    from jax.sharding import PartitionSpec as PSpec
+
+    n_dev = mesh.devices.size
+    n_pad = bins_sub.shape[1]
+    assert n_pad % (n_dev * _ROW_TILE) == 0, (
+        "pad rows to n_devices * _ROW_TILE for the sharded histogram rule"
+    )
+
+    def body(b_loc, nr_loc, st_loc):
+        H = node_histograms(
+            b_loc, nr_loc, st_loc, t_pack=t_pack, nodes=nodes, s_dim=s_dim,
+            n_bins=n_bins, interpret=interpret,
+        )
+        return psum_parts(H, DATA_AXIS)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            PSpec(None, DATA_AXIS),
+            PSpec(None, DATA_AXIS),
+            PSpec(None, DATA_AXIS),
+        ),
+        out_specs=PSpec(),
+        check_vma=False,
+    )(bins_sub, node_rel, stats_s)
+
+
 def node_histograms_reference(
     bins_sub: np.ndarray,
     node_rel: np.ndarray,

@@ -36,6 +36,12 @@
 # The returned dense tree arrays are identical in layout to grow_forest's,
 # so models/random_forest.py consumes either builder interchangeably.
 #
+# Sharding: the histogram kernel's mesh rule lives in
+# forest_hist.node_histograms_sharded (per-shard pallas pass + one psum);
+# this BUILDER still drives a single chip end-to-end (the deep phase's
+# payload sort is not sharded yet), so multi-device fits run the
+# mesh-parallel scatter engine (ops/forest.grow_forest) instead.
+#
 
 from __future__ import annotations
 
@@ -47,6 +53,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+# _p2floor: deep-phase window sizes come from the engine's shared
+# power-of-two bucketing so kernel-geometry keys draw from a small,
+# dataset-independent universe the persistent compile cache can accumulate
+from .forest import _p2floor
 from .forest_hist import (
     M_SLOTS,
     _F_BLOCK,
@@ -437,13 +447,6 @@ def _build_class(
     y_c = slice_row(y_sorted).reshape(-1)
     rel_c = jnp.where(in_seg, 0, _STRAY).astype(jnp.int32).reshape(-1)
     return sub_c, w_c, y_c, rel_c
-
-
-def _p2floor(x: int) -> int:
-    """Largest power of two <= x (>=1): deep-phase window sizes come from
-    this so kernel-geometry keys draw from a small, dataset-independent
-    universe the persistent compile cache can accumulate."""
-    return 1 << (max(1, int(x)).bit_length() - 1)
 
 
 def _nseg_chunk(n_seg: int, local: int, s_dim: int, f_pad: int, n_bins: int) -> int:

@@ -174,15 +174,31 @@ class Precompiler:
             self._workers.append(t)
 
     def _worker(self):
+        import contextlib
         import os
         import time
+
+        from ..compat import enable_x64
 
         trace = os.environ.get("SRML_PRECOMPILE_LOG") == "1"
         while True:
             job, fn, avals, static_kwargs = self._q.get()
             try:
                 t0 = time.perf_counter() if trace else 0.0
-                job.result = fn.lower(*avals, **static_kwargs).compile()
+                # x64 is a THREAD-LOCAL scope: a float64 fit submits 64-bit
+                # avals from inside its enable_x64 context, but this worker
+                # thread is outside it — lowering here would silently
+                # canonicalize the avals to 32-bit and build an executable
+                # that rejects the fit's actual arguments.  Re-enter the
+                # scope whenever the avals carry 8-byte dtypes.
+                wide = any(
+                    jnp.dtype(a.dtype).itemsize == 8
+                    for a in jax.tree_util.tree_leaves(avals)
+                    if hasattr(a, "dtype")
+                )
+                ctx = enable_x64(True) if wide else contextlib.nullcontext()
+                with ctx:
+                    job.result = fn.lower(*avals, **static_kwargs).compile()
                 profiling.incr_counter("precompile.compile")
                 if trace:
                     logger.warning(
@@ -213,6 +229,21 @@ class Precompiler:
                     break
                 del self._jobs[stale]
         self._q.put((job, fn, avals, static_kwargs))
+
+    def wait(self, keys) -> None:
+        """Block until every submitted key in `keys` has finished compiling
+        (compile FAILURES are swallowed — the dispatch path's jit fallback
+        owns them).  Lets warm-path callers (and the zero-recompile tests)
+        draw a line between 'warm compiles in flight' and 'steady state'."""
+        for key in keys:
+            with self._lock:
+                job = self._jobs.get(key)
+            if job is None:
+                continue
+            try:
+                job.wait()
+            except Exception:  # noqa: BLE001 - surfaced at dispatch instead
+                pass
 
     def cached_call(self, key: Hashable, fn, *args, **static_kwargs):
         """Executable-cache dispatch: run `fn` through the AOT executable for
@@ -273,7 +304,13 @@ class Precompiler:
             # they must surface at their true site.
             msg = str(exc).lower()
             if any(
-                s in msg for s in ("sharding", "placement", "compiled for input")
+                s in msg
+                for s in (
+                    "sharding",
+                    "placement",
+                    "compiled for input",
+                    "types differ",  # aval/dtype drift (e.g. x64-scope skew)
+                )
             ):
                 logger.warning(
                     "AOT executable for %r rejected its inputs (%s); "

@@ -153,6 +153,21 @@ def allgather_rows(x, axis_name: str = None):
     return jax.lax.all_gather(x, axis_name or DATA_AXIS, axis=0, tiled=True)
 
 
+def psum_parts(x, axis_name: str = None):
+    """Element-wise sum of per-device partial arrays (lax.psum) — the
+    "partial result per shard -> full result everywhere" reduction shape of
+    the forest engine's histogram combine: each device builds per-node
+    histograms over ITS row shard and one psum per level yields the global
+    histograms replicated on every device (ops/forest._forest_block_kernel,
+    ops/forest_hist.node_histograms_sharded).  Call ONLY inside a shard_map
+    body bound over `axis_name`."""
+    import jax
+
+    from .mesh import DATA_AXIS
+
+    return jax.lax.psum(x, axis_name or DATA_AXIS)
+
+
 def alltoall_bytes(
     cp: Any,
     rank: int,

@@ -61,6 +61,17 @@ def col_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
 
 
+def axis_sharding(mesh: Mesh, axis: int, ndim: int) -> NamedSharding:
+    """Shard one axis of an `ndim`-rank array over the data axis (the
+    generic form of data_sharding/col_sharding).  The forest engine shards
+    its (T, N) routing state and (T, N, S) per-tree stats on the ROW axis
+    (axis=1) so every per-shard histogram pass sees row-aligned slices of
+    bins, stats and node ids."""
+    spec = [None] * ndim
+    spec[axis] = DATA_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

@@ -56,9 +56,14 @@ def reset_phase_times() -> None:
     _registry().clear()
 
 
-def phase_times() -> Dict[str, float]:
-    """Seconds per named phase recorded on this thread since the last reset."""
-    return dict(_registry())
+def phase_times(prefix: str = "") -> Dict[str, float]:
+    """Seconds per named phase recorded on this thread since the last reset
+    (optionally filtered by name prefix — the benchmark idiom for reporting
+    one subsystem's phase set, e.g. "forest." or "knn.")."""
+    reg = _registry()
+    if not prefix:
+        return dict(reg)
+    return {k: v for k, v in reg.items() if k.startswith(prefix)}
 
 
 # -- process-wide counters ---------------------------------------------------

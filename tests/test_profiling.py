@@ -42,6 +42,32 @@ def test_fit_records_phase_times():
     assert times["srml.fit"] > 0.0
 
 
+def test_forest_fit_records_phase_set():
+    """The forest engine's phase timers mirror the knn.*/umap.* sets:
+    forest.bin (edges + binning), forest.hist (level-block dispatches),
+    forest.route (per-block early-stop flag syncs — where each block's
+    routing state resolves), forest.split (the single forest fetch)."""
+    from spark_rapids_ml_tpu import RandomForestRegressor
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((256, 6))
+    y = X @ np.ones(6) + 0.1 * rng.standard_normal(256)
+    df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    est = RandomForestRegressor(numTrees=3, maxDepth=3, maxBins=8, seed=1)
+    est.fit(df)
+    times = est._last_fit_phase_times
+    for name in ("forest.bin", "forest.hist", "forest.route", "forest.split"):
+        assert name in times and times[name] >= 0.0, (name, times)
+    # phase_times prefix filtering (the benchmark reporting idiom)
+    profiling.reset_phase_times()
+    with profiling.phase("forest.bin"):
+        pass
+    with profiling.phase("other.x"):
+        pass
+    assert set(profiling.phase_times("forest.")) == {"forest.bin"}
+
+
 def test_maybe_trace_writes_profile(tmp_path, monkeypatch):
     # opt-in whole-fit xprof capture via SRML_PROFILE (NCCL_DEBUG analog)
     monkeypatch.setenv(profiling.PROFILE_ENV, str(tmp_path))
