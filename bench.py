@@ -489,10 +489,19 @@ ARM_NOTES = {
 }
 
 
+# Per-arm minimum timed repeats: the kNN arm's short timed region (two
+# dispatch blocks) showed a 31.4% max-min spread at 3 repeats under tunnel
+# congestion (BENCH_r05) — more samples tighten the median without touching
+# the timed region itself.  Applied as a floor so SRML_BENCH_REPEATS can
+# still raise everything globally.
+ARM_MIN_REPEATS = {"knn": 7}
+
+
 def run_arm(algo: str, overrides, repeats: int):
     """Build, warm up, and time one arm; returns its stats dict.  cold_sec
     records the first (warmup) call — compiles + device staging included —
     so the first-fit experience is a captured artifact, not a claim."""
+    repeats = max(repeats, ARM_MIN_REPEATS.get(algo, 1))
     fit, label, rows = build_arm(algo, overrides)
     cold, times = _timed_repeats(fit, repeats)
     med, best = statistics.median(times), min(times)
@@ -507,6 +516,7 @@ def run_arm(algo: str, overrides, repeats: int):
         "spread_pct": round(100.0 * (max(times) - best) / med, 1),
         "times_sec": [round(t, 3) for t in times],
         "cold_sec": round(cold, 3),
+        "repeats": repeats,  # can exceed the global knob (ARM_MIN_REPEATS)
     }
     if algo in ARM_NOTES:
         out["notes"] = ARM_NOTES[algo]

@@ -1,0 +1,220 @@
+#
+# Open-loop load generator for the srml-serve subsystem (docs/serving.md).
+#
+# Open-loop means arrivals follow a fixed schedule regardless of completions
+# (the standard way to measure tail latency — a closed loop self-throttles
+# and hides queueing collapse).  For each (model, offered rate) point the
+# generator submits single-row / small-batch requests on the schedule,
+# drains, and reports achieved throughput plus p50/p95/p99 request latency
+# (profiling.percentiles over the engine's per-request samples), reject and
+# timeout counts, mean batch occupancy, and the steady-state compile count
+# (asserted zero unless --no_assert_steady).  Sweeping --rates yields the
+# throughput-vs-p99 curve; past the saturation rate the bounded queue turns
+# overload into fast rejections instead of unbounded latency, which the
+# reject column makes visible.
+#
+# Usage (CPU smoke, the ci/test.sh step-3e shape):
+#   python -m benchmark.bench_serving --models kmeans,linreg \
+#       --rates 50,200 --duration 2 --report_path /tmp/serving.jsonl
+#
+# Models are fit in-process on synthetic data sized by --fit_rows/--num_cols
+# (serving measures the REQUEST path; fit cost is reported separately as
+# setup_fit_sec).
+#
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from spark_rapids_ml_tpu import profiling
+from spark_rapids_ml_tpu.serving import ModelServer, ServerOverloaded
+
+from .utils import append_report
+
+SERVABLE = ("kmeans", "pca", "linreg", "logreg", "rf_clf", "rf_reg", "knn")
+
+
+def _fit_model(name: str, X: np.ndarray, y_reg: np.ndarray, y_clf: np.ndarray):
+    from spark_rapids_ml_tpu import (
+        KMeans,
+        LinearRegression,
+        LogisticRegression,
+        NearestNeighbors,
+        PCA,
+        RandomForestClassifier,
+        RandomForestRegressor,
+    )
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=2)
+    df_reg = DataFrame.from_numpy(X, y=y_reg, num_partitions=2)
+    df_clf = DataFrame.from_numpy(X, y=y_clf, num_partitions=2)
+    if name == "kmeans":
+        return KMeans(k=8, maxIter=5, seed=1).setFeaturesCol("features").fit(df)
+    if name == "pca":
+        return PCA(k=min(4, X.shape[1])).setInputCol("features").fit(df)
+    if name == "linreg":
+        return LinearRegression(maxIter=20).fit(df_reg)
+    if name == "logreg":
+        return LogisticRegression(maxIter=15).fit(df_clf)
+    if name == "rf_clf":
+        return RandomForestClassifier(
+            numTrees=8, maxDepth=5, maxBins=16, seed=1
+        ).fit(df_clf)
+    if name == "rf_reg":
+        return RandomForestRegressor(
+            numTrees=8, maxDepth=5, maxBins=16, seed=1
+        ).fit(df_reg)
+    if name == "knn":
+        return NearestNeighbors(k=8).setFeaturesCol("features").fit(df)
+    raise ValueError(f"unknown model {name!r}; choose from {SERVABLE}")
+
+
+def run_rate_point(
+    server: ModelServer,
+    X: np.ndarray,
+    rate: float,
+    duration_s: float,
+    rows_per_request: int,
+    timeout_ms: float,
+) -> Dict[str, Any]:
+    """One open-loop run at `rate` requests/sec for `duration_s`."""
+    name = server.name
+    profiling.reset_durations(f"serve.{name}.")
+    n_requests = max(1, int(rate * duration_s))
+    interarrival = 1.0 / rate
+    rng = np.random.default_rng(11)
+    idx = rng.integers(0, X.shape[0] - rows_per_request + 1, size=n_requests)
+    futures: List[Any] = []
+    rejected = late = 0
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        target = t0 + i * interarrival
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        elif now - target > interarrival:
+            late += 1  # generator itself fell behind (host too slow for rate)
+        req = X[idx[i] : idx[i] + rows_per_request]
+        try:
+            futures.append(
+                server.submit(req, timeout_ms=timeout_ms or None)
+            )
+        except ServerOverloaded:
+            rejected += 1
+    completed = timeouts = errors = 0
+    for f in futures:
+        try:
+            f.result(timeout=60.0)
+            completed += 1
+        except TimeoutError:
+            timeouts += 1
+        except Exception:
+            errors += 1
+    elapsed = time.perf_counter() - t0
+    lat = profiling.percentiles(f"serve.{name}.latency")
+    occ = profiling.percentiles(f"serve.{name}.occupancy")
+    return {
+        "model": name,
+        "offered_rps": round(rate, 1),
+        "duration_sec": round(elapsed, 3),
+        "requests": n_requests,
+        "completed": completed,
+        "rejected": rejected,
+        "timeouts": timeouts,
+        "errors": errors,
+        "late_arrivals": late,
+        "throughput_rps": round(completed / elapsed, 1),
+        "throughput_rows_sec": round(completed * rows_per_request / elapsed, 1),
+        "p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+        "p95_ms": round(lat.get("p95", 0.0) * 1e3, 3),
+        "p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+        "max_ms": round(lat.get("max", 0.0) * 1e3, 3),
+        "mean_batch_occupancy": round(occ.get("mean", 0.0), 2),
+        "steady_compiles": profiling.counter(f"serving.{name}.steady_compiles"),
+    }
+
+
+def main(argv: List[str] = None) -> None:
+    p = argparse.ArgumentParser(description="srml-serve open-loop load generator")
+    p.add_argument("--models", type=str, default="kmeans,linreg",
+                   help=f"comma list from {','.join(SERVABLE)}")
+    p.add_argument("--rates", type=str, default="50,200,400",
+                   help="offered request rates (req/s), one curve point each")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="seconds per rate point")
+    p.add_argument("--rows_per_request", type=int, default=1)
+    p.add_argument("--fit_rows", type=int, default=4096)
+    p.add_argument("--num_cols", type=int, default=16)
+    p.add_argument("--max_batch", type=int, default=256)
+    p.add_argument("--max_wait_ms", type=float, default=5.0)
+    p.add_argument("--queue_depth", type=int, default=4096)
+    p.add_argument("--timeout_ms", type=float, default=0.0,
+                   help="per-request deadline (0 = none)")
+    p.add_argument("--report_path", type=str, default="")
+    p.add_argument("--no_assert_steady", action="store_true",
+                   help="skip the zero-new-compiles steady-state assertion")
+    args = p.parse_args(argv)
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((args.fit_rows, args.num_cols)).astype(np.float32)
+    w = np.arange(1.0, args.num_cols + 1.0)
+    y_reg = (X @ w + 0.1 * rng.standard_normal(args.fit_rows)).astype(np.float64)
+    y_clf = (X @ w > 0).astype(np.float64)
+    rates = [float(r) for r in args.rates.split(",") if r]
+
+    header = (
+        f"{'model':<8} {'rps':>7} {'done':>6} {'rej':>5} {'t/o':>4} "
+        f"{'thru rps':>9} {'p50 ms':>8} {'p95 ms':>8} {'p99 ms':>8} "
+        f"{'occ':>5} {'compiles':>8}"
+    )
+    for model_name in [m for m in args.models.split(",") if m]:
+        t0 = time.perf_counter()
+        model = _fit_model(model_name, X, y_reg, y_clf)
+        fit_sec = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        server = ModelServer(
+            model_name,
+            model,
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth,
+        )
+        warm_sec = time.perf_counter() - t0
+        print(f"== {model_name}: fit {fit_sec:.1f}s, load+warm {warm_sec:.1f}s, "
+              f"buckets {server.buckets}")
+        print(header)
+        try:
+            for rate in rates:
+                rec = run_rate_point(
+                    server, X, rate, args.duration,
+                    args.rows_per_request, args.timeout_ms,
+                )
+                rec.update(
+                    setup_fit_sec=round(fit_sec, 2),
+                    warmup_sec=round(warm_sec, 2),
+                    rows_per_request=args.rows_per_request,
+                    max_batch=args.max_batch,
+                    max_wait_ms=args.max_wait_ms,
+                )
+                print(
+                    f"{rec['model']:<8} {rec['offered_rps']:>7} "
+                    f"{rec['completed']:>6} {rec['rejected']:>5} "
+                    f"{rec['timeouts']:>4} {rec['throughput_rps']:>9} "
+                    f"{rec['p50_ms']:>8} {rec['p95_ms']:>8} "
+                    f"{rec['p99_ms']:>8} {rec['mean_batch_occupancy']:>5} "
+                    f"{rec['steady_compiles']:>8}"
+                )
+                append_report(args.report_path, rec)
+            if not args.no_assert_steady:
+                server.assert_steady_state()
+        finally:
+            server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

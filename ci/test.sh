@@ -77,6 +77,40 @@ python -m tools.graftlint spark_rapids_ml_tpu/ops/forest.py \
     spark_rapids_ml_tpu/ops/forest_hist.py spark_rapids_ml_tpu/ops/forest_mxu.py \
     spark_rapids_ml_tpu/models/random_forest.py
 
+# 3e. focused gates for the srml-serve subsystem (also inside the full
+#     suite; re-asserted by name so marker drift can never silently drop
+#     them).  Runs on the 8-device CPU mesh, forced explicitly:
+#     - concurrent single-row clients coalesce into >1-request device
+#       batches (occupancy histogram + coalesced_batches counters)
+#     - steady state after bucket warmup performs ZERO new executable
+#       compilations (precompile compile/fallback counters frozen)
+#     - overload rejects fast with ServerOverloaded instead of blocking;
+#       queued-request deadlines expire with RequestTimeout
+#     - registry serves core.load'ed models with transform-equal outputs
+#     plus a graftlint-clean re-check of the serving modules by name, the
+#     save->load->transform persistence matrix the registry builds on, and
+#     an open-loop bench_serving smoke over two model types (throughput +
+#     p50/p95/p99 columns present, steady-state assertion on).
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_serving.py tests/test_persistence_matrix.py -q
+python -m tools.graftlint spark_rapids_ml_tpu/serving \
+    spark_rapids_ml_tpu/profiling.py benchmark/bench_serving.py
+SERVE_SMOKE=$(mktemp -d)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.bench_serving --models kmeans,linreg --rates 50,200 \
+    --duration 1.5 --fit_rows 1024 --num_cols 8 \
+    --report_path "$SERVE_SMOKE/serving.jsonl"
+test "$(wc -l < "$SERVE_SMOKE/serving.jsonl")" -eq 4
+python - "$SERVE_SMOKE/serving.jsonl" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1])]
+assert {r["model"] for r in recs} == {"kmeans", "linreg"}
+for r in recs:
+    assert r["steady_compiles"] == 0, r
+    assert all(k in r for k in ("throughput_rps", "p50_ms", "p95_ms", "p99_ms")), r
+EOF
+rm -rf "$SERVE_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

@@ -832,6 +832,19 @@ class _TpuModel(_TpuParams):
     def _get_tpu_transform_func(self, dataset: DataFrame) -> TransformFunc:
         raise NotImplementedError
 
+    # -- online serving -----------------------------------------------------
+    def _serving_entry(self, mesh: Any = None):
+        """ServingEntry for the online inference engine (serving/engine.py):
+        a padded-batch dispatch through the AOT executable cache plus a
+        bucket warm hook.  Served model classes override this; the base
+        raises so serving.ModelServer gives an actionable error for models
+        with no online path."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no serving entry; servable models "
+            "are KMeans/PCA/LinearRegression/LogisticRegression/"
+            "RandomForest*/NearestNeighbors"
+        )
+
     # -- multi-model -------------------------------------------------------
     @classmethod
     def _combine(cls, models: List["_TpuModel"]) -> "_TpuModel":

@@ -268,3 +268,23 @@ class KMeansModel(_KMeansParams, _TpuModelWithPredictionCol):
             return {pred_col: np.asarray(labels)}
 
         return _transform
+
+    def _serving_entry(self, mesh: Any = None):
+        """Online inference hook (serving/): nearest-center assignment as a
+        single bucket-padded kernel through the AOT executable cache."""
+        from ..serving.entry import kernel_entry
+
+        np_dtype = self._transform_dtype(self.dtype)
+        centers = jax.device_put(np.asarray(self.cluster_centers_, dtype=np_dtype))
+        pred_col = self.getOrDefault("predictionCol")
+        return kernel_entry(
+            "serve.kmeans",
+            jax.jit(kmeans_predict_kernel),
+            (centers,),
+            {},
+            lambda labels: {pred_col: np.asarray(labels)},
+            dtype=np_dtype,
+            n_cols=self.n_cols,
+            out_cols=[pred_col],
+            info={"k": len(self.cluster_centers_)},
+        )

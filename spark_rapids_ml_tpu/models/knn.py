@@ -271,50 +271,23 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         identity of the extracted feature array, with the host array
         pinned so the id cannot be recycled).  Larger-than-HBM item sets
         keep the uncached streaming path (knn_search_streamed)."""
-        from ..ops.knn import (
-            _hbm_budget_bytes,
-            knn_search_prepared,
-            knn_search_streamed,
-        )
-        from ..parallel.mesh import DATA_AXIS
+        from ..ops.knn import knn_search_prepared, knn_search_streamed
 
-        n_dev = mesh.shape[DATA_AXIS]
-        parts = [p for p in self._item_df.partitions if len(p)]
-        rows = sum(len(p) for p in parts)
-        dim = self._frame_dim(dtype)
-        in_core = (
-            dim is not None
-            and rows * dim * np.dtype(dtype).itemsize
-            <= _hbm_budget_bytes() * n_dev
+        prepared, leftover_blocks, _reason = self._stage_in_core_items(
+            id_col, dtype, mesh
         )
-        if not in_core:
-            self._staged_items = None
+        if prepared is None:
+            # degrade to the (uncached) streaming path, reusing any blocks
+            # the staging attempt already packed to device
             return knn_search_streamed(
-                self._iter_item_blocks(id_col, dtype, mesh),
+                leftover_blocks
+                if leftover_blocks is not None
+                else self._iter_item_blocks(id_col, dtype, mesh),
                 query_feats,
                 [len(p) for p in q_parts],
                 k,
                 mesh,
             )
-        key = self._staging_key(mesh, rows, dim)
-        if self._staged_items is None or self._staged_items[0] != key:
-            blocks = list(self._iter_item_blocks(id_col, dtype, mesh))
-            if len(blocks) != 1:
-                # the packer's n_dev-rounded per-block row bound can split
-                # right at the HBM-budget boundary even though the estimate
-                # above said in-core — degrade to the streaming path
-                # (uncached) instead of asserting
-                self._staged_items = None
-                return knn_search_streamed(
-                    iter(blocks),
-                    query_feats,
-                    [len(p) for p in q_parts],
-                    k,
-                    mesh,
-                )
-            self._staged_items = (key, blocks[0])
-            self._staged_queries.clear()
-        prepared = self._staged_items[1]
         # AOT-warm the query kernels for the largest partition's block
         # bucket: XLA compiles on the precompile worker pool while the
         # query features extract below, instead of serially inside the
@@ -325,7 +298,8 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         q_rows_max = max((len(p) for p in q_parts), default=0)
         if q_rows_max:
             warm_search_kernels(
-                prepared, k, mesh, n_queries=q_rows_max, d_query=dim
+                prepared, k, mesh,
+                n_queries=q_rows_max, d_query=self._frame_dim(dtype),
             )
         k_eff = min(k, prepared.n_items)
         out = []
@@ -345,6 +319,55 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
                 )
             )
         return out
+
+    def _stage_in_core_items(self, id_col: str, dtype, mesh):
+        """THE one definition of 'can this item set live device-resident,
+        and is it staged?' — shared by the kneighbors fast path and the
+        serving entry so the two can never disagree on the in-core
+        estimate, the staging key, or the block-split boundary case.
+
+        Returns (prepared, leftover_blocks, reason):
+          - (PreparedItems, None, None): staged (and cached on the model);
+          - (None, blocks_iter | None, reason): not stageable — `reason`
+            says why, and `blocks_iter`, when not None, carries device
+            blocks a failed staging attempt already packed so a streaming
+            fallback need not re-upload them."""
+        from ..ops.knn import _hbm_budget_bytes
+        from ..parallel.mesh import DATA_AXIS
+
+        rows = sum(len(p) for p in self._item_df.partitions)
+        dim = self._frame_dim(dtype)
+        n_dev = mesh.shape[DATA_AXIS]
+        in_core = (
+            dim is not None
+            and rows * dim * np.dtype(dtype).itemsize
+            <= _hbm_budget_bytes() * n_dev
+        )
+        if not in_core:
+            self._staged_items = None
+            return (
+                None,
+                None,
+                f"item set ({rows} x {dim}) exceeds the per-replica HBM "
+                "budget (SRML_KNN_HBM_BUDGET)",
+            )
+        key = self._staging_key(mesh, rows, dim)
+        if self._staged_items is None or self._staged_items[0] != key:
+            blocks = list(self._iter_item_blocks(id_col, dtype, mesh))
+            if len(blocks) != 1:
+                # the packer's n_dev-rounded per-block row bound can split
+                # right at the HBM-budget boundary even though the estimate
+                # above said in-core
+                self._staged_items = None
+                return (
+                    None,
+                    iter(blocks),
+                    "item set split across device blocks at the HBM-budget "
+                    "boundary",
+                )
+            self._staged_items = (key, blocks[0])
+            self._staged_queries.clear()
+        return self._staged_items[1], None, None
 
     def _frame_dim(self, dtype):
         """Feature dimensionality of the item frame, from ONE row —
@@ -508,6 +531,72 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
     def _get_tpu_transform_func(self, dataset):  # pragma: no cover
         raise NotImplementedError(
             "NearestNeighborsModel has no transform; use kneighbors instead."
+        )
+
+    def _ensure_staged_items(self, mesh, dtype=np.float32):
+        """Device-resident prepared item index (ops.knn.PreparedItems) for
+        the serving path — same staging helper as kneighbors, but an
+        unstageable item set is a hard error here (an online server must
+        never stream the index per batch), as is a pyspark-backed item
+        frame (serving is in-process)."""
+        from ..core import _is_pyspark_dataframe
+
+        assert self._item_df is not None, "fit() must be called before serving"
+        if _is_pyspark_dataframe(self._item_df):
+            raise ValueError(
+                "serving requires an in-process item frame; collect the "
+                "pyspark item dataframe (SRML_SPARK_COLLECT=1) before "
+                "registering the model"
+            )
+        prepared, _blocks, reason = self._stage_in_core_items(
+            self.getIdCol(), dtype, mesh
+        )
+        if prepared is None:
+            raise ValueError(f"{reason}; out-of-core indexes are kneighbors-only")
+        return prepared
+
+    def _serving_entry(self, mesh: Any = None):
+        """Online inference hook (serving/): each coalesced batch is ONE
+        knn_search_prepared call against the staged device-resident index.
+        The engine's pow2 buckets feed the search's own >=64 query-block
+        bucketing (_query_block_bucket), so warm_search_kernels covers every
+        geometry the steady state dispatches."""
+        from ..ops.knn import knn_search_prepared, warm_search_kernels
+        from ..serving.entry import ServingEntry
+
+        mesh = mesh or get_mesh(self.num_workers)
+        dtype = np.dtype(np.float32)
+        prepared = self._ensure_staged_items(mesh, dtype)
+        dim = self._frame_dim(dtype)
+        k = self.getK()
+
+        def call(batch: np.ndarray) -> Dict[str, np.ndarray]:
+            dists, ids = knn_search_prepared(prepared, batch, k, mesh)
+            return {
+                "indices": np.asarray(ids),
+                "distances": np.asarray(dists, dtype=np.float32),
+            }
+
+        def warm(buckets) -> list:
+            keys = []
+            # distinct engine buckets can collapse onto one >=64 search
+            # bucket; warm each resulting geometry once
+            for b in sorted({max(int(x), 64) for x in buckets}):
+                keys.extend(
+                    warm_search_kernels(
+                        prepared, k, mesh, n_queries=b, d_query=dim
+                    )
+                )
+            return keys
+
+        return ServingEntry(
+            name="serve.knn",
+            n_cols=int(dim),
+            dtype=dtype,
+            out_cols=["indices", "distances"],
+            call=call,
+            warm=warm,
+            info={"k": int(min(k, prepared.n_items)), "n_items": int(prepared.n_items)},
         )
 
     def write(self):

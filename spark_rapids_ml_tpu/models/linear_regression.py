@@ -388,6 +388,29 @@ class LinearRegressionModel(
 
         return _transform
 
+    def _serving_entry(self, mesh: Any = None):
+        """Online inference hook (serving/): the dense Xw + b prediction as
+        one bucket-padded kernel through the AOT executable cache (serving
+        requests arrive as dense rows; sparse bulk scoring stays on the
+        batch transform path)."""
+        assert self._num_models == 1, "combined multi-models are not servable"
+        from ..serving.entry import kernel_entry
+
+        np_dtype = self._transform_dtype(self.dtype)
+        coef = jax.device_put(np.asarray(self.coef_, dtype=np_dtype))
+        intercept = jax.numpy.asarray(np_dtype.type(self.intercept_))
+        pred_col = self.getOrDefault("predictionCol")
+        return kernel_entry(
+            "serve.linreg",
+            linear_predict_kernel,  # module-level @jax.jit
+            (coef, intercept),
+            {},
+            lambda preds: {pred_col: np.asarray(preds, dtype=np.float64)},
+            dtype=np_dtype,
+            n_cols=self.n_cols,
+            out_cols=[pred_col],
+        )
+
     def _get_eval_predict_func(self) -> Callable[[np.ndarray], np.ndarray]:
         np_dtype = self._transform_dtype(self.dtype)
         coefs = np.atleast_2d(np.asarray(self.coef_, dtype=np_dtype))

@@ -491,6 +491,55 @@ class LogisticRegressionModel(
 
         return _transform
 
+    def _serving_entry(self, mesh: Any = None):
+        """Online inference hook (serving/): decision scores, probabilities
+        and label indices fused into ONE bucket-padded kernel through the
+        AOT executable cache — the same ops the batch transform composes,
+        kept on device so a served batch is one dispatch, not three."""
+        assert self._num_models == 1, "combined multi-models are not servable"
+        from ..serving.entry import kernel_entry
+
+        np_dtype = self._transform_dtype(self.dtype)
+        W = jax.device_put(self.coef_.astype(np_dtype))
+        b = jax.device_put(self.intercept_.astype(np_dtype))
+        classes = self.classes_
+        num_classes = self._num_classes
+        pred_col = self.getOrDefault("predictionCol")
+        prob_col = self.getOrDefault("probabilityCol")
+        raw_col = self.getOrDefault("rawPredictionCol")
+
+        def _serve_kernel(X: jax.Array, W: jax.Array, b: jax.Array):
+            scores = logistic_decision_kernel(X, W, b)
+            return (
+                scores,
+                scores_to_probs(scores, num_classes),
+                scores_to_labels(scores, num_classes),
+            )
+
+        def _post(out) -> Dict[str, Any]:
+            scores, probs, labels = out
+            raw = np.asarray(scores, np.float64)
+            if num_classes == 2 and raw.shape[1] == 1:
+                raw = np.concatenate([-raw, raw], axis=1)
+            idx = np.asarray(labels, np.int64)
+            return {
+                pred_col: classes[idx].astype(np.float64),
+                prob_col: np.asarray(probs, np.float64),
+                raw_col: raw,
+            }
+
+        return kernel_entry(
+            "serve.logreg",
+            jax.jit(_serve_kernel),
+            (W, b),
+            {},
+            _post,
+            dtype=np_dtype,
+            n_cols=self.n_cols,
+            out_cols=[pred_col, prob_col, raw_col],
+            info={"num_classes": num_classes},
+        )
+
     def _get_eval_predict_func(self) -> Callable[[np.ndarray], tuple]:
         np_dtype = self._transform_dtype(self.dtype)
         coefs = jnp.asarray(

@@ -201,3 +201,31 @@ class PCAModel(_PCAParams, _TpuModel):
             return {out_col: np.asarray(projected)}
 
         return _transform
+
+    def _serving_entry(self, mesh: Any = None):
+        """Online inference hook (serving/): the (whiten-scaled) projection
+        as one bucket-padded kernel through the AOT executable cache —
+        exactly the matrix transform() applies, so served and batch outputs
+        are bit-identical."""
+        from ..serving.entry import kernel_entry
+
+        np_dtype = self._transform_dtype(self.dtype)
+        comps = np.asarray(self.components_, dtype=np_dtype)
+        if self._tpu_params.get("whiten"):
+            scale = 1.0 / np.sqrt(
+                np.maximum(self.explained_variance_, 1e-12)
+            ).astype(np_dtype)
+            comps = comps * scale[:, None]
+        components = jax.device_put(comps)
+        out_col = self.getOrDefault("outputCol")
+        return kernel_entry(
+            "serve.pca",
+            pca_transform_kernel,  # module-level @jax.jit
+            (components,),
+            {},
+            lambda proj: {out_col: np.asarray(proj)},
+            dtype=np_dtype,
+            n_cols=self.n_cols,
+            out_cols=[out_col],
+            info={"k": len(self.components_)},
+        )

@@ -809,6 +809,29 @@ class _RandomForestModelBase(_RandomForestParams, _TpuModelWithPredictionCol):
         )
         return self._per_model_values(features)[0]
 
+    def _serving_values_entry(self, postprocess, out_cols: List[str]):
+        """Shared serving plumbing for both forest models: the mean-leaf-
+        values traversal dispatched under the SAME 'forest_predict' cache
+        name and statics as the batch transform path (ops/forest.
+        forest_predict_cached), so serving and transform share compiled
+        executables wherever their row buckets coincide."""
+        assert self._num_models == 1, "combined multi-models are not servable"
+        from ..ops.forest import forest_predict_kernel
+        from ..serving.entry import kernel_entry
+
+        f, t, v = self._forest_arrays()
+        return kernel_entry(
+            "forest_predict",
+            forest_predict_kernel,  # module-level jit, static max_depth
+            (f, t, v),
+            {"max_depth": int(self.max_depth)},
+            postprocess,
+            dtype=self._transform_dtype(self.dtype),
+            n_cols=self.n_cols,
+            out_cols=out_cols,
+            info={"num_trees": int(self.features_.shape[0])},
+        )
+
     @property
     def getNumTrees(self) -> int:  # property for pyspark API parity
         return self.features_.shape[0]
@@ -966,6 +989,28 @@ class RandomForestClassificationModel(
 
         return _transform
 
+    def _serving_entry(self, mesh: Any = None):
+        """Online inference hook (serving/): one forest traversal per
+        coalesced batch; class mapping and normalization on host, matching
+        transform() exactly."""
+        classes = self.classes_
+        n_trees = self.features_.shape[0]
+        pred_col = self.getOrDefault("predictionCol")
+        prob_col = self.getOrDefault("probabilityCol")
+        raw_col = self.getOrDefault("rawPredictionCol")
+
+        def _post(values) -> Dict[str, Any]:
+            probs = np.asarray(values)
+            probs = probs / np.maximum(probs.sum(axis=1, keepdims=True), 1e-12)
+            idx = probs.argmax(axis=1)
+            return {
+                pred_col: classes[idx].astype(np.float64),
+                prob_col: probs.astype(np.float64),
+                raw_col: (probs * n_trees).astype(np.float64),
+            }
+
+        return self._serving_values_entry(_post, [pred_col, prob_col, raw_col])
+
     def _get_eval_predict_func(self):
         classes = self.classes_
 
@@ -1067,6 +1112,17 @@ class RandomForestRegressionModel(
             return {pred_col: preds.astype(np.float64)}
 
         return _transform
+
+    def _serving_entry(self, mesh: Any = None):
+        """Online inference hook (serving/): one forest traversal per
+        coalesced batch, first value column as the regression prediction."""
+        pred_col = self.getOrDefault("predictionCol")
+        return self._serving_values_entry(
+            lambda values: {
+                pred_col: np.asarray(values)[:, 0].astype(np.float64)
+            },
+            [pred_col],
+        )
 
     def _get_eval_predict_func(self) -> Callable[[np.ndarray], np.ndarray]:
         def _predict_all(feats: np.ndarray) -> np.ndarray:

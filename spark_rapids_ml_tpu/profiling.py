@@ -25,6 +25,10 @@
 #   - record_event/events: a per-thread ORDERED event log for asserting
 #     pipeline interleavings (e.g. "block i+1 dispatched before block i
 #     collected" in the kNN query engine) without timing-dependent tests.
+#   - record_duration/percentiles: PROCESS-wide duration samples (per-request
+#     serving latencies recorded on the dispatch worker thread, read from the
+#     main thread) with p50/p95/p99 summaries — the SLO surface the serving
+#     engine and the benchmark reports share.
 #
 
 from __future__ import annotations
@@ -111,6 +115,79 @@ def reset_counters(prefix: str = "") -> None:
     with _counters_lock:
         for k in [k for k in _counters if k.startswith(prefix)]:
             del _counters[k]
+
+
+# -- process-wide duration samples -------------------------------------------
+# Like the counters (and unlike the phase registry) these are NOT thread-
+# local: the serving engine records request latencies on its dispatch worker
+# thread while stats()/tests read the percentiles from the main thread.
+# Bounded per name so a long-lived server cannot grow the sample list without
+# limit; past the cap new samples overwrite the oldest (ring buffer), keeping
+# the percentiles a sliding window over the most recent traffic.
+
+_DURATION_CAP = 65536
+
+_durations_lock = threading.Lock()
+_durations: Dict[str, list] = {}
+_duration_next: Dict[str, int] = {}  # ring-buffer write cursor past the cap
+
+
+def record_duration(name: str, seconds: float) -> None:
+    """Append one duration sample (seconds) to the process-wide series
+    `name`.  Cheap enough for per-request recording; capped per name (ring
+    buffer) so recording is observability, never a leak."""
+    with _durations_lock:
+        series = _durations.get(name)
+        if series is None:
+            series = []
+            _durations[name] = series
+        if len(series) < _DURATION_CAP:
+            series.append(float(seconds))
+        else:
+            cur = _duration_next.get(name, 0)
+            series[cur] = float(seconds)
+            _duration_next[name] = (cur + 1) % _DURATION_CAP
+
+
+def durations(prefix: str = "") -> Dict[str, list]:
+    """Copy of every duration series whose name starts with `prefix`."""
+    with _durations_lock:
+        return {k: list(v) for k, v in _durations.items() if k.startswith(prefix)}
+
+
+def reset_durations(prefix: str = "") -> None:
+    with _durations_lock:
+        for k in [k for k in _durations if k.startswith(prefix)]:
+            del _durations[k]
+            _duration_next.pop(k, None)
+
+
+def percentiles(prefix: str = "") -> Dict[str, float]:
+    """p50/p95/p99 (plus count/mean/max) over every duration sample recorded
+    under names starting with `prefix`, merged into ONE distribution — pass
+    an exact series name for a single series, or a subsystem prefix (e.g.
+    "serve.kmeans.") for its whole latency surface.  Returns {} when nothing
+    was recorded.  Linear interpolation between order statistics, the numpy
+    default, so tiny test samples get deterministic values."""
+    merged: list = []
+    with _durations_lock:
+        for k, v in _durations.items():
+            if k.startswith(prefix):
+                merged.extend(v)
+    if not merged:
+        return {}
+    import numpy as np
+
+    arr = np.asarray(merged, dtype=np.float64)
+    p50, p95, p99 = np.percentile(arr, [50.0, 95.0, 99.0])
+    return {
+        "count": int(arr.size),
+        "mean": float(arr.mean()),
+        "p50": float(p50),
+        "p95": float(p95),
+        "p99": float(p99),
+        "max": float(arr.max()),
+    }
 
 
 # -- per-thread ordered event log --------------------------------------------

@@ -1,0 +1,37 @@
+#
+# srml-serve: the online inference subsystem.
+#
+# The fit engines of PRs 1-4 built everything an online request path needs —
+# an AOT executable cache keyed on pow2 shape buckets (ops/precompile),
+# device-resident model state, and staged transform kernels — but nothing
+# composed them: every transform() was a one-shot batch call.  This package
+# is that composition (docs/serving.md):
+#
+#   batcher.py   dynamic micro-batching: bounded queue, coalesce-until-
+#                deadline, fast ServerOverloaded rejection, per-request
+#                deadlines
+#   entry.py     the model <-> engine contract (ServingEntry) + the single
+#                pow2 row-bucket rule shared by dispatch and warmup
+#   engine.py    ModelServer: dedicated dispatch worker, bucket-warmed
+#                executables (steady state = zero new compiles, asserted),
+#                latency percentiles through profiling
+#   registry.py  named servers over in-memory or core.load'ed models
+#
+from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
+from .engine import ModelServer
+from .entry import ServingEntry, bucket_rows, entry_for, kernel_entry, serve_buckets
+from .registry import ModelRegistry, default_registry
+
+__all__ = [
+    "MicroBatcher",
+    "ModelRegistry",
+    "ModelServer",
+    "RequestTimeout",
+    "ServerOverloaded",
+    "ServingEntry",
+    "bucket_rows",
+    "default_registry",
+    "entry_for",
+    "kernel_entry",
+    "serve_buckets",
+]

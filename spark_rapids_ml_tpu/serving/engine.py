@@ -1,0 +1,326 @@
+#
+# ModelServer: one fitted model behind a dynamic micro-batcher and a
+# dedicated dispatch worker thread.
+#
+# The worker pops coalesced batches (serving/batcher.py), zero-pads each to
+# its power-of-two row bucket (serving/entry.py bucket_rows), and runs the
+# model's ServingEntry.call — upload, AOT-cached executable, host fetch —
+# then scatters the output columns back to the requests' futures.  Running
+# dispatch on its own thread is what overlaps the host->device->host
+# pipeline with queue fill: while a batch is on device, the next one is
+# coalescing.
+#
+# Warmup at construction makes steady state compile-free: every serving
+# bucket is AOT-submitted through ops/precompile (entry.warm) AND dispatched
+# once end to end with a synthetic batch, so the first real request lands on
+# executables that already exist.  The engine then watches the precompile
+# compile/fallback counters; any post-warm compile is recorded in
+# serving.<name>.steady_compiles and assert_steady_state() turns it into a
+# hard failure (the CI serving gate's zero-new-compiles contract).
+#
+# Observability rides profiling: process-wide counters under
+# serving.<name>.* (requests/rows/batches/coalesced_batches/rejected/
+# timeouts/errors/pad_rows/flush_*), per-request wall-clock latencies under
+# serve.<name>.latency (profiling.percentiles gives p50/p95/p99), and
+# per-batch dispatch times under serve.<name>.dispatch.
+#
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import profiling
+from .batcher import (  # noqa: F401
+    MicroBatcher,
+    RequestTimeout,
+    ServerOverloaded,
+    resolve_future,
+)
+from .entry import ServingEntry, bucket_rows, entry_for, serve_buckets
+
+logger = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+
+def _compile_watermark() -> int:
+    """Total executable builds so far: AOT pool compiles plus jit fallbacks
+    (a fallback means an AOT executable rejected its inputs and a FRESH jit
+    compile happened — that is a new compile even though the pool counter
+    does not move)."""
+    return profiling.counter("precompile.compile") + profiling.counter(
+        "precompile.fallback"
+    )
+
+
+# The compile watermark is PROCESS-wide, so a server dispatching while
+# ANOTHER server warms up would see the warmer's compiles in its own
+# window and fail assert_steady_state spuriously (the multi-model registry
+# load-under-traffic case).  Every warmup registers here; a dispatch whose
+# window overlapped any warmup skips compile attribution for that batch
+# (counted as unattributed, never as a steady-state breach).
+_warm_lock = threading.Lock()
+_warm_active = 0
+_warm_epoch = 0  # bumped at every warmup start AND end
+
+
+@contextlib.contextmanager
+def _warm_scope():
+    global _warm_active, _warm_epoch
+    with _warm_lock:
+        _warm_active += 1
+        _warm_epoch += 1
+    try:
+        yield
+    finally:
+        with _warm_lock:
+            _warm_active -= 1
+            _warm_epoch += 1
+
+
+def _warm_snapshot():
+    with _warm_lock:
+        return _warm_active, _warm_epoch
+
+
+class ModelServer:
+    """Online inference for one fitted model.
+
+    Construction warms every serving bucket and starts the dispatch worker;
+    `submit` enqueues a request and returns a Future, `predict` is the
+    blocking convenience.  Use as a context manager or call shutdown()."""
+
+    def __init__(
+        self,
+        name: str,
+        model: Any,
+        mesh: Any = None,
+        *,
+        max_batch: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        default_timeout_ms: Optional[float] = None,
+        warm: bool = True,
+    ):
+        self.name = str(name)
+        self.model = model
+        self.ns = f"serving.{self.name}"
+        self._entry: ServingEntry = entry_for(model, mesh)
+        self._batcher = MicroBatcher(
+            n_cols=self._entry.n_cols,
+            dtype=self._entry.dtype,
+            counter_ns=self.ns,
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            queue_depth=queue_depth,
+            default_timeout_ms=default_timeout_ms,
+        )
+        self.buckets = serve_buckets(self._batcher.max_batch)
+        self._wide = np.dtype(self._entry.dtype).itemsize == 8
+        self._steady_compiles = 0
+        self._warmed = False
+        if warm:
+            self._warm_buckets()
+        self._worker = threading.Thread(
+            target=self._run, name=f"srml-serve-{self.name}", daemon=True
+        )
+        self._worker.start()
+
+    # -- warmup -------------------------------------------------------------
+    def _warm_buckets(self) -> None:
+        """Compile every serving-bucket geometry before traffic: AOT-submit
+        through the precompile pool (parallel compiles), wait, then push one
+        synthetic batch per bucket through the FULL dispatch path so any
+        internal jit a route owns (e.g. the kNN merge) is compiled too.
+        After this, the compile watermark is the steady-state baseline."""
+        from ..ops.precompile import global_precompiler
+
+        t0 = time.perf_counter()
+        with _warm_scope():
+            keys = self._entry.warm(list(self.buckets))
+            if keys:
+                global_precompiler().wait(keys)
+            with self._x64_scope():
+                for b in self.buckets:
+                    synth = np.zeros(
+                        (b, self._entry.n_cols), dtype=self._entry.dtype
+                    )
+                    out = self._entry.call(synth)
+                    missing = [c for c in self._entry.out_cols if c not in out]
+                    assert not missing, (
+                        f"serving entry {self._entry.name!r} returned columns "
+                        f"{sorted(out)} missing declared {missing}"
+                    )
+        profiling.record_duration(f"serve.{self.name}.warmup", time.perf_counter() - t0)
+        profiling.incr_counter(f"{self.ns}.warmed_buckets", len(self.buckets))
+        self._warmed = True
+
+    def _x64_scope(self):
+        import contextlib
+
+        if self._wide:
+            from ..compat import enable_x64
+
+            # the worker thread is outside any fit's x64 scope; a float64
+            # model's kernels must not silently canonicalize to f32 here
+            return enable_x64(True)
+        return contextlib.nullcontext()
+
+    # -- client API ---------------------------------------------------------
+    def submit(self, features: np.ndarray, timeout_ms: Optional[float] = None):
+        """Enqueue one request ((D,) row or (n, D) block, n <= max_batch);
+        returns a Future resolving to {output column: np array of n rows}.
+        Raises ServerOverloaded when the queue bound is hit."""
+        return self._batcher.submit(features, timeout_ms=timeout_ms)
+
+    def predict(
+        self, features: np.ndarray, timeout_ms: Optional[float] = None
+    ) -> Dict[str, np.ndarray]:
+        """Blocking convenience around submit(); the client-side wait is
+        bounded by the request timeout plus one dispatch."""
+        fut = self.submit(features, timeout_ms=timeout_ms)
+        wait_s = None
+        if timeout_ms is not None and timeout_ms > 0:
+            wait_s = timeout_ms / 1000.0 + 60.0  # dispatch slack
+        return fut.result(timeout=wait_s)
+
+    # -- dispatch worker ----------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            item = self._batcher.take()
+            if item is None:
+                return
+            batch, _reason = item
+            try:
+                self._dispatch(batch)
+            except BaseException:  # noqa: BLE001 - the worker must survive
+                # _dispatch relays model errors to the batch's futures; this
+                # guard is for bookkeeping bugs (e.g. a racing future state)
+                # — one batch may be lost, the server must not wedge
+                logger.exception("%s: dispatch bookkeeping failed", self.ns)
+                profiling.incr_counter(f"{self.ns}.errors")
+                for r in batch:
+                    resolve_future(
+                        r.future,
+                        exc=RuntimeError(f"{self.ns}: dispatch failed"),
+                    )
+
+    def _dispatch(self, batch) -> None:
+        n_rows = sum(r.n_rows for r in batch)
+        b = bucket_rows(n_rows, self._batcher.max_batch)
+        padded = np.zeros((b, self._entry.n_cols), dtype=self._entry.dtype)
+        off = 0
+        for r in batch:
+            padded[off : off + r.n_rows] = r.features
+            off += r.n_rows
+        profiling.incr_counter(f"{self.ns}.pad_rows", b - n_rows)
+        # compile accounting brackets THIS dispatch: the watermark counters
+        # are process-wide, so a baseline taken at warmup end would blame
+        # this server for another server's later load-time compiles (any
+        # compile our own dispatch triggers finishes inside entry.call —
+        # cached_call waits on the pool job before running).  A window that
+        # overlapped any concurrent warmup (epoch moved / warm active) skips
+        # attribution entirely — see _warm_scope.
+        active0, epoch0 = _warm_snapshot()
+        mark0 = _compile_watermark() if self._warmed else 0
+        t0 = time.perf_counter()
+        try:
+            with self._x64_scope():
+                out = self._entry.call(padded)
+        except BaseException as exc:  # noqa: BLE001 - relayed to every waiter
+            profiling.incr_counter(f"{self.ns}.errors")
+            for r in batch:
+                resolve_future(r.future, exc=exc)
+            return
+        dt = time.perf_counter() - t0
+        profiling.record_duration(f"serve.{self.name}.dispatch", dt)
+        profiling.record_duration(f"serve.{self.name}.occupancy", float(len(batch)))
+        if self._warmed:
+            delta = _compile_watermark() - mark0
+            if delta > 0:
+                active1, epoch1 = _warm_snapshot()
+                if active0 == 0 and active1 == 0 and epoch0 == epoch1:
+                    profiling.incr_counter(f"{self.ns}.steady_compiles", delta)
+                    self._steady_compiles += delta
+                else:
+                    profiling.incr_counter(
+                        f"{self.ns}.unattributed_compiles", delta
+                    )
+        done_t = time.perf_counter()
+        off = 0
+        for r in batch:
+            sl = slice(off, off + r.n_rows)
+            off += r.n_rows
+            result = {c: np.asarray(v[sl]) for c, v in out.items()}
+            if resolve_future(r.future, result):
+                profiling.record_duration(
+                    f"serve.{self.name}.latency", done_t - r.enqueue_t
+                )
+
+    # -- lifecycle / observability ------------------------------------------
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Flush pending partial batches immediately and wait until every
+        queued request has resolved (quiescence).  The server keeps running
+        only in the sense that the worker stays alive for shutdown(); new
+        submits are rejected once draining starts."""
+        self._batcher.begin_drain()
+        if not self._batcher.wait_quiescent(timeout_s=timeout_s):
+            raise TimeoutError(
+                f"{self.ns}: drain timed out with "
+                f"{self._batcher.outstanding()} request(s) unresolved"
+            )
+
+    def shutdown(self, drain: bool = True, timeout_s: float = 60.0) -> None:
+        if drain:
+            try:
+                self.drain(timeout_s=timeout_s)
+            finally:
+                self._batcher.stop()
+        else:
+            self._batcher.stop()
+        self._worker.join(timeout=timeout_s)
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def assert_steady_state(self) -> None:
+        """Zero-new-compiles contract: every post-warmup dispatch ran on an
+        already-compiled executable.  Raises AssertionError otherwise —
+        used by the CI serving gate and available to deployments that treat
+        a steady-state compile as an SLO breach."""
+        assert self._steady_compiles == 0, (
+            f"{self.ns}: {self._steady_compiles} executable compile(s) "
+            "after warmup — a serving bucket or kernel geometry was not "
+            "covered by the warm set"
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        """One self-describing snapshot: queue gauges, batching counters,
+        latency percentiles, and the compile watermark."""
+        lat = profiling.percentiles(f"serve.{self.name}.latency")
+        disp = profiling.percentiles(f"serve.{self.name}.dispatch")
+        occ = profiling.percentiles(f"serve.{self.name}.occupancy")
+        return {
+            "name": self.name,
+            "entry": self._entry.name,
+            "out_cols": list(self._entry.out_cols),
+            "buckets": list(self.buckets),
+            "max_batch": self._batcher.max_batch,
+            "max_wait_ms": self._batcher.max_wait_s * 1000.0,
+            "queue_depth": self._batcher.queue_depth,
+            "queued_rows": self._batcher.queued_rows(),
+            "queued_requests": self._batcher.queued_requests(),
+            "counters": profiling.counters(self.ns + "."),
+            "latency": lat,
+            "dispatch": disp,
+            "batch_occupancy": occ,
+            "steady_compiles": self._steady_compiles,
+            **({"info": self._entry.info} if self._entry.info else {}),
+        }

@@ -1,0 +1,117 @@
+#
+# ModelRegistry: named ModelServers over fitted models.
+#
+# Two admission paths: register(name, model) for models already in memory
+# (a just-fitted estimator, a kNN model whose item frame lives in the
+# process), and load(name, path) which resolves any saved model through the
+# core persistence layer (core.load reads the class from metadata.json) and
+# serves it.  Either way the server warms EVERY serving bucket at
+# registration — model load time is where the compile bill is paid, so the
+# first request is already steady state.
+#
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .engine import ModelServer
+
+
+class ModelRegistry:
+    """Thread-safe name -> ModelServer map with load-time warmup.
+
+    `server_kwargs` are the defaults every server is built with
+    (max_batch, max_wait_ms, queue_depth, ...); per-model overrides go on
+    register/load."""
+
+    def __init__(self, **server_kwargs: Any):
+        self._defaults = dict(server_kwargs)
+        self._lock = threading.Lock()
+        self._servers: Dict[str, ModelServer] = {}
+
+    def register(self, name: str, model: Any, **overrides: Any) -> ModelServer:
+        """Serve an in-memory fitted model under `name` (warms buckets and
+        starts the dispatch worker before returning).  The name is RESERVED
+        before the warmup: a duplicate fails immediately instead of paying
+        the whole compile bill first — and polluting the live server's
+        serving.<name>.* metrics namespace with a doomed twin's warmup."""
+        with self._lock:
+            if name in self._servers:
+                raise ValueError(f"model name {name!r} already registered")
+            self._servers[name] = None  # reservation; filled below
+        try:
+            server = ModelServer(name, model, **{**self._defaults, **overrides})
+        except BaseException:
+            with self._lock:
+                self._servers.pop(name, None)
+            raise
+        with self._lock:
+            self._servers[name] = server
+        return server
+
+    def load(self, name: str, path: str, **overrides: Any) -> ModelServer:
+        """Load a saved model from `path` via core persistence and serve it.
+        Estimators (no transform surface) are rejected with a clear error."""
+        from ..core import _TpuModel, load as core_load
+
+        obj = core_load(path)
+        if not isinstance(obj, _TpuModel):
+            raise TypeError(
+                f"{path!r} holds a {type(obj).__name__}, not a fitted model; "
+                "only models are servable"
+            )
+        return self.register(name, obj, **overrides)
+
+    def get(self, name: str) -> ModelServer:
+        with self._lock:
+            server = self._servers.get(name)
+        if server is None:  # absent OR still warming (reservation)
+            raise KeyError(f"no served model named {name!r}")
+        return server
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(n for n, s in self._servers.items() if s is not None)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return self._servers.get(name) is not None
+
+    def unregister(self, name: str, drain: bool = True) -> None:
+        with self._lock:
+            server = self._servers.pop(name, None)
+        if server is not None:
+            server.shutdown(drain=drain)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            servers = {n: s for n, s in self._servers.items() if s is not None}
+        return {name: s.stats() for name, s in sorted(servers.items())}
+
+    def shutdown(self, drain: bool = True) -> None:
+        with self._lock:
+            servers = [s for s in self._servers.values() if s is not None]
+            self._servers.clear()
+        for s in servers:
+            s.shutdown(drain=drain)
+
+    def __enter__(self) -> "ModelRegistry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+_default: Optional[ModelRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> ModelRegistry:
+    """Process-wide registry for embedders that want one shared serving
+    plane (the analog of ops/precompile.global_precompiler)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = ModelRegistry()
+        return _default

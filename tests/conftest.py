@@ -80,3 +80,69 @@ def n_devices():
     import jax
 
     return jax.device_count()
+
+
+@pytest.fixture(scope="session")
+def model_zoo():
+    """Lazily-fitted tiny models over one shared dataset, keyed by arm name
+    ("kmeans", "pca", "linreg", "logreg", "rf_clf", "rf_reg", "umap",
+    "knn").  Returns a factory: model_zoo(name) -> (model, X) with X the
+    float32 feature matrix the model was fit on.  Session-scoped and cached
+    so the persistence matrix and the serving tests share ONE fit per
+    class instead of re-fitting per test."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((96, 5)).astype(np.float32)
+    y_reg = (X @ np.arange(1.0, 6.0) + 0.1 * rng.standard_normal(96)).astype(
+        np.float64
+    )
+    y_clf = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    cache = {}
+
+    def _build(name):
+        from spark_rapids_ml_tpu import (
+            KMeans,
+            LinearRegression,
+            LogisticRegression,
+            NearestNeighbors,
+            PCA,
+            RandomForestClassifier,
+            RandomForestRegressor,
+            UMAP,
+        )
+        from spark_rapids_ml_tpu.dataframe import DataFrame
+
+        df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=2)
+        df_reg = DataFrame.from_numpy(X, y=y_reg, num_partitions=2)
+        df_clf = DataFrame.from_numpy(X, y=y_clf, num_partitions=2)
+        if name == "kmeans":
+            return KMeans(k=3, maxIter=4, seed=1).setFeaturesCol("features").fit(df)
+        if name == "pca":
+            return PCA(k=3).setInputCol("features").fit(df)
+        if name == "linreg":
+            return LinearRegression(maxIter=20).fit(df_reg)
+        if name == "logreg":
+            return LogisticRegression(maxIter=10).fit(df_clf)
+        if name == "rf_clf":
+            return RandomForestClassifier(
+                numTrees=3, maxDepth=3, maxBins=8, seed=1
+            ).fit(df_clf)
+        if name == "rf_reg":
+            return RandomForestRegressor(
+                numTrees=3, maxDepth=3, maxBins=8, seed=1
+            ).fit(df_reg)
+        if name == "umap":
+            return UMAP(
+                n_neighbors=8, n_epochs=30, init="random", random_state=2
+            ).setFeaturesCol("features").fit(df)
+        if name == "knn":
+            return NearestNeighbors(k=4).setFeaturesCol("features").fit(df)
+        raise KeyError(name)
+
+    def get(name):
+        if name not in cache:
+            cache[name] = (_build(name), X)
+        return cache[name]
+
+    return get

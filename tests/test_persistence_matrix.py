@@ -1,0 +1,66 @@
+# One parametrized save -> load -> transform equivalence matrix over every
+# persistable model class (persistence used to be asserted ad hoc per model
+# file).  The loaded model must be the same class, carry the same param
+# surface, and produce BIT-IDENTICAL transform output on the training
+# features — both sides run the same device kernels on the same dtype, so
+# exact equality is the right bar, not allclose.  The `model_zoo` fixture
+# fitting these models is shared with the serving tests (the registry's
+# model-loading path, tests/test_serving.py).
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.core import load as core_load
+from spark_rapids_ml_tpu.dataframe import DataFrame
+
+MODEL_ARMS = ["kmeans", "pca", "linreg", "logreg", "rf_clf", "rf_reg", "umap"]
+
+
+def _columns(df) -> dict:
+    """{column: stacked np array} over all partitions of a facade frame."""
+    out = {}
+    for name in df.columns:
+        vals = []
+        for p in df.partitions:
+            vals.extend(list(p[name]))
+        out[name] = np.asarray(vals)
+    return out
+
+
+def _transform_outputs(model, X: np.ndarray) -> dict:
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=2)
+    if model.hasParam("featuresCol"):
+        model.setFeaturesCol("features")
+    out = model.transform(df)
+    return {k: v for k, v in _columns(out).items() if k != "features"}
+
+
+@pytest.mark.parametrize("arm", MODEL_ARMS)
+def test_save_load_transform_equivalence(arm, model_zoo, tmp_path):
+    model, X = model_zoo(arm)
+    path = str(tmp_path / arm)
+    model.save(path)
+    loaded = core_load(path)
+    assert type(loaded) is type(model)
+    # the param surface survives the round trip (outputs land in the same
+    # columns)
+    for p in ("predictionCol", "probabilityCol", "rawPredictionCol", "outputCol"):
+        if model.hasParam(p) and model.isDefined(p):
+            assert loaded.getOrDefault(p) == model.getOrDefault(p)
+    before = _transform_outputs(model, X)
+    after = _transform_outputs(loaded, X)
+    assert sorted(before) == sorted(after)
+    for col in before:
+        assert np.array_equal(
+            np.asarray(before[col]), np.asarray(after[col])
+        ), f"{arm}: column {col!r} changed across save/load"
+
+
+def test_loaded_model_attributes_round_trip(model_zoo, tmp_path):
+    # spot-check the attribute payload itself (npz + json split): arrays
+    # stay arrays, scalars stay scalars
+    model, _X = model_zoo("kmeans")
+    path = str(tmp_path / "kmeans_attrs")
+    model.save(path)
+    loaded = core_load(path)
+    assert np.array_equal(loaded.cluster_centers_, model.cluster_centers_)
+    assert loaded.n_cols == model.n_cols and loaded.dtype == model.dtype

@@ -107,6 +107,51 @@ def test_counters_are_process_wide_and_resettable():
     assert profiling.counters("test.ctr") == {}
 
 
+def test_percentiles_over_recorded_durations():
+    profiling.reset_durations("t.lat")
+    for ms in range(1, 101):  # 1..100 ms
+        profiling.record_duration("t.lat.a", ms / 1000.0)
+    stats = profiling.percentiles("t.lat.a")
+    assert stats["count"] == 100
+    assert abs(stats["p50"] - 0.0505) < 1e-9  # numpy linear interpolation
+    assert stats["p95"] <= stats["p99"] <= stats["max"] == 0.1
+    assert abs(stats["mean"] - 0.0505) < 1e-9
+    profiling.reset_durations("t.lat")
+    assert profiling.percentiles("t.lat") == {}
+
+
+def test_percentiles_merge_prefix_and_cross_thread():
+    import threading
+
+    profiling.reset_durations("t.merge")
+    profiling.record_duration("t.merge.a", 1.0)
+    # worker-thread samples land in the same process-wide registry (the
+    # serving dispatch-thread contract)
+    t = threading.Thread(target=lambda: profiling.record_duration("t.merge.b", 3.0))
+    t.start()
+    t.join()
+    merged = profiling.percentiles("t.merge")
+    assert merged["count"] == 2 and merged["p50"] == 2.0
+    only_a = profiling.percentiles("t.merge.a")
+    assert only_a["count"] == 1 and only_a["p50"] == 1.0
+    assert profiling.durations("t.merge") == {
+        "t.merge.a": [1.0],
+        "t.merge.b": [3.0],
+    }
+    profiling.reset_durations("t.merge")
+
+
+def test_duration_cap_is_a_ring_buffer(monkeypatch):
+    monkeypatch.setattr(profiling, "_DURATION_CAP", 4)
+    profiling.reset_durations("t.ring")
+    for i in range(6):
+        profiling.record_duration("t.ring", float(i))
+    series = profiling.durations("t.ring")["t.ring"]
+    assert len(series) == 4  # capped
+    assert sorted(series) == [2.0, 3.0, 4.0, 5.0]  # oldest overwritten
+    profiling.reset_durations("t.ring")
+
+
 def test_event_log_order_and_reset():
     profiling.reset_events()
     profiling.record_event("t.dispatch", block=0)

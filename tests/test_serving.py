@@ -1,0 +1,383 @@
+# srml-serve gates (docs/serving.md): dynamic micro-batching, bucket-warmed
+# executables (steady state = zero new compiles), admission control with
+# fast ServerOverloaded rejection, per-request deadlines, clean drain, the
+# registry's load path over core persistence, and serving-vs-transform
+# output equivalence for every served model class.
+#
+# Counter-based assertions follow the PR2-4 idiom: profiling counters and
+# duration percentiles, never wall-clock thresholds.
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import profiling
+from spark_rapids_ml_tpu.serving import (
+    ModelRegistry,
+    ModelServer,
+    RequestTimeout,
+    ServerOverloaded,
+    ServingEntry,
+    bucket_rows,
+    serve_buckets,
+)
+
+SERVED_ARMS = ["kmeans", "pca", "linreg", "logreg", "rf_clf", "rf_reg"]
+
+
+# -- a controllable fake model for policy tests ------------------------------
+
+
+class _EchoModel:
+    """Servable stub: echoes row sums; optional per-dispatch delay lets the
+    policy tests hold the worker busy to build a backlog deterministically."""
+
+    def __init__(self, n_cols=4, delay_s=0.0):
+        self.n_cols = n_cols
+        self.delay_s = delay_s
+        self.calls = []
+
+    def _serving_entry(self, mesh=None):
+        def call(batch):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            self.calls.append(batch.shape[0])
+            return {"echo": batch.sum(axis=1)}
+
+        return ServingEntry(
+            name="serve.echo",
+            n_cols=self.n_cols,
+            dtype=np.dtype(np.float32),
+            out_cols=["echo"],
+            call=call,
+            warm=lambda buckets: [],
+        )
+
+
+# -- bucket rules -------------------------------------------------------------
+
+
+def test_bucket_rules():
+    assert bucket_rows(1, 256) == 16  # SRML_SERVE_MIN_BUCKET default
+    assert bucket_rows(17, 256) == 32
+    assert bucket_rows(256, 256) == 256
+    assert bucket_rows(300, 256) == 256  # clamped to the max-batch bucket
+    assert serve_buckets(256) == [16, 32, 64, 128, 256]
+    assert serve_buckets(100) == [16, 32, 64, 128]
+    assert serve_buckets(8) == [16]
+
+
+def test_submit_validation():
+    srv = ModelServer("echo_val", _EchoModel(), max_batch=8, max_wait_ms=1)
+    try:
+        with pytest.raises(ValueError, match="features must be"):
+            srv.submit(np.zeros((2, 3), np.float32))  # wrong width
+        with pytest.raises(ValueError, match="empty request"):
+            srv.submit(np.zeros((0, 4), np.float32))
+        with pytest.raises(ValueError, match="exceeds max_batch"):
+            srv.submit(np.zeros((9, 4), np.float32))
+    finally:
+        srv.shutdown()
+
+
+# -- batching policy ----------------------------------------------------------
+
+
+def test_single_row_requests_coalesce_into_one_device_batch():
+    model = _EchoModel(delay_s=0.05)
+    srv = ModelServer("echo_coal", model, max_batch=64, max_wait_ms=20)
+    try:
+        before = profiling.counters("serving.echo_coal.")
+        # first request occupies the worker (delay_s); the rest pile up in
+        # the queue and MUST flush as one multi-request batch
+        futs = [
+            srv.submit(np.full(4, i, np.float32)) for i in range(8)
+        ]
+        results = [f.result(timeout=30) for f in futs]
+        delta = profiling.counter_deltas(before, "serving.echo_coal.")
+        assert delta["serving.echo_coal.requests"] == 8
+        assert delta["serving.echo_coal.batches"] < 8  # coalescing happened
+        assert delta.get("serving.echo_coal.coalesced_batches", 0) >= 1
+        # batch occupancy > 1 observed by the engine's own histogram
+        occ = profiling.percentiles("serve.echo_coal.occupancy")
+        assert occ["max"] > 1
+        # scatter is per request, in order, with the right values
+        for i, r in enumerate(results):
+            assert r["echo"].shape == (1,)
+            assert r["echo"][0] == pytest.approx(4.0 * i)
+    finally:
+        srv.shutdown()
+
+
+def test_deadline_flush_of_partial_batch():
+    srv = ModelServer("echo_partial", _EchoModel(), max_batch=64, max_wait_ms=5)
+    try:
+        before = profiling.counters("serving.echo_partial.")
+        out = srv.predict(np.ones(4, np.float32))
+        assert out["echo"][0] == pytest.approx(4.0)
+        delta = profiling.counter_deltas(before, "serving.echo_partial.")
+        # one lone request under max_batch flushed at the deadline
+        assert delta.get("serving.echo_partial.flush_deadline", 0) >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_full_batch_flushes_without_waiting():
+    srv = ModelServer(
+        "echo_full", _EchoModel(delay_s=0.05), max_batch=4, max_wait_ms=10_000
+    )
+    try:
+        futs = [srv.submit(np.ones((2, 4), np.float32)) for _ in range(4)]
+        for f in futs:
+            f.result(timeout=30)  # would hang for 10 s if deadline-bound
+        delta = profiling.counters("serving.echo_full.")
+        assert delta.get("serving.echo_full.flush_full", 0) >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_padding_to_pow2_bucket():
+    model = _EchoModel()
+    srv = ModelServer("echo_pad", model, max_batch=64, max_wait_ms=1)
+    try:
+        srv.predict(np.ones((3, 4), np.float32))
+    finally:
+        srv.shutdown()
+    # warmup dispatches every bucket (16, 32, 64); traffic adds one 16-pad
+    assert model.calls[:3] == [16, 32, 64]
+    assert model.calls[-1] == 16  # 3 rows padded to the min bucket
+
+
+# -- admission control / deadlines -------------------------------------------
+
+
+def test_overload_rejects_fast_instead_of_blocking():
+    model = _EchoModel(delay_s=0.2)
+    srv = ModelServer(
+        "echo_over", model, max_batch=4, max_wait_ms=1, queue_depth=8
+    )
+    try:
+        before = profiling.counters("serving.echo_over.")
+        futs = []
+        rejected = 0
+        # worker is busy 200 ms per dispatch; queue bound is 8 rows — the
+        # burst MUST hit ServerOverloaded, and the submit path must return
+        # immediately either way (no blocking admission)
+        t0 = time.perf_counter()
+        for _ in range(64):
+            try:
+                futs.append(srv.submit(np.ones(4, np.float32)))
+            except ServerOverloaded:
+                rejected += 1
+        submit_wall = time.perf_counter() - t0
+        assert rejected > 0
+        assert submit_wall < 1.0  # 64 admissions/rejections, zero dispatch waits
+        delta = profiling.counter_deltas(before, "serving.echo_over.")
+        assert delta["serving.echo_over.rejected"] == rejected
+        for f in futs:
+            f.result(timeout=30)  # admitted requests still complete
+    finally:
+        srv.shutdown()
+
+
+def test_request_deadline_expires_in_queue():
+    model = _EchoModel(delay_s=0.25)
+    srv = ModelServer("echo_to", model, max_batch=2, max_wait_ms=1)
+    try:
+        first = srv.submit(np.ones((2, 4), np.float32))  # occupies the worker
+        doomed = srv.submit(np.ones(4, np.float32), timeout_ms=50.0)
+        survivor = srv.submit(np.ones(4, np.float32))  # no deadline
+        assert first.result(timeout=30)
+        with pytest.raises(RequestTimeout):
+            doomed.result(timeout=30)
+        assert survivor.result(timeout=30)["echo"][0] == pytest.approx(4.0)
+        assert profiling.counter("serving.echo_to.timeouts") >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_drain_and_shutdown_are_clean():
+    srv = ModelServer("echo_drain", _EchoModel(delay_s=0.05), max_batch=4, max_wait_ms=50)
+    futs = [srv.submit(np.ones(4, np.float32)) for _ in range(6)]
+    srv.drain()  # flushes the partial batch immediately (quiescence)
+    for f in futs:
+        assert f.done()
+    with pytest.raises(RuntimeError, match="shut down"):
+        srv.submit(np.ones(4, np.float32))
+    srv.shutdown()
+    assert not srv._worker.is_alive()
+
+
+def test_dispatch_error_fails_the_batch_not_the_server():
+    class _Flaky(_EchoModel):
+        def _serving_entry(self, mesh=None):
+            entry = super()._serving_entry(mesh)
+            calls = {"n": 0}
+            inner = entry.call
+
+            def call(batch):
+                calls["n"] += 1
+                if calls["n"] == 4:  # first post-warmup dispatch fails
+                    raise RuntimeError("boom")
+                return inner(batch)
+
+            entry.call = call
+            return entry
+
+    srv = ModelServer("echo_flaky", _Flaky(), max_batch=64, max_wait_ms=1)
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            srv.predict(np.ones(4, np.float32))
+        assert profiling.counter("serving.echo_flaky.errors") == 1
+        # the worker survives and serves the next request
+        out = srv.predict(np.ones(4, np.float32))
+        assert out["echo"][0] == pytest.approx(4.0)
+    finally:
+        srv.shutdown()
+
+
+# -- real models: equivalence + zero-new-compiles steady state ----------------
+
+
+def _direct_transform(model, X):
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=1)
+    if model.hasParam("featuresCol"):
+        model.setFeaturesCol("features")
+    out = model.transform(df)
+    return {
+        c: np.asarray(list(out.partitions[0][c]))
+        for c in out.columns
+        if c != "features"
+    }
+
+
+@pytest.mark.parametrize("arm", SERVED_ARMS)
+def test_served_outputs_match_batch_transform(arm, model_zoo):
+    model, X = model_zoo(arm)
+    expect = _direct_transform(model, X[:10])
+    with ModelServer(f"eq_{arm}", model, max_batch=32, max_wait_ms=2) as srv:
+        got = srv.predict(X[:10])
+        assert sorted(got) == sorted(expect)
+        for col in expect:
+            np.testing.assert_allclose(
+                np.asarray(got[col], np.float64),
+                np.asarray(expect[col], np.float64),
+                rtol=1e-5,
+                atol=1e-5,
+                err_msg=f"{arm}: column {col!r} diverged from transform()",
+            )
+        srv.drain()
+        srv.assert_steady_state()
+
+
+def test_served_knn_matches_kneighbors(model_zoo):
+    model, X = model_zoo("knn")
+    _, _, knn_df = model.kneighbors(
+        __import__("spark_rapids_ml_tpu.dataframe", fromlist=["DataFrame"])
+        .DataFrame.from_numpy(X[:8], num_partitions=1)
+    )
+    expect_ids = np.asarray(list(knn_df.partitions[0]["indices"]))
+    expect_d = np.asarray(list(knn_df.partitions[0]["distances"]))
+    with ModelServer("eq_knn", model, max_batch=32, max_wait_ms=2) as srv:
+        got = srv.predict(X[:8])
+        assert np.array_equal(got["indices"], expect_ids)
+        np.testing.assert_allclose(got["distances"], expect_d, rtol=1e-5, atol=1e-5)
+        srv.drain()
+        srv.assert_steady_state()
+
+
+def test_steady_state_zero_new_compiles(model_zoo):
+    """The acceptance gate: after warmup, a mixed stream of single-row and
+    small-batch requests across every bucket performs ZERO new executable
+    compilations (precompile compile/fallback counters frozen)."""
+    model, X = model_zoo("kmeans")
+    srv = ModelServer("steady_km", model, max_batch=64, max_wait_ms=2)
+    try:
+        before = profiling.counters("precompile.")
+        rng = np.random.default_rng(3)
+        for size in (1, 1, 3, 17, 33, 64, 5, 1, 64):
+            srv.predict(
+                rng.standard_normal((size, X.shape[1])).astype(np.float32)
+            )
+        delta = profiling.counter_deltas(before, "precompile.")
+        assert delta.get("precompile.compile", 0) == 0, delta
+        assert delta.get("precompile.fallback", 0) == 0, delta
+        srv.drain()
+        srv.assert_steady_state()
+        assert profiling.counter("serving.steady_km.steady_compiles") == 0
+    finally:
+        srv.shutdown()
+
+
+def test_latency_percentiles_surface(model_zoo):
+    model, X = model_zoo("linreg")
+    with ModelServer("slo_lin", model, max_batch=32, max_wait_ms=2) as srv:
+        for i in range(12):
+            srv.predict(X[i])
+        stats = srv.stats()
+    lat = stats["latency"]
+    assert lat["count"] >= 12
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    assert stats["counters"]["serving.slo_lin.requests"] >= 12
+    assert stats["buckets"] == serve_buckets(32)
+
+
+# -- registry -----------------------------------------------------------------
+
+
+def test_registry_register_get_unregister(model_zoo):
+    model, X = model_zoo("kmeans")
+    with ModelRegistry(max_batch=32, max_wait_ms=2) as reg:
+        srv = reg.register("km", model)
+        assert "km" in reg and reg.get("km") is srv
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register("km", model)
+        out = reg.get("km").predict(X[:3])
+        assert out["prediction"].shape == (3,)
+        assert reg.names() == ["km"]
+        assert "km" in reg.stats()
+        reg.unregister("km")
+        with pytest.raises(KeyError):
+            reg.get("km")
+
+
+def test_registry_loads_saved_models_and_serves(model_zoo, tmp_path):
+    """The registry's load path: core.load resolves the class from
+    metadata, the server warms at load, outputs match the in-memory
+    model's transform (the persistence-matrix fixture doing double duty)."""
+    with ModelRegistry(max_batch=32, max_wait_ms=2) as reg:
+        for arm in ("kmeans", "rf_clf"):
+            model, X = model_zoo(arm)
+            path = str(tmp_path / arm)
+            model.save(path)
+            srv = reg.load(arm, path)
+            got = srv.predict(X[:6])
+            expect = _direct_transform(model, X[:6])
+            for col in expect:
+                np.testing.assert_allclose(
+                    np.asarray(got[col], np.float64),
+                    np.asarray(expect[col], np.float64),
+                    rtol=1e-5,
+                    atol=1e-5,
+                )
+            srv.drain()
+            srv.assert_steady_state()
+
+
+def test_registry_rejects_estimators(tmp_path):
+    from spark_rapids_ml_tpu import KMeans
+
+    est = KMeans(k=2)
+    path = str(tmp_path / "est")
+    est.save(path)
+    with ModelRegistry() as reg:
+        with pytest.raises(TypeError, match="not a fitted model"):
+            reg.load("est", path)
+
+
+def test_unservable_model_gives_actionable_error(model_zoo):
+    model, _X = model_zoo("umap")  # no serving entry (transform-only)
+    with pytest.raises(NotImplementedError, match="no serving entry"):
+        ModelServer("umap", model)
