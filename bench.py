@@ -44,6 +44,7 @@ REF_GPU_SECONDS = {
     "linreg": 32.0,   # ridge configuration (fastest GPU arm)
     "logreg": 69.0,
     "knn": 82.0,      # no published kNN bar; reuse the kmeans-scale bar as a floor
+    "ann": 82.0,      # no published ANN bar either; same kmeans-scale floor
     "rf_clf": 59.0,
     "rf_reg": 52.0,
     "umap": 82.0,     # no published UMAP bar; kmeans-scale floor like knn
@@ -58,7 +59,7 @@ REF_GPU_SECONDS = {
 # that is the whole point of the normalized metric)
 CYCLE_ARMS = [
     "kmeans", "pca", "linreg", "logreg", "logreg_sparse",
-    "knn", "rf_reg", "rf_clf", "umap",
+    "knn", "ann", "rf_reg", "rf_clf", "umap",
 ]
 CYCLE_OVERRIDES = {
     # 1M x 100 sparse (the BASELINE.json shape family, 4x smaller)
@@ -356,6 +357,55 @@ def build_arm(algo: str, overrides):
         # throughput counts completed query rows
         return fit, f"knn_query_throughput_n{rows}_d{cols}_k{k}", n_query
 
+    if algo == "ann":
+        # IVF-Flat probed query throughput (srml-ann).  Shape: the ANN
+        # regime is many rows x embedding-scale dims (the exact arm's
+        # 3000-col FLOP wall is exactly what IVF probing removes), so the
+        # arm defaults to 400k x 256 clustered rows.  The timed region is
+        # the PUBLIC model.kneighbors probed search with the index staged
+        # and kernels warm (the warmup call); index build (quantizer +
+        # assignment + layout + upload) lands in cold_sec.  recall@k vs
+        # the exact path is measured by benchmark/bench_approximate_nn.py
+        # on the same engine and asserted >= 0.95 in tests — this arm
+        # reports throughput at the documented operating point.
+        k = int(_ov("SRML_BENCH_K", 200))
+        rows = int(_ov("SRML_BENCH_ROWS", 400_000 if on_accel else 20_000))
+        cols = int(_ov("SRML_BENCH_COLS", 256 if on_accel else 64))
+        n_query = int(_ov("SRML_BENCH_QUERIES", min(rows, 16384)))
+        from spark_rapids_ml_tpu import ApproximateNearestNeighbors
+        from spark_rapids_ml_tpu.ann.ivfflat import default_nlist, default_nprobe
+        from spark_rapids_ml_tpu.dataframe import DataFrame
+
+        nlist = int(_ov("SRML_BENCH_NLIST", default_nlist(rows)))
+        nprobe = int(_ov("SRML_BENCH_NPROBE", default_nprobe(nlist)))
+        # clustered items (the workload IVF exists for; uniform data would
+        # spread every query's true neighbors over all lists and report a
+        # recall no real embedding table exhibits)
+        n_blobs = max(32, nlist)
+        centers_h = 10.0 * rng.standard_normal((n_blobs, cols), dtype=np.float32)
+        lab = rng.integers(0, n_blobs, size=rows)
+        X_host = centers_h[lab] + rng.standard_normal(
+            (rows, cols), dtype=np.float32
+        )
+        item_bdf = DataFrame.from_numpy(X_host)
+        query_bdf = DataFrame.from_numpy(X_host[:n_query].copy())
+        est = ApproximateNearestNeighbors(
+            k=k, algoParams={"nlist": nlist, "nprobe": nprobe}
+        ).setInputCol("features")
+        model = est.fit(item_bdf)  # index build: untimed setup (cold_sec
+        # still captures staging + compiles via the warmup call)
+
+        def fit():
+            _, _, knn_df = model.kneighbors(query_bdf)
+            d0 = knn_df.partitions[0]["distances"].iloc[0]
+            return float(np.asarray(d0).ravel()[0])
+
+        return (
+            fit,
+            f"ann_query_throughput_n{rows}_d{cols}_k{k}_l{nlist}_p{nprobe}",
+            n_query,
+        )
+
     on_accel_rf = algo in ("rf_clf", "rf_reg") and on_accel
     if on_accel_rf:
         # the reference's published regressor arm: 30 trees, bins=128,
@@ -480,6 +530,13 @@ def build_arm(algo: str, overrides):
 # round-4: the caveat lived only in comments, so cross-framework
 # comparisons could silently drop it)
 ARM_NOTES = {
+    "ann": (
+        "probed IVF-Flat search at the documented operating point "
+        "(nlist/nprobe in the metric label) on clustered data; index build "
+        "is untimed setup; recall@k vs the exact path is gated >= 0.95 in "
+        "tests/test_ann_engine.py and reported per-run by "
+        "benchmark/bench_approximate_nn.py"
+    ),
     "knn": (
         "timed region is model.kneighbors with the item index and query "
         "upload pre-seeded in the model staging caches (the steady state "
@@ -494,7 +551,7 @@ ARM_NOTES = {
 # congestion (BENCH_r05) — more samples tighten the median without touching
 # the timed region itself.  Applied as a floor so SRML_BENCH_REPEATS can
 # still raise everything globally.
-ARM_MIN_REPEATS = {"knn": 7}
+ARM_MIN_REPEATS = {"knn": 7, "ann": 7}  # short timed regions, same spread risk
 
 
 def run_arm(algo: str, overrides, repeats: int):

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from .bench_approximate_nn import BenchmarkApproximateNearestNeighbors
 from .bench_kmeans import BenchmarkKMeans
 from .bench_linear_regression import BenchmarkLinearRegression
 from .bench_logistic_regression import BenchmarkLogisticRegression
@@ -27,6 +28,7 @@ from .bench_umap import BenchmarkUMAP
 class BenchmarkRunner:
     def __init__(self) -> None:
         registered = {
+            "approximate_nearest_neighbors": BenchmarkApproximateNearestNeighbors,
             "kmeans": BenchmarkKMeans,
             "knn": BenchmarkNearestNeighbors,
             "linear_regression": BenchmarkLinearRegression,

@@ -111,6 +111,43 @@ for r in recs:
 EOF
 rm -rf "$SERVE_SMOKE"
 
+# 3f. focused gates for the srml-ann IVF-Flat subsystem (also inside the
+#     full suite; re-asserted by name so marker drift can never silently
+#     drop them).  Runs on the 8-device CPU mesh, forced explicitly:
+#     - recall@10 >= 0.95 vs the exact kneighbors path at the documented
+#       nprobe on clustered data (the acceptance gate)
+#     - BITWISE 1-device-vs-8-device mesh parity of probed results
+#       (lexicographic (d2, pos) selection — extends the UMAP/RF matrix)
+#     - repeat same-shape probed search performs ZERO new compilations,
+#       and the warm path covers the exact dispatch key
+#     - the SRML_UMAP_ANN=ivfflat knob keeps k=15 neighbor preservation
+#       within the established 1% of the exact-graph layout
+#     plus a graftlint-clean re-check of the ann modules by name and a
+#     bench_approximate_nn smoke asserting recall/qps columns + zero
+#     steady-state compiles on tiny clustered data.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_ann_engine.py -q
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_umap_engine.py -q -k ann_graph
+python -m tools.graftlint spark_rapids_ml_tpu/ann \
+    spark_rapids_ml_tpu/models/approximate_nn.py \
+    spark_rapids_ml_tpu/metrics/binary.py benchmark/bench_approximate_nn.py
+ANN_SMOKE=$(mktemp -d)
+python -m benchmark.gen_data blobs --num_rows 2000 --num_cols 16 --n_clusters 8 \
+    --output_dir "$ANN_SMOKE/blobs" --output_num_files 2
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.benchmark_runner approximate_nearest_neighbors \
+    --train_path "$ANN_SMOKE/blobs" --k 10 --nlist 8 --nprobe 4 \
+    --report_path "$ANN_SMOKE/ann.jsonl"
+python - "$ANN_SMOKE/ann.jsonl" <<'EOF'
+import json, sys
+rec = json.loads(open(sys.argv[1]).readline())
+assert rec["recall_at_k"] >= 0.95, rec
+assert rec["qps"] > 0 and "speedup_vs_exact" in rec, rec
+assert rec["steady_compiles"] == 0, rec
+EOF
+rm -rf "$ANN_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

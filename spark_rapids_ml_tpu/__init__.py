@@ -22,6 +22,8 @@ __all__ = [
     "RandomForestRegressionModel",
     "NearestNeighbors",
     "NearestNeighborsModel",
+    "ApproximateNearestNeighbors",
+    "ApproximateNearestNeighborsModel",
     "UMAP",
     "UMAPModel",
     "CrossValidator",
@@ -48,6 +50,8 @@ def __getattr__(name):  # lazy re-exports keep `import spark_rapids_ml_tpu` ligh
         "RandomForestRegressionModel": ".models.random_forest",
         "NearestNeighbors": ".models.knn",
         "NearestNeighborsModel": ".models.knn",
+        "ApproximateNearestNeighbors": ".models.approximate_nn",
+        "ApproximateNearestNeighborsModel": ".models.approximate_nn",
         "UMAP": ".models.umap",
         "UMAPModel": ".models.umap",
         "CrossValidator": ".tuning",

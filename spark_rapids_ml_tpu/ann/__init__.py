@@ -1,0 +1,36 @@
+#
+# srml-ann: approximate nearest-neighbor engines (layer peer of serving/).
+#
+# First tier: IVF-Flat (ivfflat.py) — a coarse k-means quantizer partitions
+# the item set into inverted lists; queries probe only the nprobe nearest
+# lists, turning the exact engine's O(items x queries) scan into
+# O(nprobe * list_len * queries) with a recall knob.  The engine is built
+# FROM the primitives PRs 2-5 hardened: the kmeans engine trains the
+# quantizer, the fused distance+argmin kernel assigns lists, probed search
+# rides the kNN block pipeline, and every kernel dispatches through the
+# process-wide AOT executable cache.
+#
+
+from .ivfflat import (
+    IVFFlatIndex,
+    PackedIVF,
+    build_ivfflat_packed,
+    default_nlist,
+    default_nprobe,
+    index_from_packed,
+    ivfflat_search_prepared,
+    recall_at_k,
+    warm_probe_kernels,
+)
+
+__all__ = [
+    "IVFFlatIndex",
+    "PackedIVF",
+    "build_ivfflat_packed",
+    "default_nlist",
+    "default_nprobe",
+    "index_from_packed",
+    "ivfflat_search_prepared",
+    "recall_at_k",
+    "warm_probe_kernels",
+]

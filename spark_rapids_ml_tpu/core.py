@@ -842,7 +842,7 @@ class _TpuModel(_TpuParams):
         raise NotImplementedError(
             f"{type(self).__name__} has no serving entry; servable models "
             "are KMeans/PCA/LinearRegression/LogisticRegression/"
-            "RandomForest*/NearestNeighbors"
+            "RandomForest*/NearestNeighbors/ApproximateNearestNeighbors"
         )
 
     # -- multi-model -------------------------------------------------------

@@ -257,7 +257,12 @@ class ClusteringEvaluator(Evaluator, HasFeaturesCol, HasPredictionCol):
 class BinaryClassificationEvaluator(
     Evaluator, HasLabelCol, HasRawPredictionCol, HasWeightCol
 ):
-    """areaUnderROC / areaUnderPR over the rawPrediction column."""
+    """areaUnderROC / areaUnderPR over the rawPrediction column, computed
+    from mergeable per-partition threshold partials
+    (metrics/binary.BinaryClassificationMetrics) — live Spark frames score
+    executor-side like the round-5 ClusteringEvaluator; only the per-
+    distinct-score weighted counts ever reach the driver (the old path
+    collected the whole prediction frame)."""
 
     metricName = Param(_dummy(), "metricName", "metric name in evaluation (areaUnderROC|areaUnderPR)", TypeConverters.toString)
 
@@ -270,21 +275,49 @@ class BinaryClassificationEvaluator(
     def getMetricName(self) -> str:
         return self.getOrDefault("metricName")
 
+    def setMetricName(self, value: str) -> "BinaryClassificationEvaluator":
+        self.set(self.getParam("metricName"), value)
+        return self
+
     def setLabelCol(self, value: str) -> "BinaryClassificationEvaluator":
         self.set(self.getParam("labelCol"), value)
         return self
 
-    def evaluate(self, dataset: Any) -> float:
-        from sklearn.metrics import average_precision_score, roc_auc_score
+    def setRawPredictionCol(self, value: str) -> "BinaryClassificationEvaluator":
+        self.set(self.getParam("rawPredictionCol"), value)
+        return self
 
-        df = as_dataframe(dataset)
-        pdf = df.toPandas()
-        labels = pdf[self.getOrDefault("labelCol")].to_numpy()
+    def _partial_metrics_frame(self, pdf: Any):
+        """One partition's mergeable (scores, pos_w, neg_w) partial — the
+        ONE extraction shared by the local loop below and the executor-side
+        UDF (spark/adapter.executor_evaluate)."""
+        from .metrics.binary import BinaryClassificationMetrics
+
         raw = pdf[self.getOrDefault("rawPredictionCol")].to_numpy()
         if raw.dtype == object:
             raw = np.stack(raw)[:, -1]  # score of the positive class
-        if self.getMetricName() == "areaUnderROC":
-            return float(roc_auc_score(labels, raw))
-        if self.getMetricName() == "areaUnderPR":
-            return float(average_precision_score(labels, raw))
-        raise ValueError(f"Unsupported metric name, found {self.getMetricName()}")
+        weight_col = (
+            self.getOrDefault("weightCol")
+            if self.hasParam("weightCol") and self.isSet("weightCol")
+            else None
+        )
+        weights = (
+            pdf[weight_col].to_numpy() if weight_col is not None else None
+        )
+        return BinaryClassificationMetrics.from_arrays(
+            pdf[self.getOrDefault("labelCol")].to_numpy(), raw, weights
+        )
+
+    def evaluate(self, dataset: Any) -> float:
+        spark_score = self._evaluate_executor_side(dataset)
+        if spark_score is not None:
+            return spark_score
+        df = as_dataframe(dataset)
+        metrics = None
+        for part in df.partitions:
+            if len(part) == 0:
+                continue
+            m = self._partial_metrics_frame(part)
+            metrics = m if metrics is None else metrics.merge(m)
+        assert metrics is not None, "empty dataset"
+        return metrics.evaluate(self)

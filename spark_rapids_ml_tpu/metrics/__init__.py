@@ -31,6 +31,7 @@ class EvalMetricInfo:
 
 from .regression import RegressionMetrics, _SummarizerBuffer  # noqa: E402
 from .multiclass import MulticlassMetrics, log_loss  # noqa: E402
+from .binary import BinaryClassificationMetrics  # noqa: E402
 
 __all__ = [
     "EvalMetricInfo",
@@ -38,5 +39,6 @@ __all__ = [
     "RegressionMetrics",
     "_SummarizerBuffer",
     "MulticlassMetrics",
+    "BinaryClassificationMetrics",
     "log_loss",
 ]

@@ -1,0 +1,415 @@
+#
+# ApproximateNearestNeighbors estimator/model (IVF-Flat).
+#
+# Param-surface parity with the reference's ApproximateNearestNeighbors
+# (cuML algorithm='ivfflat', algoParams={'nlist', 'nprobe'}): fit TRAINS the
+# coarse quantizer and packs the inverted lists (unlike the exact
+# NearestNeighbors, whose fit only captures the frame — an ANN index is a
+# real artifact), kneighbors runs the probed search, and `exactSearch=True`
+# routes through the exact brute-force engine over the same packed items (a
+# recall-vs-latency escape hatch that shares ids with the probed path).
+# Unlike the exact model, this model IS persistable: the packed index
+# (items sorted by list, ids, per-list counts, centroids) rides the core
+# npz persistence path and restages onto whatever mesh loads it.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from ..ann.ivfflat import (
+    PackedIVF,
+    build_ivfflat_packed,
+    default_nlist,
+    default_nprobe,
+    index_from_packed,
+    ivfflat_search_prepared,
+    warm_probe_kernels,
+)
+from ..core import _TpuEstimatorSupervised, _TpuModel
+from ..dataframe import DataFrame, as_dataframe
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    Param,
+    TypeConverters,
+    _dummy,
+    _TpuParams,
+)
+from ..parallel.mesh import get_mesh
+
+_ALGO_PARAM_KEYS = {"nlist", "nprobe"}
+
+
+class ApproximateNearestNeighborsClass(_TpuParams):
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {"k": "n_neighbors", "algorithm": "algorithm"}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_neighbors": 5,
+            "verbose": False,
+            "algorithm": "ivfflat",
+            "metric": "euclidean",
+        }
+
+
+class _ApproximateNearestNeighborsParams(
+    ApproximateNearestNeighborsClass, HasFeaturesCol, HasFeaturesCols
+):
+    k = Param(_dummy(), "k", "the number of nearest neighbors to retrieve (> 0)", TypeConverters.toInt)
+    idCol = Param(_dummy(), "idCol", "id column name; if unset a monotonically increasing id column is generated", TypeConverters.toString)
+    algorithm = Param(_dummy(), "algorithm", "the ANN algorithm (only 'ivfflat' is supported)", TypeConverters.toString)
+    algoParams = Param(_dummy(), "algoParams", "algorithm parameters: {'nlist': coarse lists, 'nprobe': probed lists per query}", TypeConverters.identity)
+    exactSearch = Param(_dummy(), "exactSearch", "route kneighbors through the exact brute-force engine over the indexed items (recall escape hatch)", TypeConverters.toBoolean)
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._setDefault(k=5, algorithm="ivfflat", exactSearch=False)
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def setK(self, value: int):
+        return self._set_params(k=value)
+
+    def getIdCol(self) -> str:
+        return self.getOrDefault("idCol") if self.isDefined("idCol") else "unique_id"
+
+    def setIdCol(self, value: str):
+        self.set(self.getParam("idCol"), value)
+        return self
+
+    def getAlgorithm(self) -> str:
+        return self.getOrDefault("algorithm")
+
+    def setAlgorithm(self, value: str):
+        return self._set_params(algorithm=value)
+
+    def getAlgoParams(self) -> Optional[Dict[str, int]]:
+        return self.getOrDefault("algoParams") if self.isDefined("algoParams") else None
+
+    def setAlgoParams(self, value: Dict[str, int]):
+        self.set(self.getParam("algoParams"), value)
+        return self
+
+    def getExactSearch(self) -> bool:
+        return self.getOrDefault("exactSearch")
+
+    def setExactSearch(self, value: bool):
+        self.set(self.getParam("exactSearch"), value)
+        return self
+
+    def setInputCol(self, value: Union[str, List[str]]):
+        if isinstance(value, str):
+            self._set_params(featuresCol=value)
+        else:
+            self._set_params(featuresCols=value)
+        return self
+
+    def _resolved_algo_params(self, n_items: int, n_lists: int = None) -> Tuple[int, int]:
+        """(nlist, nprobe) with the documented defaults (ann/ivfflat
+        default_nlist/default_nprobe) filling unset keys; unknown keys are
+        a hard error (a typo'd 'nprobes' must not silently probe 1/4)."""
+        ap = dict(self.getAlgoParams() or {})
+        unknown = set(ap) - _ALGO_PARAM_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown algoParams {sorted(unknown)}; supported: "
+                f"{sorted(_ALGO_PARAM_KEYS)}"
+            )
+        nlist = int(ap.get("nlist", n_lists or default_nlist(n_items)))
+        nprobe = int(ap.get("nprobe", default_nprobe(nlist)))
+        if nlist < 1 or nprobe < 1:
+            raise ValueError(
+                f"nlist ({nlist}) and nprobe ({nprobe}) must be >= 1"
+            )
+        return nlist, nprobe
+
+    def _check_algorithm(self) -> None:
+        if self.getAlgorithm() != "ivfflat":
+            raise ValueError(
+                f"algorithm={self.getAlgorithm()!r} is not supported; only "
+                "'ivfflat' is implemented (the first ANN tier)"
+            )
+
+
+class ApproximateNearestNeighbors(
+    _ApproximateNearestNeighborsParams, _TpuEstimatorSupervised
+):
+    """IVF-Flat approximate kNN over the TPU mesh (ann/ivfflat.py): the
+    kmeans engine trains the coarse quantizer, the fused distance+argmin
+    kernel assigns lists, and probed search rides the kNN block pipeline
+    with a recall knob (nprobe)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._initialize_tpu_params()
+        self._set_params(**kwargs)
+
+    def _fit(self, dataset: Any) -> "ApproximateNearestNeighborsModel":
+        from ..core import _use_executor_path, extract_partition_features
+
+        self._check_algorithm()
+        if getattr(dataset, "_device_features", None) is not None:
+            raise NotImplementedError(
+                "ApproximateNearestNeighbors.fit does not take "
+                "DataFrame.from_device frames (their features column is a "
+                "placeholder); fit a host frame instead"
+            )
+        if _use_executor_path(dataset):
+            raise NotImplementedError(
+                "ApproximateNearestNeighbors builds its index in-process; "
+                "collect the pyspark item dataframe (SRML_SPARK_COLLECT=1) "
+                "before fitting"
+            )
+        df = as_dataframe(dataset)
+        id_col = self.getIdCol()
+        if id_col not in df.columns:
+            df = df.with_row_id(id_col)
+        self._validate_parameters(df)
+        input_col, input_cols = self._get_input_columns()
+        feats, ids = [], []
+        for part in df.partitions:
+            if len(part) == 0:
+                continue
+            feats.append(
+                extract_partition_features(
+                    part, input_col, input_cols, np.float32
+                )
+            )
+            ids.append(np.asarray(part[id_col].to_numpy(), np.int64))
+        if not feats:
+            raise RuntimeError("Dataset is empty; cannot build an IVF index")
+        X = np.concatenate(feats) if len(feats) > 1 else feats[0]
+        item_ids = np.concatenate(ids) if len(ids) > 1 else ids[0]
+        nlist, _nprobe = self._resolved_algo_params(X.shape[0])
+        packed = build_ivfflat_packed(X, item_ids, nlist, seed=0)
+        model = ApproximateNearestNeighborsModel(
+            centroids_=packed.centroids,
+            packed_items_=packed.items,
+            packed_ids_=packed.ids,
+            list_counts_=packed.counts,
+            n_lists=packed.n_lists,
+            n_items=packed.n_items,
+            n_cols=int(X.shape[1]),
+            dtype="float32",
+        )
+        self._copyValues(model)
+        model._tpu_params.update(self._tpu_params)
+        model._num_workers = self._num_workers
+        model._float32_inputs = self._float32_inputs
+        model._item_df = df
+        return model
+
+    def fit(
+        self, dataset: Any, params: Optional[Dict] = None
+    ) -> "ApproximateNearestNeighborsModel":
+        return self._fit(dataset)
+
+    def _get_tpu_fit_func(self, dataset, extra_params=None):  # pragma: no cover
+        raise NotImplementedError("ApproximateNearestNeighbors overrides _fit")
+
+    def _create_model(self, result):  # pragma: no cover
+        raise NotImplementedError("ApproximateNearestNeighbors overrides _fit")
+
+
+class ApproximateNearestNeighborsModel(
+    _ApproximateNearestNeighborsParams, _TpuModel
+):
+    """A fitted IVF-Flat index.  Persistable through the core npz path (the
+    packed layout is mesh-independent; staging expands it per mesh); a
+    loaded model answers kneighbors without the original item frame."""
+
+    def __init__(
+        self,
+        centroids_: np.ndarray,
+        packed_items_: np.ndarray,
+        packed_ids_: np.ndarray,
+        list_counts_: np.ndarray,
+        n_lists: int,
+        n_items: int,
+        n_cols: int,
+        dtype: str = "float32",
+    ) -> None:
+        super().__init__(
+            centroids_=np.asarray(centroids_),
+            packed_items_=np.asarray(packed_items_),
+            packed_ids_=np.asarray(packed_ids_),
+            list_counts_=np.asarray(list_counts_),
+            n_lists=int(n_lists),
+            n_items=int(n_items),
+            n_cols=int(n_cols),
+            dtype=str(dtype),
+        )
+        self.centroids_ = np.asarray(centroids_, np.float32)
+        self.packed_items_ = np.asarray(packed_items_, np.float32)
+        self.packed_ids_ = np.asarray(packed_ids_, np.int64)
+        self.list_counts_ = np.asarray(list_counts_, np.int64)
+        self.n_lists = int(n_lists)
+        self.n_items = int(n_items)
+        self.n_cols = int(n_cols)
+        self.dtype = str(dtype)
+        self._item_df: Optional[DataFrame] = None
+        # per-mesh staging caches (die with the model, like the exact
+        # model's _staged_items): the probed index and the exactSearch
+        # prepared item set
+        self._staged_index: Optional[Tuple[Any, Any]] = None
+        self._staged_exact: Optional[Tuple[Any, Any]] = None
+
+    def _packed(self) -> PackedIVF:
+        return PackedIVF(
+            self.packed_items_,
+            self.packed_ids_,
+            self.list_counts_,
+            self.centroids_,
+            self.n_lists,
+            self.n_items,
+        )
+
+    def _mesh_key(self, mesh) -> Tuple:
+        from ..ops.precompile import mesh_fingerprint
+
+        # value identity, not object identity: get_mesh builds fresh Mesh
+        # objects per call, and a re-staged identical mesh must HIT
+        return mesh_fingerprint(mesh)
+
+    def _ensure_staged_index(self, mesh):
+        key = self._mesh_key(mesh)
+        if self._staged_index is None or self._staged_index[0] != key:
+            self._staged_index = (key, index_from_packed(self._packed(), mesh))
+        return self._staged_index[1]
+
+    def _ensure_staged_exact(self, mesh):
+        from ..ops.knn import prepare_items
+
+        key = self._mesh_key(mesh)
+        if self._staged_exact is None or self._staged_exact[0] != key:
+            self._staged_exact = (
+                key,
+                prepare_items(self.packed_items_, self.packed_ids_, mesh),
+            )
+        return self._staged_exact[1]
+
+    def kneighbors(
+        self, query_df: Any
+    ) -> Tuple[Optional[DataFrame], DataFrame, DataFrame]:
+        """Probed approximate k nearest items for every query row (float32
+        euclidean, same output frame as the exact model's kneighbors:
+        (item_df — None on a loaded model, query_df_withid, knn_df with
+        query_<id>, indices, distances)).  exactSearch=True routes through
+        the exact engine over the same indexed items, so the two paths
+        share the id space and recall_at_k can gate one against the
+        other."""
+        from ..core import _is_pyspark_dataframe, extract_partition_features
+
+        self._check_algorithm()
+        if _is_pyspark_dataframe(query_df):
+            raise NotImplementedError(
+                "ApproximateNearestNeighborsModel serves in-process query "
+                "frames; collect the pyspark frame (SRML_SPARK_COLLECT=1) "
+                "first"
+            )
+        qdf = as_dataframe(query_df)
+        id_col = self.getIdCol()
+        if id_col not in qdf.columns:
+            qdf = qdf.with_row_id(id_col)
+        input_col, input_cols = self._get_input_columns()
+        mesh = get_mesh(self.num_workers)
+        k = self.getK()
+        _nlist, nprobe = self._resolved_algo_params(
+            self.n_items, n_lists=self.n_lists
+        )
+        exact = self.getExactSearch()
+        if exact:
+            from ..ops.knn import knn_search_prepared
+
+            prepared = self._ensure_staged_exact(mesh)
+        else:
+            index = self._ensure_staged_index(mesh)
+        out_parts = []
+        for part in qdf.partitions:
+            if len(part) == 0:
+                out_parts.append(
+                    pd.DataFrame(
+                        {f"query_{id_col}": [], "indices": [], "distances": []}
+                    )
+                )
+                continue
+            feats = extract_partition_features(
+                part, input_col, input_cols, np.float32
+            )
+            if exact:
+                dists, ids = knn_search_prepared(prepared, feats, k, mesh)
+            else:
+                dists, ids = ivfflat_search_prepared(
+                    index, feats, k, nprobe, mesh
+                )
+            out_parts.append(
+                pd.DataFrame(
+                    {
+                        f"query_{id_col}": part[id_col].to_numpy(),
+                        "indices": list(np.asarray(ids)),
+                        "distances": list(np.asarray(dists, np.float32)),
+                    }
+                )
+            )
+        return self._item_df, qdf, DataFrame(out_parts)
+
+    def _get_tpu_transform_func(self, dataset):  # pragma: no cover
+        raise NotImplementedError(
+            "ApproximateNearestNeighborsModel has no transform; use "
+            "kneighbors instead."
+        )
+
+    def _serving_entry(self, mesh: Any = None):
+        """Online ANN hook (serving/): each coalesced batch is ONE probed
+        ivfflat_search_prepared call against the staged index; warm submits
+        the probe-kernel geometry for every engine bucket (the engine's
+        pow2 buckets feed the search's own >=64 query-block rule, same
+        contract as the exact kNN entry)."""
+        from ..serving.entry import ServingEntry
+
+        self._check_algorithm()
+        mesh = mesh or get_mesh(self.num_workers)
+        index = self._ensure_staged_index(mesh)
+        k = self.getK()
+        _nlist, nprobe = self._resolved_algo_params(
+            self.n_items, n_lists=self.n_lists
+        )
+        dtype = np.dtype(np.float32)
+
+        def call(batch: np.ndarray) -> Dict[str, np.ndarray]:
+            dists, ids = ivfflat_search_prepared(index, batch, k, nprobe, mesh)
+            return {
+                "indices": np.asarray(ids),
+                "distances": np.asarray(dists, dtype=np.float32),
+            }
+
+        def warm(buckets) -> list:
+            keys = []
+            for b in sorted({max(int(x), 64) for x in buckets}):
+                keys.extend(
+                    warm_probe_kernels(index, k, nprobe, mesh, n_queries=b)
+                )
+            return keys
+
+        return ServingEntry(
+            name="serve.ann",
+            n_cols=int(self.n_cols),
+            dtype=dtype,
+            out_cols=["indices", "distances"],
+            call=call,
+            warm=warm,
+            info={
+                "k": int(min(k, self.n_items)),
+                "n_items": int(self.n_items),
+                "nlist": int(self.n_lists),
+                "nprobe": int(nprobe),
+            },
+        )

@@ -49,6 +49,58 @@ from ..ops.umap import (
 from ..utils import get_logger
 
 
+def _umap_ann_mode() -> str:
+    """SRML_UMAP_ANN routes the graph phase's kNN self-join: "" (default)
+    keeps the exact engine; "ivfflat" uses the srml-ann IVF-Flat engine."""
+    import os
+
+    mode = os.environ.get("SRML_UMAP_ANN", "")
+    if mode not in ("", "ivfflat"):
+        raise ValueError(
+            f"SRML_UMAP_ANN={mode!r} is not supported (only 'ivfflat')"
+        )
+    return mode
+
+
+def _ann_self_join(X: np.ndarray, k: int, mesh, seed: int):
+    """(dists, ids) kNN self-join via the IVF-Flat engine (ann/ivfflat.py).
+    nlist defaults to sqrt(n); nprobe defaults to HALF the lists — the
+    graph phase feeds the layout's attraction edges, so it trades less
+    speedup for recall headroom vs the serving default (a quarter).  Env
+    overrides: SRML_UMAP_ANN_NLIST / SRML_UMAP_ANN_NPROBE."""
+    import os
+
+    from ..ann.ivfflat import (
+        build_ivfflat_packed,
+        default_nlist,
+        index_from_packed,
+        ivfflat_search_prepared,
+    )
+
+    n = X.shape[0]
+    nlist = int(os.environ.get("SRML_UMAP_ANN_NLIST", 0)) or default_nlist(n)
+    nprobe = int(os.environ.get("SRML_UMAP_ANN_NPROBE", 0)) or max(
+        8, nlist // 2
+    )
+    packed = build_ivfflat_packed(
+        X, np.arange(n, dtype=np.int64), nlist, seed=seed
+    )
+    index = index_from_packed(packed, mesh)
+    dists, ids = ivfflat_search_prepared(
+        index, X, k, nprobe, mesh, query_block=32768
+    )
+    if (ids < 0).any():
+        # the graph assembly consumes ids as dense row indices; a -1
+        # unfillable slot (probed lists held < k candidates for some row)
+        # must fail loudly, not gather garbage edges
+        raise RuntimeError(
+            "IVF-Flat self-join returned unfillable neighbor slots at "
+            f"nlist={nlist} nprobe={nprobe}; raise SRML_UMAP_ANN_NPROBE "
+            "(or unset SRML_UMAP_ANN to use the exact graph)"
+        )
+    return dists, ids
+
+
 class UMAPClass(_TpuParams):
     @classmethod
     def _param_mapping(cls) -> Dict[str, Optional[str]]:
@@ -228,6 +280,19 @@ class UMAP(_UMAPParams, _TpuEstimator):
                         f"precomputed_knn has {ids.shape[0]} rows but the "
                         f"(sampled) training set has {n}"
                     )
+            elif _umap_ann_mode() == "ivfflat":
+                # Opt-in (SRML_UMAP_ANN=ivfflat): the graph self-join runs
+                # through the IVF-Flat engine instead of the exact scan —
+                # sub-linear in n, gated by the k=15 neighbor-preservation
+                # test within the established 1% tolerance of the exact-
+                # graph reference layout (tests/test_umap_engine.py).
+                # SRML_UMAP_ANN_NLIST / SRML_UMAP_ANN_NPROBE override the
+                # defaults (sqrt(n) lists, half of them probed — the graph
+                # phase needs higher recall than online serving, so the
+                # default probes deeper than ann.default_nprobe).
+                dists, ids = _ann_self_join(
+                    np.asarray(X, np.float32), k, mesh, seed
+                )
             else:
                 # query_block 32768: the graph build is a self-join of many
                 # small-k blocks whose per-block host round-trips (through

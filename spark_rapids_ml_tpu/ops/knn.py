@@ -134,27 +134,34 @@ def _force_adaptive() -> bool:
     return os.environ.get(_FORCE_ADAPTIVE_ENV, "") == "1"
 
 
-def _run_block_pipeline(n_blocks: int, dispatch, collect, window: int) -> None:
+def _run_block_pipeline(
+    n_blocks: int, dispatch, collect, window: int, phase_prefix: str = "knn"
+) -> None:
     """Drive `dispatch(block_index)` / `collect(block_index)` over
     `n_blocks` query blocks keeping at most `window` + 1 blocks in flight.
     jax dispatch is async, so block b + 1..b + window compute on device
     while block b's results cross the host link inside `collect`.  The
     bound matters — dispatching everything up front would keep every padded
-    query block resident on device at once and OOM large searches."""
+    query block resident on device at once and OOM large searches.
+    `phase_prefix` names the profiling phases/events so other engines
+    riding the pipeline (the IVF-Flat probed search, ann/ivfflat.py) stay
+    separable from kNN in fit reports."""
+    p_dispatch = f"{phase_prefix}.dispatch"
+    p_collect = f"{phase_prefix}.collect"
     done = 0
     for bi in range(n_blocks):
-        with profiling.phase("knn.dispatch"):
+        with profiling.phase(p_dispatch):
             dispatch(bi)
-        profiling.record_event("knn.dispatch", block=bi)
+        profiling.record_event(p_dispatch, block=bi)
         if bi - done >= window:
-            with profiling.phase("knn.collect"):
+            with profiling.phase(p_collect):
                 collect(done)
-            profiling.record_event("knn.collect", block=done)
+            profiling.record_event(p_collect, block=done)
             done += 1
     while done < n_blocks:
-        with profiling.phase("knn.collect"):
+        with profiling.phase(p_collect):
             collect(done)
-        profiling.record_event("knn.collect", block=done)
+        profiling.record_event(p_collect, block=done)
         done += 1
 
 

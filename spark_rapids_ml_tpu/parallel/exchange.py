@@ -168,6 +168,28 @@ def psum_parts(x, axis_name: str = None):
     return jax.lax.psum(x, axis_name or DATA_AXIS)
 
 
+def psum_merge_parts(x, axis_name: str = None):
+    """Stack per-device candidate blocks into one (n_dev, ...) slab via a
+    single psum — the IVF-Flat probed search's ONE cross-shard collective
+    (ops-level: each shard scatters its local top-k into its slot of a
+    zeros slab; the psum leaves the full slab replicated everywhere).
+    Bitwise-safe as a gather: every slab element receives exactly one
+    shard's value plus zeros, and x + 0.0 is exact for the finite/+inf
+    distances and int32 positions the merge carries (no -0.0, no NaN by
+    construction).  Call ONLY inside a shard_map body bound over
+    `axis_name`."""
+    import jax
+    import jax.numpy as jnp
+
+    from .mesh import DATA_AXIS
+
+    axis = axis_name or DATA_AXIS
+    n_dev = jax.lax.psum(1, axis)
+    idx = jax.lax.axis_index(axis)
+    slab = jnp.zeros((n_dev,) + x.shape, x.dtype).at[idx].set(x)
+    return jax.lax.psum(slab, axis)
+
+
 def alltoall_bytes(
     cp: Any,
     rank: int,

@@ -358,10 +358,12 @@ def executor_evaluate(sdf: Any, evaluator: Any) -> float:
     import json
 
     from ..evaluation import (
+        BinaryClassificationEvaluator,
         ClusteringEvaluator,
         MulticlassClassificationEvaluator,
         RegressionEvaluator,
     )
+    from ..metrics.binary import BinaryClassificationMetrics
     from ..metrics.multiclass import MulticlassMetrics
     from ..metrics.regression import RegressionMetrics
 
@@ -371,6 +373,10 @@ def executor_evaluate(sdf: Any, evaluator: Any) -> float:
         metrics_cls: Any = MulticlassMetrics
     elif isinstance(evaluator, RegressionEvaluator):
         metrics_cls = RegressionMetrics
+    elif isinstance(evaluator, BinaryClassificationEvaluator):
+        # the round-5 VERDICT gap fix: AUC partials merge executor-side
+        # (metrics/binary.py) instead of collecting the prediction frame
+        metrics_cls = BinaryClassificationMetrics
     else:
         raise NotImplementedError(f"{evaluator} is unsupported yet.")
 

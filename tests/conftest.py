@@ -86,7 +86,7 @@ def n_devices():
 def model_zoo():
     """Lazily-fitted tiny models over one shared dataset, keyed by arm name
     ("kmeans", "pca", "linreg", "logreg", "rf_clf", "rf_reg", "umap",
-    "knn").  Returns a factory: model_zoo(name) -> (model, X) with X the
+    "knn", "ann").  Returns a factory: model_zoo(name) -> (model, X) with X the
     float32 feature matrix the model was fit on.  Session-scoped and cached
     so the persistence matrix and the serving tests share ONE fit per
     class instead of re-fitting per test."""
@@ -102,6 +102,7 @@ def model_zoo():
 
     def _build(name):
         from spark_rapids_ml_tpu import (
+            ApproximateNearestNeighbors,
             KMeans,
             LinearRegression,
             LogisticRegression,
@@ -138,6 +139,12 @@ def model_zoo():
             ).setFeaturesCol("features").fit(df)
         if name == "knn":
             return NearestNeighbors(k=4).setFeaturesCol("features").fit(df)
+        if name == "ann":
+            # nprobe == nlist: every list probed, so serving/persistence
+            # equivalence gates are deterministic AND recall-1.0 vs exact
+            return ApproximateNearestNeighbors(
+                k=4, algoParams={"nlist": 4, "nprobe": 4}
+            ).setFeaturesCol("features").fit(df)
         raise KeyError(name)
 
     def get(name):

@@ -175,3 +175,120 @@ class TestRegressionMetrics:
         # explained_variance_score); check against the direct formula
         want = np.sum((preds - labels.mean()) ** 2) / len(labels)
         assert m.explained_variance == pytest.approx(want, rel=1e-6)
+
+
+class TestBinaryClassificationMetrics:
+    """The round-5 VERDICT gap fix: AUC from mergeable per-partition
+    threshold partials (metrics/binary.py) must equal the driver-local
+    computation (sklearn on the whole array) metric-for-metric — ties,
+    weights, merge order, and the JSON wire format included."""
+
+    @pytest.fixture
+    def bin_data(self):
+        rng = np.random.default_rng(7)
+        n = 3000
+        labels = rng.integers(0, 2, size=n).astype(np.float64)
+        # rounded scores: plenty of exact ties across partitions
+        raw = np.round(rng.normal(size=n) + 1.2 * labels, 2)
+        weights = rng.uniform(0.25, 4.0, size=n)
+        return labels, raw, weights
+
+    def test_partials_match_sklearn(self, bin_data):
+        from sklearn.metrics import average_precision_score, roc_auc_score
+
+        from spark_rapids_ml_tpu.metrics import BinaryClassificationMetrics
+
+        labels, raw, weights = bin_data
+        m = None
+        for idx in np.array_split(np.arange(len(labels)), 9):
+            p = BinaryClassificationMetrics.from_arrays(
+                labels[idx], raw[idx], weights[idx]
+            )
+            m = p if m is None else m.merge(p)
+        assert m.area_under_roc() == pytest.approx(
+            roc_auc_score(labels, raw, sample_weight=weights), abs=1e-12
+        )
+        assert m.area_under_pr() == pytest.approx(
+            average_precision_score(labels, raw, sample_weight=weights),
+            abs=1e-12,
+        )
+
+    def test_json_wire_round_trip(self, bin_data):
+        import json
+
+        from spark_rapids_ml_tpu.metrics import BinaryClassificationMetrics
+
+        labels, raw, weights = bin_data
+        rows = []
+        for idx in np.array_split(np.arange(len(labels)), 5):
+            p = BinaryClassificationMetrics.from_arrays(
+                labels[idx], raw[idx], weights[idx]
+            )
+            rows.append(json.loads(json.dumps(p.to_row(0))))
+        merged = BinaryClassificationMetrics._from_rows(1, rows)[0]
+        whole = BinaryClassificationMetrics.from_arrays(labels, raw, weights)
+        assert merged.area_under_roc() == pytest.approx(
+            whole.area_under_roc(), abs=1e-12
+        )
+        assert merged.area_under_pr() == pytest.approx(
+            whole.area_under_pr(), abs=1e-12
+        )
+
+    def test_bin_cap_compresses_and_stays_close(self, bin_data):
+        from sklearn.metrics import roc_auc_score
+
+        from spark_rapids_ml_tpu.metrics import BinaryClassificationMetrics
+
+        labels, _raw, _w = bin_data
+        rng = np.random.default_rng(1)
+        raw = rng.normal(size=len(labels)) + labels  # high-cardinality
+        capped = BinaryClassificationMetrics.from_arrays(
+            labels, raw, max_bins=256
+        )
+        assert capped.scores.size <= 256
+        exact = roc_auc_score(labels, raw)
+        # numBins-style downsampling: close, not exact (documented)
+        assert capped.area_under_roc() == pytest.approx(exact, abs=0.01)
+
+    def test_one_class_raises(self):
+        from spark_rapids_ml_tpu.metrics import BinaryClassificationMetrics
+
+        m = BinaryClassificationMetrics.from_arrays(
+            np.ones(10), np.arange(10.0)
+        )
+        with pytest.raises(ValueError, match="one class"):
+            m.area_under_roc()
+
+    def test_evaluator_partition_merge_equals_driver_local(self, bin_data):
+        """The evaluator gate: multi-partition facade evaluate (the same
+        partial merge the executor route ships as JSON) == the driver-local
+        whole-frame computation, for both metrics, with and without
+        weightCol."""
+        import pandas as pd
+        from sklearn.metrics import average_precision_score, roc_auc_score
+
+        from spark_rapids_ml_tpu.dataframe import DataFrame
+        from spark_rapids_ml_tpu.evaluation import BinaryClassificationEvaluator
+
+        labels, raw, weights = bin_data
+        # rawPrediction as the usual [neg, pos] score arrays
+        pdf = pd.DataFrame(
+            {
+                "label": labels,
+                "rawPrediction": list(np.stack([-raw, raw], axis=1)),
+                "w": weights,
+            }
+        )
+        df = DataFrame.from_pandas(pdf, 6)
+        for name, want in (
+            ("areaUnderROC", roc_auc_score(labels, raw)),
+            ("areaUnderPR", average_precision_score(labels, raw)),
+        ):
+            ev = BinaryClassificationEvaluator(metricName=name)
+            assert ev.evaluate(df) == pytest.approx(want, abs=1e-12)
+        ev_w = BinaryClassificationEvaluator()
+        ev_w.set(ev_w.getParam("weightCol"), "w")
+        assert ev_w.evaluate(df) == pytest.approx(
+            roc_auc_score(labels, raw, sample_weight=weights), abs=1e-12
+        )
+        assert BinaryClassificationEvaluator().isLargerBetter()

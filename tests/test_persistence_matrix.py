@@ -55,6 +55,31 @@ def test_save_load_transform_equivalence(arm, model_zoo, tmp_path):
         ), f"{arm}: column {col!r} changed across save/load"
 
 
+def test_ann_save_load_kneighbors_equivalence(model_zoo, tmp_path):
+    """The ANN model has no transform — its persistence gate is
+    save -> load -> kneighbors BIT-IDENTICAL to the in-memory model (the
+    packed index layout is mesh-independent data, and the probed search is
+    deterministic, so exact equality is the right bar here too)."""
+    model, X = model_zoo("ann")
+    path = str(tmp_path / "ann")
+    model.save(path)
+    loaded = core_load(path)
+    assert type(loaded) is type(model)
+    assert loaded.getK() == model.getK()
+    assert loaded.getAlgoParams() == model.getAlgoParams()
+    qdf = DataFrame.from_numpy(X[:20], num_partitions=2)
+    _, _, before = model.kneighbors(qdf)
+    _, _, after = loaded.kneighbors(qdf)
+    for col in ("indices", "distances"):
+        b = np.concatenate(
+            [np.asarray(list(p[col])) for p in before.partitions if len(p)]
+        )
+        a = np.concatenate(
+            [np.asarray(list(p[col])) for p in after.partitions if len(p)]
+        )
+        assert np.array_equal(a, b), f"ann: column {col!r} changed across save/load"
+
+
 def test_loaded_model_attributes_round_trip(model_zoo, tmp_path):
     # spot-check the attribute payload itself (npz + json split): arrays
     # stay arrays, scalars stay scalars

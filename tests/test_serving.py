@@ -272,6 +272,25 @@ def test_served_outputs_match_batch_transform(arm, model_zoo):
         srv.assert_steady_state()
 
 
+def test_served_ann_matches_probed_search(model_zoo):
+    """Served ANN == batch probed search (the srml-ann serving gate): the
+    online entry answers from the same staged index + cached executables
+    the batch kneighbors path dispatches, so ids are exactly equal."""
+    model, X = model_zoo("ann")
+    _, _, knn_df = model.kneighbors(
+        __import__("spark_rapids_ml_tpu.dataframe", fromlist=["DataFrame"])
+        .DataFrame.from_numpy(X[:8], num_partitions=1)
+    )
+    expect_ids = np.asarray(list(knn_df.partitions[0]["indices"]))
+    expect_d = np.asarray(list(knn_df.partitions[0]["distances"]))
+    with ModelServer("eq_ann", model, max_batch=32, max_wait_ms=2) as srv:
+        got = srv.predict(X[:8])
+        assert np.array_equal(got["indices"], expect_ids)
+        np.testing.assert_allclose(got["distances"], expect_d, rtol=1e-5, atol=1e-5)
+        srv.drain()
+        srv.assert_steady_state()
+
+
 def test_served_knn_matches_kneighbors(model_zoo):
     model, X = model_zoo("knn")
     _, _, knn_df = model.kneighbors(
