@@ -75,20 +75,28 @@ def _sync(x) -> float:
 
 def _timed_repeats(fn, repeats: int):
     """One warmup call (compiles are cached for the timed runs), then
-    `repeats` timed calls.  Returns (cold_seconds, per-run seconds): the
-    cold time captures the first-fit experience (compiles + staging) the
-    warm numbers amortize away; the multi-repeat protocol exists because
-    single timed runs on the tunneled device have been observed 5x apart
-    under congestion."""
+    `repeats` timed calls.  Returns (cold_seconds, per-run seconds,
+    per-run phase-time dicts): the cold time captures the first-fit
+    experience (compiles + staging) the warm numbers amortize away; the
+    multi-repeat protocol exists because single timed runs on the tunneled
+    device have been observed 5x apart under congestion.  The per-repeat
+    phase breakdown (srml-scope) is what lets a spread be ATTRIBUTED to a
+    phase instead of eyeballed (the kNN arm's standing 31% mystery)."""
+    from spark_rapids_ml_tpu import profiling
+
     t0 = time.perf_counter()
     fn()
     cold = time.perf_counter() - t0
-    times = []
+    times, phases = [], []
     for _ in range(repeats):
+        profiling.reset_phase_times()
         t0 = time.perf_counter()
         fn()
         times.append(time.perf_counter() - t0)
-    return cold, times
+        phases.append(profiling.phase_times())
+    return cold, times, phases
+
+
 
 
 def _device_padded_gen(mesh, rows, gen_fn, seed=42):
@@ -560,7 +568,7 @@ def run_arm(algo: str, overrides, repeats: int):
     so the first-fit experience is a captured artifact, not a claim."""
     repeats = max(repeats, ARM_MIN_REPEATS.get(algo, 1))
     fit, label, rows = build_arm(algo, overrides)
-    cold, times = _timed_repeats(fit, repeats)
+    cold, times, phases = _timed_repeats(fit, repeats)
     med, best = statistics.median(times), min(times)
     value = rows / med
     baseline = REF_ROWS / REF_GPU_SECONDS.get(algo, REF_GPU_SECONDS["kmeans"])
@@ -575,6 +583,18 @@ def run_arm(algo: str, overrides, repeats: int):
         "cold_sec": round(cold, 3),
         "repeats": repeats,  # can exceed the global knob (ARM_MIN_REPEATS)
     }
+    # per-repeat phase breakdown + the phase the spread lives in (srml-scope
+    # satellites: standings.py renders the attribution next to the ⚠ flag)
+    from spark_rapids_ml_tpu import profiling
+
+    attribution = profiling.spread_attribution(phases, med)
+    if attribution:
+        out["spread_attribution"] = attribution
+        out["spread_phase"] = next(iter(attribution))
+    if phases and phases[-1]:
+        out["phase_times_per_repeat"] = [
+            {k: round(v, 4) for k, v in sorted(p.items())} for p in phases
+        ]
     if algo in ARM_NOTES:
         out["notes"] = ARM_NOTES[algo]
     return out

@@ -184,6 +184,30 @@ class BenchmarkBase:
             if vals:
                 agg[f"{k}_mean"] = round(statistics.fmean(vals), 6)
                 agg[f"{k}_median"] = round(statistics.median(vals), 6)
+        # per-phase spread attribution over the session (srml-scope): when
+        # the runs carry phase_times (or per-repeat lists), report each
+        # phase's max−min as % of the median timed call and name the top
+        # contributor — the data behind the standings ⚠ footnote
+        phase_runs = []
+        for r in runs:
+            per = r.get("phase_times_per_repeat")
+            if isinstance(per, list):
+                phase_runs.extend(p for p in per if isinstance(p, dict))
+            elif isinstance(r.get("phase_times"), dict):
+                phase_runs.append(r["phase_times"])
+        base_key = (
+            "transform_time"
+            if "transform_time_median" in agg
+            else "benchmark_time"
+        )
+        from spark_rapids_ml_tpu import profiling
+
+        spread = profiling.spread_attribution(
+            phase_runs, agg.get(f"{base_key}_median", 0.0)
+        )
+        if spread:
+            agg["spread_attribution"] = spread
+            agg["spread_phase"] = next(iter(spread))
         return agg
 
     def run(self) -> None:
@@ -202,6 +226,13 @@ class BenchmarkBase:
             results["run_idx"] = run_idx
             results["mode"] = self._args.mode
             results["num_devices"] = self._args.num_devices
+            if self._args.mode == "tpu":
+                # srml-scope export rides every artifact record: counters,
+                # duration percentiles, and this thread's phase stats in the
+                # stable JSON schema (docs/observability.md)
+                from spark_rapids_ml_tpu import profiling
+
+                results["metrics_export"] = profiling.export_metrics()
             results.update(self._class_params)
             print("-" * 100)
             pprint.pprint(results)

@@ -6,11 +6,12 @@ set -ex
 cd "$(dirname "$0")/.."
 
 # 1. lint / static checks: byte-compile everything (mypy/black optional in
-#    this image), then graftlint — the JAX/TPU invariant checker (R1-R5:
+#    this image), then graftlint — the JAX/TPU invariant checker (R1-R6:
 #    hidden host syncs, recompile risk, unbound collective axis names,
-#    nondeterministic RNG/set-order, float64 in solver kernels; see
-#    docs/graftlint.md).  Fails on ANY finding and prints the per-rule
-#    count; use --baseline to land a new rule warn-only first.
+#    nondeterministic RNG/set-order, float64 in solver kernels, raw clocks
+#    outside srml-scope; see docs/graftlint.md).  Fails on ANY finding and
+#    prints the per-rule count; use --baseline to land a new rule warn-only
+#    first.
 python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
 python -m tools.graftlint spark_rapids_ml_tpu benchmark
 
@@ -147,6 +148,54 @@ assert rec["qps"] > 0 and "speedup_vs_exact" in rec, rec
 assert rec["steady_compiles"] == 0, rec
 EOF
 rm -rf "$ANN_SMOKE"
+
+# 3g. focused gates for srml-scope observability (also inside the full
+#     suite; re-asserted by name so marker drift can never silently drop
+#     them), then an end-to-end trace/export smoke: a kmeans fit + a
+#     serving session run with SRML_TRACE_DIR set, and the emitted files
+#     must parse as valid Chrome trace-event JSON with >0 complete ("X")
+#     span events; the fit must surface fit_telemetry() on the model; and
+#     export_metrics() must round-trip through json.loads with the stable
+#     schema (docs/observability.md).
+python -m pytest tests/test_profiling.py -q
+TRACE_SMOKE=$(mktemp -d)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    SRML_TRACE_DIR="$TRACE_SMOKE/traces" python - "$TRACE_SMOKE/traces" <<'EOF'
+import glob, json, sys
+import numpy as np
+from spark_rapids_ml_tpu import KMeans, profiling
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.serving import ModelRegistry
+
+X = np.random.default_rng(0).standard_normal((512, 16)).astype(np.float32)
+model = KMeans(k=4, maxIter=5, seed=1).fit(DataFrame.from_numpy(X))
+telem = model.fit_telemetry()
+assert telem is not None and telem.phases["srml.fit"]["count"] == 1, telem
+with ModelRegistry(max_batch=32, max_wait_ms=2) as reg:
+    reg.register("km", model)
+    for i in range(8):
+        reg.get("km").predict(X[i])
+    snap = reg.telemetry()
+    assert snap.counters.get("serving.km.requests", 0) >= 8, snap.counters
+
+traces = glob.glob(sys.argv[1] + "/*.trace.json")
+tags = {p.rsplit("/", 1)[-1].split("-")[0] for p in traces}
+assert {"fit", "serve"} <= tags, traces
+for p in traces:
+    doc = json.load(open(p))
+    complete = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert complete, f"{p}: no complete span events"
+    for e in complete:
+        assert set(e) >= {"name", "ts", "dur", "pid", "tid", "args"}, e
+
+exported = profiling.export_metrics()
+rt = json.loads(json.dumps(exported))
+assert rt == exported and rt["schema"] == "srml-scope/v1"
+assert "srml_counter{" in profiling.render_prometheus(exported)
+print(f"observability smoke OK: {len(traces)} trace file(s), "
+      f"{len(exported['counters'])} counters exported")
+EOF
+rm -rf "$TRACE_SMOKE"
 
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)

@@ -446,7 +446,7 @@ def index_from_packed(packed: PackedIVF, mesh: Mesh) -> IVFFlatIndex:
     x_norm = np.einsum(
         "nd,nd->n", data.astype(np.float64), data.astype(np.float64)
     ).astype(np.float32)
-    with profiling.phase("ann.stage"):
+    with profiling.phase("ann.stage", bytes=int(data.nbytes)):
         index = IVFFlatIndex(
             list_data=jax.device_put(
                 data.reshape(nlist_pad, l_pad, d), axis_sharding(mesh, 0, 3)

@@ -70,6 +70,12 @@ def _maybe_x64(dtype: Any):
     return contextlib.nullcontext()
 
 
+# Reserved key a fit result dict carries its TelemetrySnapshot under —
+# attached executor-side (parallel/runner) or by the local fit dispatch,
+# popped by _fit_internal before the attrs reach _create_model, exposed as
+# model.fit_telemetry().  Never a model attribute.
+TELEMETRY_ATTR = "__srml_telemetry__"
+
 # single-slot device-input cache; see _TpuCaller._build_fit_inputs
 _FIT_INPUT_CACHE: Dict[str, Any] = {}
 
@@ -519,11 +525,22 @@ class _TpuCaller(_TpuParams):
                 else None
             )
             results = barrier_fit_estimator(self, dataset, extra_params=extra)
-            self._last_fit_phase_times = {}
+            # the executors' merged telemetry snapshot rides the result wire
+            # (parallel/runner attaches it); the driver-side phase view comes
+            # from it — on live Spark the fit never ran on this thread
+            from . import profiling
+
+            telem = results[0].get(TELEMETRY_ATTR) if results else None
+            self._last_fit_phase_times = (
+                profiling.TelemetrySnapshot.from_dict(telem).phase_seconds()
+                if telem
+                else {}
+            )
             return results if paramMaps is not None else results[0]
         from . import profiling
 
         profiling.reset_phase_times()
+        counters0 = profiling.counters()
         df = as_dataframe(dataset)
         self._validate_parameters(df)
         # float64 fits genuinely run in float64 (reference core.py:363-401
@@ -532,7 +549,9 @@ class _TpuCaller(_TpuParams):
         # (device_put) and the fit (trace-time dtypes); it recompiles the
         # kernels for f64, which TPUs execute via (slower) emulation.
         input_col, input_cols = self._get_input_columns()
-        with _maybe_x64(self._use_dtype(df, input_col, input_cols)):
+        with profiling.trace_session(f"fit-{type(self).__name__}"), _maybe_x64(
+            self._use_dtype(df, input_col, input_cols)
+        ):
             with profiling.phase("srml.ingest"):
                 inputs = self._build_fit_inputs(df)
             extra_params = None
@@ -552,6 +571,13 @@ class _TpuCaller(_TpuParams):
                 with profiling.phase("srml.fit"), sanitize_scope():
                     result = fit_func(inputs, dict(self._tpu_params))
         self._last_fit_phase_times = profiling.phase_times()
+        # telemetry rides the SAME attribute dicts the executor path ships,
+        # so _fit_internal attaches model.fit_telemetry() uniformly (the
+        # snapshot is shared across a single-pass multi-model fit — one
+        # data load, one solver pass, one set of phase timers)
+        snap = profiling.TelemetrySnapshot.capture(counters0, rank=0)
+        for r in result if isinstance(result, list) else [result]:
+            r[TELEMETRY_ATTR] = snap.to_dict()
         return result
 
     def _paramMap_to_tpu_overrides(self, paramMap: Dict[Param, Any]) -> Dict[str, Any]:
@@ -663,7 +689,14 @@ class _TpuEstimator(_TpuCaller):
             assert len(results) == 1
         models = []
         for i, attrs in enumerate(results if isinstance(results, list) else [results]):
+            telem = attrs.pop(TELEMETRY_ATTR, None)
             model = self._create_model(attrs)
+            if telem is not None:
+                from . import profiling
+
+                model._fit_telemetry = profiling.TelemetrySnapshot.from_dict(
+                    telem
+                )
             self._copyValues(model)
             model._tpu_params.update(self._tpu_params)
             model._num_workers = self._num_workers
@@ -720,6 +753,14 @@ class _TpuModel(_TpuParams):
 
     def _get_model_attributes(self) -> Dict[str, Any]:
         return self._model_attributes
+
+    def fit_telemetry(self):
+        """TelemetrySnapshot of the fit that produced this model — phase
+        rollups, counter deltas, per-rank merge — on BOTH the local and the
+        live-Spark (barrier executor) paths.  None for models built by
+        hand, loaded from disk, or combined (telemetry describes one fit
+        session, not a persisted artifact)."""
+        return getattr(self, "_fit_telemetry", None)
 
     @classmethod
     def _construct(cls, attrs: Dict[str, Any]) -> "_TpuModel":

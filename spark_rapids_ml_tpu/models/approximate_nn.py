@@ -332,33 +332,36 @@ class ApproximateNearestNeighborsModel(
             prepared = self._ensure_staged_exact(mesh)
         else:
             index = self._ensure_staged_index(mesh)
+        from .. import profiling
+
         out_parts = []
-        for part in qdf.partitions:
-            if len(part) == 0:
+        with profiling.trace_session("search-ApproximateNearestNeighbors"):
+            for part in qdf.partitions:
+                if len(part) == 0:
+                    out_parts.append(
+                        pd.DataFrame(
+                            {f"query_{id_col}": [], "indices": [], "distances": []}
+                        )
+                    )
+                    continue
+                feats = extract_partition_features(
+                    part, input_col, input_cols, np.float32
+                )
+                if exact:
+                    dists, ids = knn_search_prepared(prepared, feats, k, mesh)
+                else:
+                    dists, ids = ivfflat_search_prepared(
+                        index, feats, k, nprobe, mesh
+                    )
                 out_parts.append(
                     pd.DataFrame(
-                        {f"query_{id_col}": [], "indices": [], "distances": []}
+                        {
+                            f"query_{id_col}": part[id_col].to_numpy(),
+                            "indices": list(np.asarray(ids)),
+                            "distances": list(np.asarray(dists, np.float32)),
+                        }
                     )
                 )
-                continue
-            feats = extract_partition_features(
-                part, input_col, input_cols, np.float32
-            )
-            if exact:
-                dists, ids = knn_search_prepared(prepared, feats, k, mesh)
-            else:
-                dists, ids = ivfflat_search_prepared(
-                    index, feats, k, nprobe, mesh
-                )
-            out_parts.append(
-                pd.DataFrame(
-                    {
-                        f"query_{id_col}": part[id_col].to_numpy(),
-                        "indices": list(np.asarray(ids)),
-                        "distances": list(np.asarray(dists, np.float32)),
-                    }
-                )
-            )
         return self._item_df, qdf, DataFrame(out_parts)
 
     def _get_tpu_transform_func(self, dataset):  # pragma: no cover

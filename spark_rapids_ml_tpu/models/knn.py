@@ -243,9 +243,12 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
             )
 
         mesh = get_mesh(self.num_workers)
-        per_part = self._search_partitions(
-            id_col, dtype, mesh, q_parts, _query_feats, self.getK()
-        )
+        from .. import profiling
+
+        with profiling.trace_session("search-NearestNeighbors"):
+            per_part = self._search_partitions(
+                id_col, dtype, mesh, q_parts, _query_feats, self.getK()
+            )
         out_parts = []
         for part, (dists, ids) in zip(q_parts, per_part):
             out_parts.append(

@@ -985,7 +985,7 @@ def grow_forest(
 
     top = max_depth
     for (ck, statics), (l0, block, npad) in zip(plan, blocks):
-        with profiling.phase("forest.hist"):
+        with profiling.phase("forest.hist", l0=l0, levels=block):
             out = pc.cached_call(
                 ck, _forest_block_kernel, *args, mesh=smesh, **statics
             )

@@ -150,16 +150,16 @@ def _run_block_pipeline(
     p_collect = f"{phase_prefix}.collect"
     done = 0
     for bi in range(n_blocks):
-        with profiling.phase(p_dispatch):
+        with profiling.phase(p_dispatch, block=bi):
             dispatch(bi)
         profiling.record_event(p_dispatch, block=bi)
         if bi - done >= window:
-            with profiling.phase(p_collect):
+            with profiling.phase(p_collect, block=done):
                 collect(done)
             profiling.record_event(p_collect, block=done)
             done += 1
     while done < n_blocks:
-        with profiling.phase(p_collect):
+        with profiling.phase(p_collect, block=done):
             collect(done)
         profiling.record_event(p_collect, block=done)
         done += 1

@@ -176,7 +176,6 @@ class Precompiler:
     def _worker(self):
         import contextlib
         import os
-        import time
 
         from ..compat import enable_x64
 
@@ -184,7 +183,7 @@ class Precompiler:
         while True:
             job, fn, avals, static_kwargs = self._q.get()
             try:
-                t0 = time.perf_counter() if trace else 0.0
+                t0 = profiling.now() if trace else 0.0
                 # x64 is a THREAD-LOCAL scope: a float64 fit submits 64-bit
                 # avals from inside its enable_x64 context, but this worker
                 # thread is outside it — lowering here would silently
@@ -197,12 +196,22 @@ class Precompiler:
                     if hasattr(a, "dtype")
                 )
                 ctx = enable_x64(True) if wide else contextlib.nullcontext()
-                with ctx:
+                # the compile span carries the kernel name (first key
+                # element) so pool compile time is attributable per kernel
+                # in traces without string-ifying the full geometry key
+                kname = (
+                    job.key[0]
+                    if isinstance(job.key, tuple) and job.key
+                    else str(job.key)[:64]
+                )
+                with ctx, profiling.span(
+                    "precompile.compile", kernel=str(kname)
+                ):
                     job.result = fn.lower(*avals, **static_kwargs).compile()
                 profiling.incr_counter("precompile.compile")
                 if trace:
                     logger.warning(
-                        "compiled %r in %.2fs", job.key, time.perf_counter() - t0
+                        "compiled %r in %.2fs", job.key, profiling.now() - t0
                     )
             except BaseException as exc:  # noqa: BLE001 - relayed to waiter
                 job.error = exc

@@ -28,9 +28,10 @@
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
 import struct
-from typing import Any, List, Sequence
+from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -42,6 +43,63 @@ from .. import profiling
 CHUNK_BYTES = 8 << 20
 
 _MAGIC = b"SRX1"
+
+
+# -- the ONE collective reporting wrapper -------------------------------------
+# Every exchange primitive — host control-plane collective or in-mesh device
+# collective — reports through section(): uniform `exchange.<name>.bytes` /
+# `exchange.<name>.time_ns` / `exchange.<name>.calls` process counters plus a
+# hierarchical span named `exchange.<name>` (srml-scope), so per-section
+# byte/time accounting is one namespace regardless of which idiom moved the
+# data (the first concrete step of ROADMAP item 5's unified comms layer).
+#
+# Host sections measure wall clock.  Device sections (psum_parts,
+# allgather_rows, psum_merge_parts) run at TRACE time inside shard_map
+# bodies, where wall clock is meaningless — they report the STATIC payload
+# bytes of the traced shapes plus a trace count, and wrap the collective in
+# jax.named_scope so the section shows up by name in xprof/HLO instead.
+# Counters therefore move once per compiled geometry for device sections and
+# once per call for host sections; docs/observability.md spells this out.
+
+
+@contextlib.contextmanager
+def section(name: str, nbytes: Optional[int] = None) -> Iterator[None]:
+    """Host-side collective section: span + byte/time/call counters."""
+    full = f"exchange.{name}"
+    t0 = profiling.now()
+    with profiling.span(full, **({"bytes": int(nbytes)} if nbytes else {})):
+        yield
+    dt = profiling.now() - t0
+    profiling.incr_counter(f"{full}.calls")
+    profiling.incr_counter(f"{full}.time_ns", int(dt * 1e9))
+    if nbytes:
+        profiling.incr_counter(f"{full}.bytes", int(nbytes))
+
+
+def _static_nbytes(*arrays: Any) -> int:
+    """Payload bytes of traced (or concrete) arrays from their STATIC
+    shape/dtype — safe on tracers inside shard_map bodies."""
+    total = 0
+    for a in arrays:
+        n = 1
+        for s in a.shape:
+            n *= int(s)
+        total += n * np.dtype(a.dtype).itemsize
+    return total
+
+
+def device_section(name: str, *arrays: Any):
+    """Device-side collective section: called at trace time inside a
+    shard_map body.  Records the static payload bytes + a trace count and
+    returns a jax.named_scope so the section is named in device traces
+    (wall-clock for device sections lives in the xprof timeline, not the
+    host counters)."""
+    import jax
+
+    full = f"exchange.{name}"
+    profiling.incr_counter(f"{full}.traces")
+    profiling.incr_counter(f"{full}.bytes", _static_nbytes(*arrays))
+    return jax.named_scope(full)
 
 
 def pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
@@ -118,9 +176,10 @@ def allgather_bytes(
     """Broadcast allGather of one binary payload per rank (every receiver
     materializes every rank's payload — use for data all sides need, e.g.
     the query broadcast).  Chunked under the transport frame limit.
-    Wall-clock lands in the "exchange.allgather" profiling phase so
-    control-plane time is separable from device compute in fit reports."""
-    with profiling.phase("exchange.allgather"):
+    Wall-clock and payload bytes land in the "exchange.allgather" section
+    (span + counters) so control-plane time is separable from device compute
+    in fit reports and telemetry snapshots."""
+    with section("allgather", nbytes=len(payload)):
         use_bytes = hasattr(cp, "allGatherBytes")
         mine = _chunks(payload, chunk)
         counts = [int(c) for c in cp.allGather(str(len(mine)))]
@@ -150,7 +209,10 @@ def allgather_rows(x, axis_name: str = None):
 
     from .mesh import DATA_AXIS
 
-    return jax.lax.all_gather(x, axis_name or DATA_AXIS, axis=0, tiled=True)
+    with device_section("allgather_rows", x):
+        return jax.lax.all_gather(
+            x, axis_name or DATA_AXIS, axis=0, tiled=True
+        )
 
 
 def psum_parts(x, axis_name: str = None):
@@ -165,7 +227,8 @@ def psum_parts(x, axis_name: str = None):
 
     from .mesh import DATA_AXIS
 
-    return jax.lax.psum(x, axis_name or DATA_AXIS)
+    with device_section("psum_parts", *jax.tree_util.tree_leaves(x)):
+        return jax.lax.psum(x, axis_name or DATA_AXIS)
 
 
 def psum_merge_parts(x, axis_name: str = None):
@@ -184,10 +247,11 @@ def psum_merge_parts(x, axis_name: str = None):
     from .mesh import DATA_AXIS
 
     axis = axis_name or DATA_AXIS
-    n_dev = jax.lax.psum(1, axis)
-    idx = jax.lax.axis_index(axis)
-    slab = jnp.zeros((n_dev,) + x.shape, x.dtype).at[idx].set(x)
-    return jax.lax.psum(slab, axis)
+    with device_section("psum_merge_parts", x):
+        n_dev = jax.lax.psum(1, axis)
+        idx = jax.lax.axis_index(axis)
+        slab = jnp.zeros((n_dev,) + x.shape, x.dtype).at[idx].set(x)
+        return jax.lax.psum(slab, axis)
 
 
 def alltoall_bytes(
@@ -210,7 +274,7 @@ def alltoall_bytes(
     owning rank)."""
     if len(dests) != nranks:
         raise ValueError(f"need {nranks} destination payloads, got {len(dests)}")
-    with profiling.phase("exchange.alltoall"):
+    with section("alltoall", nbytes=sum(len(d) for d in dests)):
         use_bytes = hasattr(cp, "allGatherBytes")
         frames = [_chunks(d, chunk) for d in dests]
         meta = json.dumps([len(f) for f in frames])

@@ -325,14 +325,42 @@ class DistributedFitSession:
         from .. import profiling
         from ..sanitize import sanitize_scope
 
-        with profiling.phase("runner.build_inputs"):
-            inputs = self.build_fit_inputs(estimator, df)
-        fit_func = estimator._get_tpu_fit_func(df, extra_params)
-        with sanitize_scope(), profiling.phase("runner.fit"):
-            result = fit_func(inputs, dict(estimator._tpu_params))
+        profiling.reset_phase_times()
+        counters0 = profiling.counters()
+        with profiling.trace_session(
+            f"fit-{type(estimator).__name__}-rank{self.rank}"
+        ):
+            with profiling.phase("runner.build_inputs"):
+                inputs = self.build_fit_inputs(estimator, df)
+            fit_func = estimator._get_tpu_fit_func(df, extra_params)
+            with sanitize_scope(), profiling.phase("runner.fit"):
+                result = fit_func(inputs, dict(estimator._tpu_params))
+        # Telemetry snapshot at fit-task exit, merged ACROSS RANKS through
+        # the control plane before rank 0's results leave for the driver —
+        # this is how the driver-side model sees where every executor's fit
+        # spent its time (the reference's per-task NVTX/log lines die on the
+        # executors; a mergeable rollup is the only thing that can ride the
+        # model-attribute wire).  One extra string gather round; every rank
+        # participates (collective contract).
+        snap = profiling.TelemetrySnapshot.capture(counters0, rank=self.rank)
+        merged = snap
+        if self.nranks > 1:
+            gathered = self.control_plane.allGather(json.dumps(snap.to_dict()))
+            snaps = sorted(
+                (json.loads(m) for m in gathered),
+                key=lambda d: d.get("meta", {}).get("ranks", [0]),
+            )
+            merged = profiling.TelemetrySnapshot.from_dict(snaps[0])
+            for d in snaps[1:]:
+                merged = merged.merge(profiling.TelemetrySnapshot.from_dict(d))
         self.control_plane.barrier()
         results = result if isinstance(result, list) else [result]
-        return [encode_attrs(r) for r in results]
+        encoded = [encode_attrs(r) for r in results]
+        from ..core import TELEMETRY_ATTR
+
+        for e in encoded:
+            e[TELEMETRY_ATTR] = merged.to_dict()
+        return encoded
 
 
 @contextlib.contextmanager

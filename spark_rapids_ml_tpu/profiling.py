@@ -1,5 +1,5 @@
 #
-# Tracing / profiling hooks.
+# srml-scope: the runtime observability layer.
 #
 # TPU-native equivalent of the reference's observability surface (SURVEY.md
 # §5): the Scala path wraps phases in NVTX ranges
@@ -7,44 +7,75 @@
 # and the Python path logs coarse phase lines inside the fit UDF
 # (/root/reference/python/src/spark_rapids_ml/core.py:583,617) with wall-clock
 # timers in the benchmark harness
-# (/root/reference/python/benchmark/benchmark/utils.py:42-50).
+# (/root/reference/python/benchmark/benchmark/utils.py:42-50).  Those ideas
+# grew here into three pillars:
 #
-# Here the same three ideas map to jax:
-#   - phase(name): a context manager emitting a jax.profiler.TraceAnnotation
-#     (named range in an xprof/tensorboard trace — the NVTX analog on TPU)
-#     plus a DEBUG log line with host wall-clock, and recording the duration
-#     in a per-thread registry that estimators expose after fit.
-#   - maybe_trace(): opt-in whole-program capture — set SRML_PROFILE=/some/dir
-#     and every top-level fit() writes an xprof trace there, the moral
-#     equivalent of running the reference benchmarks with NCCL_DEBUG=INFO.
-#   - with_benchmark(name, fn): wall-clock helper with the same shape as the
-#     reference's benchmark/utils.py:42-50.
-#   - incr_counter/counters: PROCESS-wide monotonic counters (the precompile
-#     subsystem's compile/hit/miss accounting — its worker threads must be
-#     able to report into the same registry the main thread reads).
+#   1. HIERARCHICAL SPANS — span(name, **attrs) nests: each span records its
+#      parent span (per-thread stack), thread id/name, monotonic start/end
+#      timestamps, and any attached counters (bytes=, rows=, block=...).
+#      phase(name) is the same function (API-compatible shim) — every
+#      existing phase site in the engines is a span site.  Alongside the
+#      host-side record, every span still emits a jax.profiler
+#      TraceAnnotation so xprof captures carry the same names.  Span records
+#      are collected ONLY while a trace session is active: spans off means
+#      no allocation, no buffer append, no thread-local stack — the disabled
+#      path is the old flat phase timer, nothing more (guarded by
+#      tests/test_profiling.py).
+#   2. TRACE EXPORT — trace_session(tag) (active when SRML_TRACE_DIR is set)
+#      collects every span completed during the session and writes a Chrome
+#      trace-event JSON file (load it in Perfetto / chrome://tracing).  Fit,
+#      kneighbors, and serving sessions open one automatically.
+#   3. MERGEABLE TELEMETRY — TelemetrySnapshot rolls up phase stats,
+#      counters, and duration digests into a JSON-safe dict with associative
+#      commutative merge rules (mirroring metrics/binary.py partials), so
+#      executor-side fit telemetry crosses the Spark wire and merges on the
+#      driver: model.fit_telemetry() works on live Spark, not just local
+#      mode.  export_metrics() / render_prometheus() are the pull surface
+#      (stable JSON + Prometheus text exposition).
+#
+# The flat primitives underneath are unchanged:
+#   - incr_counter/counters: PROCESS-wide monotonic counters (precompile's
+#     compile/hit/miss accounting; worker threads report into the registry
+#     the main thread reads).
 #   - record_event/events: a per-thread ORDERED event log for asserting
-#     pipeline interleavings (e.g. "block i+1 dispatched before block i
-#     collected" in the kNN query engine) without timing-dependent tests.
-#   - record_duration/percentiles: PROCESS-wide duration samples (per-request
-#     serving latencies recorded on the dispatch worker thread, read from the
-#     main thread) with p50/p95/p99 summaries — the SLO surface the serving
-#     engine and the benchmark reports share.
+#     pipeline interleavings without timing-dependent tests.
+#   - record_duration/percentiles: PROCESS-wide duration samples with
+#     p50/p95/p99 summaries (the serving SLO surface).
+#   - maybe_trace(): opt-in whole-program xprof capture (SRML_PROFILE=<dir>).
+#   - now(): the ONE monotonic clock.  Engine/serving modules must take
+#     timestamps through it (or through span()) — graftlint R6 rejects raw
+#     time.perf_counter()/time.time() outside this module, so every timing
+#     source srml-scope reports from is the same clock.
 #
 
 from __future__ import annotations
 
 import contextlib
+import itertools
+import json
 import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 _log = logging.getLogger("spark_rapids_ml_tpu.profiling")
 
 PROFILE_ENV = "SRML_PROFILE"
+TRACE_ENV = "SRML_TRACE_DIR"
 
 _tls = threading.local()
+
+
+def now() -> float:
+    """The process's ONE monotonic clock (time.perf_counter).  All timing in
+    engine/serving modules goes through here or span() — graftlint R6."""
+    return time.perf_counter()
+
+
+# perf_counter value at import: trace-event timestamps are exported relative
+# to it so a Perfetto timeline starts near zero instead of at host uptime
+_EPOCH = time.perf_counter()
 
 
 def _registry() -> Dict[str, float]:
@@ -55,9 +86,18 @@ def _registry() -> Dict[str, float]:
     return reg
 
 
+def _count_registry() -> Dict[str, int]:
+    reg = getattr(_tls, "phase_counts", None)
+    if reg is None:
+        reg = {}
+        _tls.phase_counts = reg
+    return reg
+
+
 def reset_phase_times() -> None:
     """Clear the current thread's phase registry (called at fit entry)."""
     _registry().clear()
+    _count_registry().clear()
 
 
 def phase_times(prefix: str = "") -> Dict[str, float]:
@@ -68,6 +108,19 @@ def phase_times(prefix: str = "") -> Dict[str, float]:
     if not prefix:
         return dict(reg)
     return {k: v for k, v in reg.items() if k.startswith(prefix)}
+
+
+def phase_stats(prefix: str = "") -> Dict[str, Dict[str, float]]:
+    """{name: {"count", "total_s"}} for this thread's phases since the last
+    reset — the span rollup a TelemetrySnapshot carries (counts travel with
+    totals so merged snapshots can still average per-invocation cost)."""
+    reg = _registry()
+    cnt = _count_registry()
+    return {
+        k: {"count": int(cnt.get(k, 0)), "total_s": float(v)}
+        for k, v in reg.items()
+        if k.startswith(prefix)
+    }
 
 
 # -- process-wide counters ---------------------------------------------------
@@ -100,12 +153,12 @@ def counter_deltas(before: Dict[str, int], prefix: str = "") -> Dict[str, int]:
     """Nonzero differences of the current counters vs a `counters(prefix)`
     snapshot — the benchmark/test idiom for "what moved during this fit"
     without resetting the monotonic registry."""
-    now = counters(prefix)
-    keys = set(now) | set(before)
+    now_ = counters(prefix)
+    keys = set(now_) | set(before)
     return {
-        k: now.get(k, 0) - before.get(k, 0)
+        k: now_.get(k, 0) - before.get(k, 0)
         for k in sorted(keys)
-        if now.get(k, 0) != before.get(k, 0)
+        if now_.get(k, 0) != before.get(k, 0)
     }
 
 
@@ -130,23 +183,38 @@ _DURATION_CAP = 65536
 _durations_lock = threading.Lock()
 _durations: Dict[str, list] = {}
 _duration_next: Dict[str, int] = {}  # ring-buffer write cursor past the cap
+# lifetime [count, sum, min, max] per series: unlike the capped ring these
+# are MONOTONIC (evicted samples stay counted), so duration_digests deltas
+# between two snapshots are exact no matter how busy the series is
+_duration_stats: Dict[str, list] = {}
 
 
 def record_duration(name: str, seconds: float) -> None:
     """Append one duration sample (seconds) to the process-wide series
     `name`.  Cheap enough for per-request recording; capped per name (ring
     buffer) so recording is observability, never a leak."""
+    s = float(seconds)
     with _durations_lock:
         series = _durations.get(name)
         if series is None:
             series = []
             _durations[name] = series
         if len(series) < _DURATION_CAP:
-            series.append(float(seconds))
+            series.append(s)
         else:
             cur = _duration_next.get(name, 0)
-            series[cur] = float(seconds)
+            series[cur] = s
             _duration_next[name] = (cur + 1) % _DURATION_CAP
+        stats = _duration_stats.get(name)
+        if stats is None:
+            _duration_stats[name] = [1, s, s, s]
+        else:
+            stats[0] += 1
+            stats[1] += s
+            if s < stats[2]:
+                stats[2] = s
+            if s > stats[3]:
+                stats[3] = s
 
 
 def durations(prefix: str = "") -> Dict[str, list]:
@@ -160,6 +228,7 @@ def reset_durations(prefix: str = "") -> None:
         for k in [k for k in _durations if k.startswith(prefix)]:
             del _durations[k]
             _duration_next.pop(k, None)
+            _duration_stats.pop(k, None)
 
 
 def percentiles(prefix: str = "") -> Dict[str, float]:
@@ -174,11 +243,15 @@ def percentiles(prefix: str = "") -> Dict[str, float]:
         for k, v in _durations.items():
             if k.startswith(prefix):
                 merged.extend(v)
-    if not merged:
+    return _percentile_digest(merged)
+
+
+def _percentile_digest(samples: list) -> Dict[str, float]:
+    if not samples:
         return {}
     import numpy as np
 
-    arr = np.asarray(merged, dtype=np.float64)
+    arr = np.asarray(samples, dtype=np.float64)
     p50, p95, p99 = np.percentile(arr, [50.0, 95.0, 99.0])
     return {
         "count": int(arr.size),
@@ -188,6 +261,28 @@ def percentiles(prefix: str = "") -> Dict[str, float]:
         "p99": float(p99),
         "max": float(arr.max()),
     }
+
+
+def duration_digests(prefix: str = "") -> Dict[str, Dict[str, float]]:
+    """Mergeable per-series digests {name: {count, sum_s, min_s, max_s}} —
+    the duration form a TelemetrySnapshot carries: unlike percentiles these
+    merge associatively across executors, so a driver-side rollup is exact
+    regardless of merge order.  Built from LIFETIME running totals, not the
+    capped sample ring, so count/sum stay monotonic past the ring's
+    eviction point and snapshot deltas (registry.telemetry(since=...)) are
+    exact on arbitrarily busy series (percentiles over the raw ring remain
+    a most-recent-traffic view; see docs/observability.md)."""
+    out: Dict[str, Dict[str, float]] = {}
+    with _durations_lock:
+        for k, s in _duration_stats.items():
+            if k.startswith(prefix):
+                out[k] = {
+                    "count": s[0],
+                    "sum_s": s[1],
+                    "min_s": s[2],
+                    "max_s": s[3],
+                }
+    return out
 
 
 # -- per-thread ordered event log --------------------------------------------
@@ -222,12 +317,62 @@ def reset_events() -> None:
     _event_log().clear()
 
 
-@contextlib.contextmanager
-def phase(name: str) -> Iterator[None]:
-    """Named range: xprof TraceAnnotation + wall-clock accounting.
+# -- hierarchical spans -------------------------------------------------------
+# A span is the phase timer grown a parent: while a trace session is active,
+# every completed span appends ONE record (name, t0, t1, thread, span id,
+# parent id, attrs) to a process-wide bounded buffer under a lock.  The
+# per-thread parent stack exists only while collecting, so the disabled path
+# is byte-for-byte the old flat timer: TraceAnnotation + two thread-local
+# dict updates, no allocation, no lock (asserted by the zero-overhead guard
+# in tests/test_profiling.py).
 
-    The TraceAnnotation shows up in a tensorboard/xprof capture exactly where
-    NVTX ranges show up in nsys for the reference's Scala path."""
+_TRACE_CAP = 131072
+
+_trace_lock = threading.Lock()
+_trace_records: List[tuple] = []
+_collect_depth = 0  # active trace sessions / collection scopes
+_span_ids = itertools.count(1)
+_session_seq = itertools.count(1)
+
+
+class _SpanHandle:
+    """Yielded by span(): set(**kv) attaches counters (bytes=, rows=...) to
+    the span record mid-flight.  The module-level null handle is what the
+    disabled path yields — set() is a no-op there, so call sites never
+    branch on whether tracing is on."""
+
+    __slots__ = ("attrs",)
+
+    def __init__(self, attrs: Optional[Dict[str, Any]]):
+        self.attrs = attrs
+
+    def set(self, **kv: Any) -> None:
+        if self.attrs is not None:
+            self.attrs.update(kv)
+
+
+_NULL_SPAN = _SpanHandle(None)
+
+
+def _span_stack() -> list:
+    stack = getattr(_tls, "span_stack", None)
+    if stack is None:
+        stack = []
+        _tls.span_stack = stack
+    return stack
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[_SpanHandle]:
+    """Named range: xprof TraceAnnotation + wall-clock accounting + (while a
+    trace session is active) one hierarchical span record.
+
+    The TraceAnnotation shows up in a tensorboard/xprof capture exactly
+    where NVTX ranges show up in nsys for the reference's Scala path; the
+    span record is what the Chrome-trace export and TelemetrySnapshot
+    rollups are built from.  `attrs` become the trace event's args
+    (bytes=, rows=, block=...); they are ignored — never allocated — when
+    no session is collecting."""
     try:
         import jax.profiler
 
@@ -236,13 +381,388 @@ def phase(name: str) -> Iterator[None]:
         )
     except Exception:  # pragma: no cover - profiler always importable with jax
         annotation = contextlib.nullcontext()
+    collecting = _collect_depth > 0
+    if collecting:
+        sid = next(_span_ids)
+        stack = _span_stack()
+        parent = stack[-1] if stack else 0
+        stack.append(sid)
+        handle = _SpanHandle(dict(attrs))
+    else:
+        handle = _NULL_SPAN
     t0 = time.perf_counter()
-    with annotation:
+    try:
+        with annotation:
+            yield handle
+    finally:
+        t1 = time.perf_counter()
+        dt = t1 - t0
+        reg = _registry()
+        reg[name] = reg.get(name, 0.0) + dt
+        cnt = _count_registry()
+        cnt[name] = cnt.get(name, 0) + 1
+        if collecting:
+            stack.pop()
+            th = threading.current_thread()
+            with _trace_lock:
+                if len(_trace_records) < _TRACE_CAP:
+                    _trace_records.append(
+                        (name, t0, t1, th.ident, th.name, sid, parent,
+                         handle.attrs)
+                    )
+        _log.debug("phase %s: %.3fs", name, dt)
+
+
+# API-compatible shim: every existing phase site is a span site
+phase = span
+
+
+def span_records() -> List[tuple]:
+    """Copy of the collected span records (name, t0, t1, thread_ident,
+    thread_name, span_id, parent_id, attrs) — test/introspection surface."""
+    with _trace_lock:
+        return list(_trace_records)
+
+
+@contextlib.contextmanager
+def collect_spans() -> Iterator[None]:
+    """Enable span-record collection for the enclosing scope WITHOUT writing
+    a trace file (trace_session composes this with the Chrome-trace writer;
+    tests use it directly).  Reentrant; the shared buffer clears when the
+    last scope exits."""
+    global _collect_depth
+    with _trace_lock:
+        _collect_depth += 1
+    try:
         yield
-    dt = time.perf_counter() - t0
-    reg = _registry()
-    reg[name] = reg.get(name, 0.0) + dt
-    _log.debug("phase %s: %.3fs", name, dt)
+    finally:
+        with _trace_lock:
+            _collect_depth -= 1
+            if _collect_depth == 0:
+                _trace_records.clear()
+
+
+def _safe_tag(tag: str) -> str:
+    return "".join(c if (c.isalnum() or c in "-_.") else "-" for c in tag)
+
+
+def _write_chrome_trace(path: str, records: List[tuple]) -> None:
+    """Write span records as Chrome trace-event JSON (the `traceEvents`
+    array format Perfetto and chrome://tracing load): one complete ("X")
+    event per span with microsecond ts/dur relative to the process epoch,
+    plus thread_name metadata events so worker threads are labeled."""
+    pid = os.getpid()
+    tid_of: Dict[int, int] = {}
+    names: Dict[int, str] = {}
+    events_out: List[Dict[str, Any]] = []
+    for name, t0, t1, ident, tname, sid, parent, attrs in records:
+        tid = tid_of.setdefault(ident, len(tid_of) + 1)
+        names.setdefault(tid, tname)
+        args: Dict[str, Any] = {"span_id": sid}
+        if parent:
+            args["parent_id"] = parent
+        if attrs:
+            args.update(attrs)
+        events_out.append(
+            {
+                "name": name,
+                "cat": "srml",
+                "ph": "X",
+                "ts": (t0 - _EPOCH) * 1e6,
+                "dur": (t1 - t0) * 1e6,
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            }
+        )
+    meta = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": tname},
+        }
+        for tid, tname in sorted(names.items())
+    ]
+    doc = {"traceEvents": meta + events_out, "displayTimeUnit": "ms"}
+    tmp = f"{path}.tmp{pid}"
+    try:
+        with open(tmp, "w") as f:
+            # default=str: span attrs are an open kwargs surface (numpy
+            # scalars, dtypes, ...) and a non-JSON attr must degrade to its
+            # repr, never fail the export
+            json.dump(doc, f, default=str)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
+
+
+@contextlib.contextmanager
+def trace_session(tag: str = "session") -> Iterator[Optional[str]]:
+    """Collect spans for the enclosed region and write them as ONE Chrome
+    trace-event JSON file under $SRML_TRACE_DIR (yielding the target path).
+    No-op — zero overhead, yields None — when the env var is unset.  Opened
+    automatically around every top-level fit (core / parallel runner),
+    kneighbors search, and serving engine lifetime; overlapping sessions
+    each export their own window of the shared buffer."""
+    out_dir = os.environ.get(TRACE_ENV)
+    if not out_dir:
+        yield None
+        return
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+    except OSError as exc:
+        # a bad observability env var must never fail the fit/search/server
+        # it wraps — degrade to the disabled path with one warning
+        _log.warning(
+            "%s=%r is not writable (%s); tracing disabled for %r",
+            TRACE_ENV, out_dir, exc, tag,
+        )
+        yield None
+        return
+    path = os.path.join(
+        out_dir,
+        f"{_safe_tag(tag)}-{os.getpid()}-{next(_session_seq):04d}.trace.json",
+    )
+    global _collect_depth
+    with _trace_lock:
+        _collect_depth += 1
+    t_start = time.perf_counter()
+    try:
+        yield path
+    finally:
+        with _trace_lock:
+            records = [r for r in _trace_records if r[1] >= t_start]
+            _collect_depth -= 1
+            if _collect_depth == 0:
+                _trace_records.clear()
+        try:
+            _write_chrome_trace(path, records)
+            _log.info(
+                "srml-scope trace for %r: %d span(s) -> %s",
+                tag, len(records), path,
+            )
+        except Exception as exc:  # disk-full, serialization drift, ...
+            # the export is best-effort by design: it runs in a finally
+            # around successful fits/searches and must never replace their
+            # result with a telemetry crash
+            _log.warning("trace export for %r failed: %s", tag, exc)
+
+
+# -- mergeable telemetry snapshots -------------------------------------------
+
+
+class TelemetrySnapshot:
+    """Serializable rollup of one session's observability: span/phase stats,
+    counter deltas, and duration digests.
+
+    Merge rules are associative AND commutative (sums, mins, maxes — the
+    same algebra as metrics/binary.py partials), so executor-side snapshots
+    captured at fit-task exit can cross the Spark wire as JSON and merge on
+    the driver in any order: merge(a, b) == merge(b, a) and
+    merge(merge(a, b), c) == merge(a, merge(b, c)) on every rollup field."""
+
+    __slots__ = ("phases", "counters", "durations", "meta")
+
+    def __init__(
+        self,
+        phases: Optional[Dict[str, Dict[str, float]]] = None,
+        counters: Optional[Dict[str, int]] = None,
+        durations: Optional[Dict[str, Dict[str, float]]] = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ):
+        self.phases = dict(phases or {})
+        self.counters = dict(counters or {})
+        self.durations = dict(durations or {})
+        self.meta = dict(meta or {})
+        self.meta.setdefault("ranks", [])
+
+    @classmethod
+    def capture(
+        cls,
+        counters_before: Optional[Dict[str, int]] = None,
+        counter_prefix: str = "",
+        duration_prefix: Optional[str] = None,
+        rank: Optional[int] = None,
+    ) -> "TelemetrySnapshot":
+        """Snapshot THIS thread's phase stats plus the process counters
+        (delta vs `counters_before` when given, so a fit reports what IT
+        moved, not process history) and optionally duration digests under
+        `duration_prefix`."""
+        ctr = (
+            counter_deltas(counters_before, counter_prefix)
+            if counters_before is not None
+            else counters(counter_prefix)
+        )
+        dur = (
+            duration_digests(duration_prefix)
+            if duration_prefix is not None
+            else {}
+        )
+        meta: Dict[str, Any] = {"ranks": [int(rank)] if rank is not None else []}
+        return cls(phases=phase_stats(), counters=ctr, durations=dur, meta=meta)
+
+    def merge(self, other: "TelemetrySnapshot") -> "TelemetrySnapshot":
+        phases: Dict[str, Dict[str, float]] = {}
+        for src in (self.phases, other.phases):
+            for k, v in src.items():
+                agg = phases.setdefault(k, {"count": 0, "total_s": 0.0})
+                agg["count"] += int(v.get("count", 0))
+                agg["total_s"] += float(v.get("total_s", 0.0))
+        ctr: Dict[str, int] = dict(self.counters)
+        for k, v in other.counters.items():
+            ctr[k] = ctr.get(k, 0) + v
+        dur: Dict[str, Dict[str, float]] = {}
+        for src in (self.durations, other.durations):
+            for k, v in src.items():
+                agg = dur.get(k)
+                if agg is None:
+                    dur[k] = dict(v)
+                else:
+                    agg["count"] += v["count"]
+                    agg["sum_s"] += v["sum_s"]
+                    agg["min_s"] = min(agg["min_s"], v["min_s"])
+                    agg["max_s"] = max(agg["max_s"], v["max_s"])
+        meta = {
+            "ranks": sorted(
+                set(self.meta.get("ranks", [])) | set(other.meta.get("ranks", []))
+            )
+        }
+        return TelemetrySnapshot(
+            phases=phases, counters=ctr, durations=dur, meta=meta
+        )
+
+    def phase_seconds(self, prefix: str = "") -> Dict[str, float]:
+        """{phase name: total seconds} — the phase_times() view of a merged
+        snapshot (what the driver prints for a live-Spark fit)."""
+        return {
+            k: float(v.get("total_s", 0.0))
+            for k, v in self.phases.items()
+            if k.startswith(prefix)
+        }
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "schema": "srml-scope/v1",
+            "phases": self.phases,
+            "counters": self.counters,
+            "durations": self.durations,
+            "meta": self.meta,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TelemetrySnapshot":
+        return cls(
+            phases=d.get("phases"),
+            counters=d.get("counters"),
+            durations=d.get("durations"),
+            meta=d.get("meta"),
+        )
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, TelemetrySnapshot)
+            and self.to_dict() == other.to_dict()
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"TelemetrySnapshot(phases={len(self.phases)}, "
+            f"counters={len(self.counters)}, durations={len(self.durations)}, "
+            f"ranks={self.meta.get('ranks', [])})"
+        )
+
+
+# -- export surface -----------------------------------------------------------
+
+
+def spread_attribution(
+    phase_runs: List[Dict[str, float]],
+    median_s: float,
+    floor_pct: float = 1.0,
+    top: int = 5,
+) -> Dict[str, float]:
+    """Attribute a multi-repeat timing spread to phases: for each phase
+    name across `phase_runs` (one phase_times() dict per timed repeat),
+    report max−min as % of the median run `median_s` — which phase's
+    variance IS the spread.  Phases under `floor_pct` are dropped; the
+    `top` largest survive, largest first.  The ONE implementation behind
+    bench.py's per-arm spread_attribution and benchmark/base.py's
+    cross-run aggregation (both write the same artifact keys)."""
+    if len(phase_runs) < 2 or median_s <= 0:
+        return {}
+    names = set().union(*(set(p) for p in phase_runs))
+    out = {}
+    for n in names:
+        vals = [float(p.get(n, 0.0)) for p in phase_runs]
+        pct = 100.0 * (max(vals) - min(vals)) / median_s
+        if pct >= floor_pct:
+            out[n] = round(pct, 1)
+    return dict(sorted(out.items(), key=lambda kv: -kv[1])[:top])
+
+
+def export_metrics(prefix: str = "") -> Dict[str, Any]:
+    """One stable JSON document of the process's observability state:
+    counters, per-series duration percentile summaries, and this thread's
+    phase stats (all optionally prefix-filtered).  Embedded into benchmark
+    artifacts and round-trippable through json.dumps/loads (asserted by the
+    CI observability gate)."""
+    dur: Dict[str, Dict[str, float]] = {}
+    with _durations_lock:
+        series = {
+            k: list(v) for k, v in _durations.items() if k.startswith(prefix)
+        }
+    for k, v in series.items():
+        dur[k] = _percentile_digest(v)
+    return {
+        "schema": "srml-scope/v1",
+        "counters": counters(prefix),
+        "durations": dur,
+        "phases": phase_stats(prefix),
+    }
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def render_prometheus(metrics: Optional[Dict[str, Any]] = None) -> str:
+    """Prometheus text exposition of export_metrics(): counters as
+    `srml_counter{name="..."}`, phases as seconds/count pairs, duration
+    series as quantile summaries.  Names ride a label (srml counter names
+    carry dots, which Prometheus metric names cannot)."""
+    m = metrics if metrics is not None else export_metrics()
+    lines = ["# TYPE srml_counter counter"]
+    for k, v in sorted(m.get("counters", {}).items()):
+        lines.append(f'srml_counter{{name="{_prom_escape(k)}"}} {v}')
+    lines.append("# TYPE srml_phase_seconds_total counter")
+    lines.append("# TYPE srml_phase_count_total counter")
+    for k, v in sorted(m.get("phases", {}).items()):
+        n = _prom_escape(k)
+        lines.append(f'srml_phase_seconds_total{{name="{n}"}} {v["total_s"]}')
+        lines.append(f'srml_phase_count_total{{name="{n}"}} {v["count"]}')
+    lines.append("# TYPE srml_duration_seconds summary")
+    for k, d in sorted(m.get("durations", {}).items()):
+        if not d:
+            continue
+        n = _prom_escape(k)
+        for q_label, q_key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            lines.append(
+                f'srml_duration_seconds{{name="{n}",quantile="{q_label}"}} '
+                f"{d[q_key]}"
+            )
+        lines.append(
+            f'srml_duration_seconds_sum{{name="{n}"}} '
+            f"{d['mean'] * d['count']}"
+        )
+        lines.append(f'srml_duration_seconds_count{{name="{n}"}} {d["count"]}')
+    return "\n".join(lines) + "\n"
+
+
+# -- xprof capture / benchmark helpers ----------------------------------------
 
 
 @contextlib.contextmanager

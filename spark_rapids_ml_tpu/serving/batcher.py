@@ -26,7 +26,6 @@ from __future__ import annotations
 import collections
 import os
 import threading
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -78,7 +77,7 @@ class _Request:
         self.features = features
         self.n_rows = int(features.shape[0])
         self.future: "Future[Dict[str, np.ndarray]]" = Future()
-        self.enqueue_t = time.perf_counter()
+        self.enqueue_t = profiling.now()
         self.deadline_t = (
             self.enqueue_t + timeout_s if timeout_s and timeout_s > 0 else None
         )
@@ -222,7 +221,7 @@ class MicroBatcher:
                     if rows >= self.max_batch or self._draining or self._stopped:
                         reason = "full" if rows >= self.max_batch else "drain"
                         break
-                    remaining = deadline - time.perf_counter()
+                    remaining = deadline - profiling.now()
                     if remaining <= 0:
                         reason = "deadline"
                         break
@@ -233,7 +232,7 @@ class MicroBatcher:
                     continue
                 batch: List[_Request] = []
                 taken_rows = 0
-                now = time.perf_counter()
+                now = profiling.now()
                 while self._queue:
                     req = self._queue[0]
                     if req.deadline_t is not None and now > req.deadline_t:
@@ -281,13 +280,13 @@ class MicroBatcher:
         """Block until every admitted request has an outcome; True on
         quiescence, False on timeout."""
         deadline = (
-            time.perf_counter() + timeout_s if timeout_s is not None else None
+            profiling.now() + timeout_s if timeout_s is not None else None
         )
         with self._done_lock:
             while self._outstanding > 0:
                 remaining = None
                 if deadline is not None:
-                    remaining = deadline - time.perf_counter()
+                    remaining = deadline - profiling.now()
                     if remaining <= 0:
                         return False
                 self._quiescent.wait(remaining)

@@ -30,7 +30,6 @@ from __future__ import annotations
 import contextlib
 import logging
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -123,12 +122,34 @@ class ModelServer:
         self._wide = np.dtype(self._entry.dtype).itemsize == 8
         self._steady_compiles = 0
         self._warmed = False
-        if warm:
-            self._warm_buckets()
-        self._worker = threading.Thread(
-            target=self._run, name=f"srml-serve-{self.name}", daemon=True
+        # one srml-scope trace session spans the server's lifetime (warmup
+        # through shutdown) when SRML_TRACE_DIR is set: every queue/dispatch
+        # span — recorded on the worker thread — lands in one Perfetto file.
+        # The session holds the process-wide span-collection scope open, so
+        # it MUST close on every exit path: a failed warmup closes it here
+        # (re-raised), shutdown() closes it normally, and __del__ backstops
+        # a server abandoned without shutdown — a leaked scope would starve
+        # every later fit/search trace of its spans.
+        self._trace_stack = contextlib.ExitStack()
+        self.trace_path = self._trace_stack.enter_context(
+            profiling.trace_session(f"serve-{self.name}")
         )
-        self._worker.start()
+        try:
+            if warm:
+                self._warm_buckets()
+            self._worker = threading.Thread(
+                target=self._run, name=f"srml-serve-{self.name}", daemon=True
+            )
+            self._worker.start()
+        except BaseException:
+            self._trace_stack.close()
+            raise
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self._trace_stack.close()  # idempotent
+        except Exception:
+            pass
 
     # -- warmup -------------------------------------------------------------
     def _warm_buckets(self) -> None:
@@ -139,8 +160,10 @@ class ModelServer:
         After this, the compile watermark is the steady-state baseline."""
         from ..ops.precompile import global_precompiler
 
-        t0 = time.perf_counter()
-        with _warm_scope():
+        t0 = profiling.now()
+        with _warm_scope(), profiling.span(
+            f"serve.{self.name}.warm", buckets=len(self.buckets)
+        ):
             keys = self._entry.warm(list(self.buckets))
             if keys:
                 global_precompiler().wait(keys)
@@ -155,7 +178,7 @@ class ModelServer:
                         f"serving entry {self._entry.name!r} returned columns "
                         f"{sorted(out)} missing declared {missing}"
                     )
-        profiling.record_duration(f"serve.{self.name}.warmup", time.perf_counter() - t0)
+        profiling.record_duration(f"serve.{self.name}.warmup", profiling.now() - t0)
         profiling.incr_counter(f"{self.ns}.warmed_buckets", len(self.buckets))
         self._warmed = True
 
@@ -191,7 +214,12 @@ class ModelServer:
     # -- dispatch worker ----------------------------------------------------
     def _run(self) -> None:
         while True:
-            item = self._batcher.take()
+            # the queue span covers the worker's wait for a coalesced batch:
+            # in a trace, long serve.<n>.queue spans between short dispatch
+            # spans read as spare capacity, back-to-back dispatches as
+            # saturation
+            with profiling.span(f"serve.{self.name}.queue"):
+                item = self._batcher.take()
             if item is None:
                 return
             batch, _reason = item
@@ -227,16 +255,19 @@ class ModelServer:
         # attribution entirely — see _warm_scope.
         active0, epoch0 = _warm_snapshot()
         mark0 = _compile_watermark() if self._warmed else 0
-        t0 = time.perf_counter()
+        t0 = profiling.now()
         try:
-            with self._x64_scope():
+            with self._x64_scope(), profiling.span(
+                f"serve.{self.name}.dispatch",
+                rows=n_rows, bucket=b, requests=len(batch),
+            ):
                 out = self._entry.call(padded)
         except BaseException as exc:  # noqa: BLE001 - relayed to every waiter
             profiling.incr_counter(f"{self.ns}.errors")
             for r in batch:
                 resolve_future(r.future, exc=exc)
             return
-        dt = time.perf_counter() - t0
+        dt = profiling.now() - t0
         profiling.record_duration(f"serve.{self.name}.dispatch", dt)
         profiling.record_duration(f"serve.{self.name}.occupancy", float(len(batch)))
         if self._warmed:
@@ -250,7 +281,7 @@ class ModelServer:
                     profiling.incr_counter(
                         f"{self.ns}.unattributed_compiles", delta
                     )
-        done_t = time.perf_counter()
+        done_t = profiling.now()
         off = 0
         for r in batch:
             sl = slice(off, off + r.n_rows)
@@ -275,14 +306,19 @@ class ModelServer:
             )
 
     def shutdown(self, drain: bool = True, timeout_s: float = 60.0) -> None:
-        if drain:
-            try:
-                self.drain(timeout_s=timeout_s)
-            finally:
+        try:
+            if drain:
+                try:
+                    self.drain(timeout_s=timeout_s)
+                finally:
+                    self._batcher.stop()
+            else:
                 self._batcher.stop()
-        else:
-            self._batcher.stop()
-        self._worker.join(timeout=timeout_s)
+            self._worker.join(timeout=timeout_s)
+        finally:
+            # close the lifetime trace session (writes the Perfetto file
+            # when SRML_TRACE_DIR is set; no-op otherwise)
+            self._trace_stack.close()
 
     def __enter__(self) -> "ModelServer":
         return self

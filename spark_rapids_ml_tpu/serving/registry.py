@@ -89,6 +89,46 @@ class ModelRegistry:
             servers = {n: s for n, s in self._servers.items() if s is not None}
         return {name: s.stats() for name, s in sorted(servers.items())}
 
+    def telemetry(self, since: Optional[Any] = None) -> Any:
+        """TelemetrySnapshot of the whole serving plane: every
+        serving.<name>.* counter plus mergeable digests of the serve.<name>.*
+        duration series.  Pass a previous snapshot as `since` for a delta —
+        counter differences and count/sum duration deltas — so a scrape loop
+        (or a live-Spark executor shipping its registry state to the driver)
+        reports "what moved this window" instead of process history.
+        Snapshots from many processes merge() associatively driver-side,
+        exactly like fit telemetry."""
+        from .. import profiling
+
+        snap = profiling.TelemetrySnapshot(
+            counters=profiling.counters("serving."),
+            durations=profiling.duration_digests("serve."),
+        )
+        if since is None:
+            return snap
+        ctr = {
+            k: v - since.counters.get(k, 0)
+            for k, v in snap.counters.items()
+            if v != since.counters.get(k, 0)
+        }
+        dur = {}
+        for k, d in snap.durations.items():
+            prev = since.durations.get(k)
+            if prev is None:
+                dur[k] = dict(d)
+                continue
+            dc = d["count"] - prev["count"]
+            if dc > 0:
+                # min/max cannot be un-merged; the window keeps the current
+                # extremes (documented in docs/observability.md)
+                dur[k] = {
+                    "count": dc,
+                    "sum_s": d["sum_s"] - prev["sum_s"],
+                    "min_s": d["min_s"],
+                    "max_s": d["max_s"],
+                }
+        return profiling.TelemetrySnapshot(counters=ctr, durations=dur)
+
     def shutdown(self, drain: bool = True) -> None:
         with self._lock:
             servers = [s for s in self._servers.values() if s is not None]

@@ -207,6 +207,88 @@ def test_allgather_bytes_string_plane_uses_base64():
     assert bar.wire_chars > 0
 
 
+# -- section(): the ONE collective reporting wrapper --------------------------
+
+
+def test_host_sections_report_uniform_byte_time_counters():
+    """Every host collective reports exchange.<name>.bytes/time_ns/calls
+    through section() — the uniform namespace of ROADMAP item 5."""
+    from spark_rapids_ml_tpu import profiling
+
+    profiling.reset_counters("exchange.")
+    nranks = 2
+    bar = StringBarrier(nranks)
+    payloads = [b"a" * 300, b"b" * 50]
+
+    def fn(rank):
+        out = allgather_bytes(bar.plane(rank), payloads[rank], chunk=128)
+        return alltoall_bytes(
+            bar.plane(rank), rank, nranks, [b"x" * 10, b"y" * 20], chunk=16
+        ) and out
+
+    _run_ranks(nranks, fn)
+    ctr = profiling.counters("exchange.")
+    assert ctr["exchange.allgather.calls"] == nranks
+    assert ctr["exchange.allgather.bytes"] == sum(len(p) for p in payloads)
+    assert ctr["exchange.allgather.time_ns"] > 0
+    assert ctr["exchange.alltoall.calls"] == nranks
+    assert ctr["exchange.alltoall.bytes"] == nranks * 30
+    assert ctr["exchange.alltoall.time_ns"] > 0
+    # wall-clock also lands in the per-thread phase registry as before
+    profiling.reset_counters("exchange.")
+
+
+def test_device_sections_report_static_bytes_at_trace_time():
+    """psum_parts/allgather_rows/psum_merge_parts report exchange.<name>
+    bytes + trace counts through the same section namespace.  Device
+    sections move counters at TRACE time (shapes are static; wall clock is
+    meaningless inside a traced body) — a fresh jit trace moves them, a
+    cached re-execution does not."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu import profiling
+    from spark_rapids_ml_tpu.compat import shard_map
+    from spark_rapids_ml_tpu.parallel.exchange import (
+        allgather_rows,
+        psum_merge_parts,
+        psum_parts,
+    )
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, get_mesh
+
+    mesh = get_mesh()
+    n_dev = mesh.devices.size
+    profiling.reset_counters("exchange.")
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    def f(x):
+        def body(xs):
+            s = psum_parts(xs.sum())
+            g = allgather_rows(xs)
+            m = psum_merge_parts(xs)
+            return (s + g.sum() + m.sum()).reshape(1)
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS)
+        )(x)
+
+    x = jnp.arange(4 * n_dev, dtype=jnp.float32)
+    f(x)
+    ctr = profiling.counters("exchange.")
+    per_shard_bytes = 4 * 4  # (4,) f32 per shard
+    assert ctr["exchange.psum_parts.traces"] == 1
+    assert ctr["exchange.allgather_rows.traces"] == 1
+    assert ctr["exchange.psum_merge_parts.traces"] == 1
+    assert ctr["exchange.allgather_rows.bytes"] == per_shard_bytes
+    assert ctr["exchange.psum_merge_parts.bytes"] == per_shard_bytes
+    assert ctr["exchange.psum_parts.bytes"] == 4  # scalar partial
+    # cached re-execution: no new trace, counters frozen
+    f(x)
+    assert profiling.counters("exchange.") == ctr
+    profiling.reset_counters("exchange.")
+
+
 def test_distributed_kneighbors_binary_exchange_end_to_end():
     """4 thread-ranks over the string-only mock: the full kneighbors
     exchange (binary frames both rounds) must reproduce a single-process

@@ -462,6 +462,71 @@ def test_baseline_demotes_then_catches_new(tmp_path):
     assert len(errors) == len(findings) and len(warnings) == len(findings)
 
 
+# -- R6: raw wall clocks outside srml-scope -----------------------------------
+
+R6_BAD = """
+    import time
+
+    def _dispatch(self, batch):
+        t0 = time.perf_counter()
+        run(batch)
+        return time.time() - t0
+"""
+
+R6_GOOD = """
+    from .. import profiling
+
+    def _dispatch(self, batch):
+        t0 = profiling.now()
+        with profiling.span("serve.dispatch"):
+            run(batch)
+        return profiling.now() - t0
+"""
+
+R6_MONOTONIC_OK = """
+    import time
+
+    def poll(deadline):
+        while time.monotonic() < deadline:
+            time.sleep(0.01)
+"""
+
+
+def test_r6_fires_on_raw_clock_in_package_module():
+    findings = _lint(R6_BAD, path="spark_rapids_ml_tpu/serving/engine.py")
+    assert _rules_of(findings) == ["R6"]
+    assert len(findings) == 2  # perf_counter AND time.time
+    assert "profiling.now()" in findings[0].message
+
+
+def test_r6_scoped_to_the_package_and_exempts_profiling():
+    # profiling.py is the clock's home
+    assert _lint(R6_BAD, path="spark_rapids_ml_tpu/profiling.py") == []
+    # benchmark/test harness code may time however it likes
+    assert _lint(R6_BAD, path="benchmark/base.py") == []
+    assert _lint(R6_BAD, path="tests/test_x.py") == []
+
+
+def test_r6_silent_on_srml_scope_and_monotonic():
+    assert _lint(R6_GOOD, path="spark_rapids_ml_tpu/serving/engine.py") == []
+    # deadline polling (monotonic/sleep) is control flow, not observability
+    assert (
+        _lint(R6_MONOTONIC_OK, path="spark_rapids_ml_tpu/parallel/runner.py")
+        == []
+    )
+
+
+def test_r6_pragma_escape():
+    src = """
+        import time
+
+        def boot():
+            t0 = time.perf_counter()  # graftlint: disable=R6 (pre-profiling bootstrap)
+            return t0
+    """
+    assert _lint(src, path="spark_rapids_ml_tpu/x.py") == []
+
+
 # -- the gate: the real tree is clean -----------------------------------------
 
 

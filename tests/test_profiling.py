@@ -1,7 +1,11 @@
-# Tracing/profiling hooks (SURVEY.md §5: NVTX-range analog via
+# srml-scope (SURVEY.md §5: NVTX-range analog via
 # jax.profiler.TraceAnnotation + coarse phase logging, reference
-# RapidsRowMatrix.scala:62,70 and core.py:583,617).
+# RapidsRowMatrix.scala:62,70 and core.py:583,617): flat phase timers,
+# hierarchical spans + Chrome-trace export, mergeable telemetry snapshots,
+# and the export surface.
+import json
 import os
+import threading
 
 import numpy as np
 import pytest
@@ -150,6 +154,210 @@ def test_duration_cap_is_a_ring_buffer(monkeypatch):
     assert len(series) == 4  # capped
     assert sorted(series) == [2.0, 3.0, 4.0, 5.0]  # oldest overwritten
     profiling.reset_durations("t.ring")
+
+
+# -- hierarchical spans / trace export ---------------------------------------
+
+
+def test_span_nesting_and_thread_attribution():
+    """Span records carry parent ids (per-thread stack) and the recording
+    thread's ident/name — the hierarchy the Chrome-trace export renders."""
+    profiling.reset_phase_times()
+    with profiling.collect_spans():
+        with profiling.span("t.outer"):
+            with profiling.span("t.inner", block=7) as sp:
+                sp.set(bytes=123)
+        def worker():
+            with profiling.span("t.worker"):
+                pass
+        th = threading.Thread(target=worker, name="unit-worker")
+        th.start()
+        th.join()
+        recs = {r[0]: r for r in profiling.span_records()}
+    assert set(recs) == {"t.outer", "t.inner", "t.worker"}
+    outer, inner, worker_r = recs["t.outer"], recs["t.inner"], recs["t.worker"]
+    # parent: inner's parent_id is outer's span_id; outer and worker are roots
+    assert inner[6] == outer[5]
+    assert outer[6] == 0 and worker_r[6] == 0
+    # timestamps nest: outer contains inner
+    assert outer[1] <= inner[1] <= inner[2] <= outer[2]
+    # thread attribution: the worker span carries ITS thread, not ours
+    assert worker_r[3] != outer[3]
+    assert worker_r[4] == "unit-worker"
+    # attached counters (attrs) survive, including mid-span set()
+    assert inner[7] == {"block": 7, "bytes": 123}
+    # the flat registry still accumulated (phase() compatibility)
+    assert "t.inner" in profiling.phase_times()
+    # buffer cleared once the last collection scope exits
+    assert profiling.span_records() == []
+
+
+def test_span_disabled_path_has_zero_overhead(monkeypatch):
+    """Spans off => no span records, no per-thread stack, no counters, and
+    the null handle (no attrs dict allocated) — the hard zero-cost rule."""
+    monkeypatch.delenv(profiling.TRACE_ENV, raising=False)
+    counters_before = profiling.counters()
+    seen = {}
+
+    def worker():  # a FRESH thread proves no thread-local stack appears
+        with profiling.span("t.off", bytes=1) as sp:
+            sp.set(rows=2)  # must be a silent no-op
+        seen["handle_attrs"] = sp.attrs
+        seen["has_stack"] = hasattr(profiling._tls, "span_stack")
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    assert seen["handle_attrs"] is None  # null handle: nothing allocated
+    assert seen["has_stack"] is False
+    assert profiling.span_records() == []
+    assert profiling.counters() == counters_before
+    with profiling.trace_session("t-noop") as path:  # env unset -> no-op
+        assert path is None
+
+
+def test_trace_session_writes_valid_chrome_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv(profiling.TRACE_ENV, str(tmp_path))
+    with profiling.trace_session("unit sess") as path:
+        assert path is not None and str(tmp_path) in path
+        with profiling.span("t.a", rows=4):
+            with profiling.span("t.b"):
+                pass
+    with open(path) as f:
+        doc = json.load(f)
+    assert isinstance(doc["traceEvents"], list)
+    complete = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in complete} == {"t.a", "t.b"}
+    for e in complete:
+        # the Chrome trace-event contract Perfetto loads: microsecond
+        # ts/dur, pid/tid, name, args
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid", "args"}
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    assert any(m["name"] == "thread_name" for m in meta)
+    by = {e["name"]: e for e in complete}
+    assert by["t.b"]["args"]["parent_id"] == by["t.a"]["args"]["span_id"]
+    assert by["t.a"]["args"]["rows"] == 4
+    # session tag is sanitized into the filename
+    assert os.path.basename(path).startswith("unit-sess-")
+
+
+# -- telemetry snapshots ------------------------------------------------------
+
+
+def _snap(**kw):
+    return profiling.TelemetrySnapshot(**kw)
+
+
+def test_telemetry_merge_is_commutative_and_associative():
+    a = _snap(
+        phases={"f.x": {"count": 1, "total_s": 2.0}},
+        counters={"c.a": 3},
+        durations={"d.l": {"count": 2, "sum_s": 1.0, "min_s": 0.25, "max_s": 0.75}},
+        meta={"ranks": [0]},
+    )
+    b = _snap(
+        phases={"f.x": {"count": 2, "total_s": 1.5}, "f.y": {"count": 1, "total_s": 0.5}},
+        counters={"c.a": 1, "c.b": 7},
+        durations={"d.l": {"count": 1, "sum_s": 3.0, "min_s": 3.0, "max_s": 3.0}},
+        meta={"ranks": [1]},
+    )
+    c = _snap(counters={"c.b": 2}, meta={"ranks": [2]})
+    assert a.merge(b) == b.merge(a)
+    assert a.merge(b).merge(c) == a.merge(b.merge(c))
+    m = a.merge(b)
+    assert m.phases["f.x"] == {"count": 3, "total_s": 3.5}
+    assert m.counters == {"c.a": 4, "c.b": 7}
+    assert m.durations["d.l"] == {
+        "count": 3, "sum_s": 4.0, "min_s": 0.25, "max_s": 3.0,
+    }
+    assert m.meta["ranks"] == [0, 1]
+    # wire round-trip (the Spark result path ships snapshots as JSON)
+    rt = profiling.TelemetrySnapshot.from_dict(
+        json.loads(json.dumps(m.to_dict()))
+    )
+    assert rt == m
+    assert m.phase_seconds("f.") == {"f.x": 3.5, "f.y": 0.5}
+
+
+def test_telemetry_capture_deltas_counters():
+    profiling.reset_counters("t.cap")
+    profiling.reset_phase_times()
+    before = profiling.counters()
+    with profiling.phase("t.cap.phase"):
+        profiling.incr_counter("t.cap.n", 5)
+    snap = profiling.TelemetrySnapshot.capture(before, rank=3)
+    assert snap.counters.get("t.cap.n") == 5
+    # counters that did not move during the window are absent (delta form)
+    assert all(k.startswith("t.cap") or v != 0 for k, v in snap.counters.items())
+    assert snap.phases["t.cap.phase"]["count"] == 1
+    assert snap.meta["ranks"] == [3]
+    profiling.reset_counters("t.cap")
+
+
+def test_local_fit_attaches_telemetry():
+    from spark_rapids_ml_tpu import KMeans
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((96, 6)).astype(np.float32)
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=2)
+    model = KMeans(k=2, maxIter=2).setFeaturesCol("features").fit(df)
+    t = model.fit_telemetry()
+    assert t is not None
+    assert t.phases["srml.fit"]["count"] == 1
+    assert t.phases["srml.fit"]["total_s"] > 0.0
+    assert t.meta["ranks"] == [0]
+    # the telemetry key never leaks into the model attribute dict
+    from spark_rapids_ml_tpu.core import TELEMETRY_ATTR
+
+    assert TELEMETRY_ATTR not in model._get_model_attributes()
+
+
+# -- export surface -----------------------------------------------------------
+
+
+def test_export_metrics_roundtrips_json():
+    profiling.reset_durations("t.em")
+    profiling.reset_counters("t.em")
+    profiling.incr_counter("t.em.c", 2)
+    for v in (0.01, 0.02, 0.03):
+        profiling.record_duration("t.em.lat", v)
+    m = profiling.export_metrics("t.em")
+    assert json.loads(json.dumps(m)) == m
+    assert m["schema"] == "srml-scope/v1"
+    assert m["counters"]["t.em.c"] == 2
+    assert m["durations"]["t.em.lat"]["count"] == 3
+    profiling.reset_durations("t.em")
+    profiling.reset_counters("t.em")
+
+
+def test_render_prometheus_exposition():
+    m = {
+        "counters": {"pre.compile": 4},
+        "phases": {"srml.fit": {"count": 1, "total_s": 2.5}},
+        "durations": {
+            "serve.m.latency": {
+                "count": 10, "mean": 0.02, "p50": 0.01, "p95": 0.05,
+                "p99": 0.09, "max": 0.1,
+            }
+        },
+    }
+    txt = profiling.render_prometheus(m)
+    assert 'srml_counter{name="pre.compile"} 4' in txt
+    assert 'srml_phase_seconds_total{name="srml.fit"} 2.5' in txt
+    assert 'srml_duration_seconds{name="serve.m.latency",quantile="0.5"} 0.01' in txt
+    assert 'srml_duration_seconds_count{name="serve.m.latency"} 10' in txt
+    # every non-comment line is name{labels} value — the exposition shape
+    for line in txt.strip().splitlines():
+        if not line.startswith("#"):
+            assert " " in line and line.startswith("srml_"), line
+
+
+def test_now_is_monotonic():
+    a = profiling.now()
+    b = profiling.now()
+    assert b >= a
 
 
 def test_event_log_order_and_reset():

@@ -343,6 +343,56 @@ def test_latency_percentiles_surface(model_zoo):
     assert stats["buckets"] == serve_buckets(32)
 
 
+def test_failed_server_init_releases_trace_scope(model_zoo, monkeypatch, tmp_path):
+    """A ModelServer whose warmup fails must close its lifetime trace
+    session on the way out — a leaked collection scope would silently
+    starve every later fit/search trace in the process."""
+    from spark_rapids_ml_tpu import profiling
+    import spark_rapids_ml_tpu.serving.engine as engine_mod
+
+    model, X = model_zoo("kmeans")
+    monkeypatch.setenv(profiling.TRACE_ENV, str(tmp_path))
+    depth0 = profiling._collect_depth
+
+    class Boom(RuntimeError):
+        pass
+
+    def bad_warm(self):
+        raise Boom("warm failed")
+
+    monkeypatch.setattr(engine_mod.ModelServer, "_warm_buckets", bad_warm)
+    with pytest.raises(Boom):
+        ModelServer("leaky", model, max_batch=16, max_wait_ms=2)
+    assert profiling._collect_depth == depth0
+
+
+def test_registry_telemetry_snapshot_and_delta(model_zoo):
+    """registry.telemetry() is a mergeable TelemetrySnapshot of the serving
+    plane; telemetry(since=prev) reports only what moved in the window —
+    the scrape/ship surface that works on live Spark executors (snapshots
+    merge driver-side like fit telemetry)."""
+    model, X = model_zoo("kmeans")
+    with ModelRegistry(max_batch=32, max_wait_ms=2) as reg:
+        reg.register("telem_km", model)
+        reg.get("telem_km").predict(X[:2])
+        snap0 = reg.telemetry()
+        assert snap0.counters.get("serving.telem_km.requests", 0) >= 1
+        assert any(
+            k.startswith("serve.telem_km.") for k in snap0.durations
+        ), snap0.durations
+        for i in range(5):
+            reg.get("telem_km").predict(X[i : i + 1])
+        delta = reg.telemetry(since=snap0)
+        assert delta.counters.get("serving.telem_km.requests") == 5
+        lat = delta.durations.get("serve.telem_km.latency")
+        assert lat is not None and lat["count"] == 5
+        # snapshots from different "processes" merge associatively
+        merged = snap0.merge(delta)
+        assert merged.counters["serving.telem_km.requests"] == (
+            snap0.counters["serving.telem_km.requests"] + 5
+        )
+
+
 # -- registry -----------------------------------------------------------------
 
 

@@ -189,6 +189,31 @@ def test_linreg_fit_multiple_single_pass_over_barrier(fake_pyspark):
     )
 
 
+def test_barrier_fit_surfaces_merged_telemetry(fake_pyspark):
+    """The executor-side fit's TelemetrySnapshot must ride the model-
+    attribute wire and surface on the DRIVER-side model — the live-Spark
+    half of the srml-scope acceptance gate (the local half lives in
+    test_profiling.test_local_fit_attaches_telemetry)."""
+    from spark_rapids_ml_tpu.core import TELEMETRY_ATTR
+
+    X, _ = _data()
+    model = KMeans(k=2, maxIter=5, seed=5).fit(_fake_sdf(X))
+    t = model.fit_telemetry()
+    assert t is not None, "barrier fit lost its telemetry snapshot"
+    # the executor phases (runner.*) are what must cross the wire — the
+    # driver thread never ran the fit
+    assert t.phases["runner.fit"]["count"] == 1
+    assert t.phases["runner.fit"]["total_s"] > 0.0
+    assert "runner.build_inputs" in t.phases
+    assert t.meta["ranks"] == [0]
+    # driver-side phase view is rebuilt from the snapshot
+    est = KMeans(k=2, maxIter=5, seed=5)
+    est.fit(_fake_sdf(X))
+    assert est._last_fit_phase_times.get("runner.fit", 0.0) > 0.0
+    # and the wire key never leaks into model attributes
+    assert TELEMETRY_ATTR not in model._get_model_attributes()
+
+
 def test_missing_input_column_fails_on_driver(fake_pyspark):
     """A wrong featuresCol must raise BEFORE any barrier stage launches —
     not as an executor traceback."""

@@ -22,6 +22,10 @@
 #   R5 dtype         float64 dtypes in ops/ solver kernels (TPU demotes f64
 #                    to slow emulation; numpy f64 scalars also silently
 #                    promote weak-typed jnp math).
+#   R6 raw-clock     time.time/time.perf_counter in spark_rapids_ml_tpu
+#                    modules outside profiling.py — all timing goes through
+#                    srml-scope (profiling.now()/span()) so spans, counters,
+#                    and trace exports share one clock.
 #
 # Suppression: `# graftlint: disable=R1 (reason)` on the finding line or the
 # line directly above.  Granted pragmas are audited in NOTES.md.
@@ -56,6 +60,7 @@ RULE_NAMES = {
     "R3": "axis-name",
     "R4": "nondeterminism",
     "R5": "dtype",
+    "R6": "raw-clock",
 }
 
 # Findings sanctioned by construction, not by pragma.  Entries are

@@ -23,7 +23,7 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-RULES = ("R1", "R2", "R3", "R4", "R5")
+RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 
 FindingTuple = Tuple[str, int, str, str]  # (rule, line, message, func-qualname)
 
@@ -36,6 +36,7 @@ _MODULE_CANON = {
     "jax.lax": "jax.lax",
     "jax.random": "jax.random",
     "functools": "functools",
+    "time": "time",
 }
 
 # canonical prefixes whose call results live on device
@@ -644,6 +645,44 @@ def _r5_check(
             )
 
 
+# -- R6: raw wall clocks in engine/serving modules ----------------------------
+# Every timestamp the framework takes must come from ONE clock so spans,
+# counters, duration series, and trace exports are mutually comparable —
+# srml-scope's profiling.now()/span().  A module-local time.perf_counter()
+# is invisible to the telemetry snapshots and the Chrome-trace export, and
+# (worse) time.time() is not even monotonic.  Scoped to the package
+# (benchmark/test harness code may time however it likes); profiling.py is
+# the clock's home and exempt.  time.monotonic/time.sleep stay allowed —
+# deadline polling loops are control flow, not observability.
+
+_R6_CLOCKS = {"time.time", "time.perf_counter", "time.perf_counter_ns"}
+
+
+def _r6_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if norm.endswith("/profiling.py") or norm == "profiling.py":
+        return False
+    return "spark_rapids_ml_tpu/" in norm or norm.startswith(
+        "spark_rapids_ml_tpu"
+    )
+
+
+def _r6_check_call(
+    call: ast.Call, index: ModuleIndex, qualname: str
+) -> Iterator[FindingTuple]:
+    name = index.dotted(call.func)
+    if name in _R6_CLOCKS:
+        yield (
+            "R6",
+            call.lineno,
+            f"{name}() in an engine/serving module: timing outside "
+            "srml-scope is invisible to spans, telemetry snapshots, and "
+            "trace exports (and time.time is not monotonic) — use "
+            "profiling.now() or profiling.span() (docs/observability.md#r6)",
+            qualname,
+        )
+
+
 # -- driver -------------------------------------------------------------------
 
 def lint_tree(
@@ -710,6 +749,8 @@ def lint_tree(
                 findings.extend(
                     _r4_check_call(node, index, qual, id(node) in module_stmts)
                 )
+            if "R6" in selected and _r6_applies(index.path):
+                findings.extend(_r6_check_call(node, index, qual))
         if isinstance(node, ast.For) and "R4" in selected:
             findings.extend(_r4_check_for(node, qual, index))
         if "R5" in selected and _r5_applies(index.path):
