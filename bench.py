@@ -21,6 +21,7 @@
 #
 
 import gc
+import glob
 import json
 import os
 import statistics
@@ -661,6 +662,15 @@ def main() -> None:
     headline = dict(results.get("kmeans") or {"error": "headline arm failed"})
     headline["repeats"] = repeats
     headline["arms"] = {a: r for a, r in results.items() if a != "kmeans"}
+    # prior-round pointer: the newest BENCH_r*.json present when THIS run
+    # started is what this artifact should be diffed against —
+    # benchmark/standings.py renders the Δ% regression column from it, so
+    # the bench trajectory is itself observable (srml-watch satellite)
+    prior = sorted(
+        glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r*.json"))
+    )
+    headline["prev_round"] = os.path.basename(prior[-1]) if prior else None
     print(json.dumps(headline))
 
 

@@ -35,6 +35,11 @@ END = "<!-- END GENERATED STANDINGS -->"
 # 31.4% at 3 repeats, BENCH_r05; bench.py ARM_MIN_REPEATS is the fix lever)
 SPREAD_BUDGET_PCT = 15.0
 
+# an arm more than this much SLOWER than the previous captured round gets a
+# regression flag (srml-watch satellite: the bench trajectory is itself
+# observable — a silent 10% slide per round compounds into a halved system)
+REGRESSION_BUDGET_PCT = 10.0
+
 
 def newest_artifact() -> str:
     paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
@@ -73,9 +78,52 @@ def load_arms(path: str):
     parsed = doc.get("parsed", doc)  # driver artifact wraps the JSON line
     if parsed is None:
         parsed = _recover_from_tail(doc)
-    arms = {"kmeans": {k: v for k, v in parsed.items() if k != "arms"}}
+    arms = {
+        "kmeans": {
+            k: v for k, v in parsed.items() if k not in ("arms", "prev_round")
+        }
+    }
     arms.update(parsed.get("arms", {}))
     return doc, arms
+
+
+def _prev_pointer(path: str, doc: dict) -> str:
+    """Basename of the round this artifact should be diffed against:
+    the `prev_round` pointer bench.py embeds (read from the already-loaded
+    `doc`), falling back — for older or tail-truncated artifacts (the
+    pointer rides the headline prefix the tail capture loses) — to the
+    file immediately before `path` in sort order."""
+    parsed = doc.get("parsed", doc) or {}
+    prev = parsed.get("prev_round")
+    if prev and os.path.exists(os.path.join(REPO, prev)):
+        return prev
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    names = [os.path.basename(p) for p in paths]
+    base = os.path.basename(path)
+    if base in names:
+        i = names.index(base)
+        if i > 0:
+            return names[i - 1]
+    return ""
+
+
+def _delta_cell(name: str, a: dict, prev_arms: dict):
+    """(markdown cell, regressed?) comparing this arm's rows/s against the
+    previous round's — ⚠ past REGRESSION_BUDGET_PCT slower.  Only metrics
+    with IDENTICAL labels compare: the label encodes the shape, and a
+    cross-shape delta is exactly the mistake the vs_baseline floor note
+    warns against."""
+    prev = prev_arms.get(name)
+    if not prev or "error" in prev or not prev.get("value"):
+        return "—", False
+    if a.get("metric") != prev.get("metric"):
+        return "— (shape changed)", False
+    pct = 100.0 * (a["value"] - prev["value"]) / prev["value"]
+    cell = f"{pct:+.1f}%"
+    regressed = pct < -REGRESSION_BUDGET_PCT
+    if regressed:
+        cell += " ⚠"
+    return cell, regressed
 
 
 def _shape_note(metric: str) -> str:
@@ -87,6 +135,13 @@ def _shape_note(metric: str) -> str:
 
 def render(path: str) -> str:
     doc, arms = load_arms(path)
+    prev_name = _prev_pointer(path, doc)
+    prev_arms: dict = {}
+    if prev_name:
+        try:
+            prev_arms = load_arms(os.path.join(REPO, prev_name))[1]
+        except (OSError, ValueError, SystemExit):
+            prev_arms = {}
     rows = []
     for name, a in arms.items():
         if "error" in a:
@@ -98,6 +153,7 @@ def render(path: str) -> str:
     # builder cycle is one captured run
     n_driver = doc.get("n", 1)
     n_timed = doc.get("repeats") or arms.get("kmeans", {}).get("repeats", 3)
+    vs_prev = f"Δ vs `{prev_name}`" if prev_name else "Δ vs prev"
     lines = [
         f"Generated by `python -m benchmark.standings` from "
         f"`{os.path.basename(path)}` "
@@ -105,16 +161,20 @@ def render(path: str) -> str:
         f"{n_timed} timed calls each"
         f"). Do not edit the table by hand.",
         "",
-        "| arm | shape | rows/s (median) | vs reference GPU cluster | spread | cold first call |",
-        "|---|---|---|---|---|---|",
+        f"| arm | shape | rows/s (median) | vs reference GPU cluster | {vs_prev} | spread | cold first call |",
+        "|---|---|---|---|---|---|---|",
     ]
     flagged = []
+    regressed = []
     for name, vsb, a in rows:
         if vsb is None:
-            lines.append(f"| {name} | — | ERROR | {a['error']} | — | — |")
+            lines.append(f"| {name} | — | ERROR | {a['error']} | — | — | — |")
             continue
         floor = " (floor)" if name in FLOOR_ARMS else ""
         val = f"{a['value']:,.0f}"
+        delta, is_reg = _delta_cell(name, a, prev_arms)
+        if is_reg:
+            regressed.append(name)
         spread_pct = float(a.get("spread_pct", 0))
         spread = f"{spread_pct:.1f}%"
         if spread_pct > SPREAD_BUDGET_PCT:
@@ -123,8 +183,16 @@ def render(path: str) -> str:
         cold = f"{a['cold_sec']:.1f} s" if "cold_sec" in a else "—"
         lines.append(
             f"| {name} | {_shape_note(a['metric'])} | {val} "
-            f"| **{vsb:.2f}×**{floor} | {spread} | {cold} |"
+            f"| **{vsb:.2f}×**{floor} | {delta} | {spread} | {cold} |"
         )
+    if regressed:
+        lines += [
+            "",
+            f"⚠ regression: {', '.join(regressed)} more than "
+            f"{REGRESSION_BUDGET_PCT:.0f}% slower than {prev_name or 'the prior round'} "
+            "— diagnose (spread attribution below / phase_times_per_repeat "
+            "in the artifact) before accepting the round.",
+        ]
     if flagged:
         lines += [
             "",
@@ -155,6 +223,13 @@ def render(path: str) -> str:
     if notes:
         lines += ["", "Measurement assumptions carried by the artifact:", *notes]
     lines += [
+        "",
+        "`Δ vs prev` compares each arm's rows/s against the previous "
+        "captured round (the artifact's `prev_round` pointer, emitted by "
+        "bench.py; older artifacts fall back to file order) — positive is "
+        f"faster, and more than {REGRESSION_BUDGET_PCT:.0f}% slower earns "
+        "the regression flag, so the bench trajectory is itself "
+        "observable.",
         "",
         "`vs_baseline` normalizes fit rows/sec against the reference's "
         "published 2×A10G GPU-cluster times on 1M rows "

@@ -6,10 +6,10 @@ set -ex
 cd "$(dirname "$0")/.."
 
 # 1. lint / static checks: byte-compile everything (mypy/black optional in
-#    this image), then graftlint — the JAX/TPU invariant checker (R1-R6:
+#    this image), then graftlint — the JAX/TPU invariant checker (R1-R7:
 #    hidden host syncs, recompile risk, unbound collective axis names,
 #    nondeterministic RNG/set-order, float64 in solver kernels, raw clocks
-#    outside srml-scope; see docs/graftlint.md).  Fails on ANY finding and
+#    outside srml-scope, unnamed threads; see docs/graftlint.md).  Fails on ANY finding and
 #    prints the per-rule count; use --baseline to land a new rule warn-only
 #    first.
 python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
@@ -196,6 +196,58 @@ print(f"observability smoke OK: {len(traces)} trace file(s), "
       f"{len(exported['counters'])} counters exported")
 EOF
 rm -rf "$TRACE_SMOKE"
+
+# 3h. focused gates for the srml-watch health plane (also inside the full
+#     suite; re-asserted by name so marker drift can never silently drop
+#     them), then a serving health smoke:
+#     - induced-hang: a fit task blocking one mocked rank produces a
+#       watchdog report naming the stalled rank AND its innermost open span
+#     - induced-exception: a failing fit dumps a Perfetto-loadable flight
+#       recording with the failing span as the final event
+#     - overhead: always-on flight recording stays under 2% of a warm
+#       kmeans fit
+#     - ModelRegistry.health() reports READY with SLO attainment >= 0 and
+#       the health/memory gauge families render through export_metrics()/
+#       render_prometheus()
+#     plus a graftlint-clean re-check (incl. R7 unnamed-thread) of the
+#     watch/serving/runner modules by name.
+python -m pytest tests/test_watch.py -q
+python -m pytest tests/test_watch.py -q -k "induced_hang or induced_exception or overhead"
+python -m tools.graftlint spark_rapids_ml_tpu/watch.py \
+    spark_rapids_ml_tpu/profiling.py spark_rapids_ml_tpu/serving \
+    spark_rapids_ml_tpu/parallel/runner.py spark_rapids_ml_tpu/parallel/context.py \
+    spark_rapids_ml_tpu/ops/precompile.py
+WATCH_SMOKE=$(mktemp -d)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    SRML_TRACE_DIR="$WATCH_SMOKE/traces" SRML_SERVE_SLO_MS=500 python - <<'EOF'
+import numpy as np
+from spark_rapids_ml_tpu import KMeans, profiling, watch
+from spark_rapids_ml_tpu.dataframe import DataFrame
+from spark_rapids_ml_tpu.serving import ModelRegistry
+
+X = np.random.default_rng(0).standard_normal((512, 16)).astype(np.float32)
+model = KMeans(k=4, maxIter=5, seed=1).fit(DataFrame.from_numpy(X))
+telem = model.fit_telemetry()
+assert telem is not None and "mem.host" in telem.memory, telem.memory
+with ModelRegistry(max_batch=32, max_wait_ms=2) as reg:
+    reg.register("km", model)
+    for i in range(16):
+        reg.get("km").predict(X[i])
+    h = reg.health()
+    assert h["state"] == "READY", h
+    km = h["models"]["km"]
+    assert km["attainment"] >= 0 and 0 <= km["burn"] <= 1, km
+    m = profiling.export_metrics()
+    g = m["gauges"]
+    assert g.get("health.km.state_code") == 1.0, g
+    assert any(k.startswith("mem.host.") for k in g), g
+    txt = profiling.render_prometheus(m)
+    assert "# TYPE srml_health gauge" in txt, txt[-500:]
+    assert "# TYPE srml_memory_bytes gauge" in txt
+assert watch.ring_stats()["events"] > 0
+print("watch smoke OK:", km["state"], f"attainment={km['attainment']}")
+EOF
+rm -rf "$WATCH_SMOKE"
 
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)

@@ -549,7 +549,15 @@ class _TpuCaller(_TpuParams):
         # (device_put) and the fit (trace-time dtypes); it recompiles the
         # kernels for f64, which TPUs execute via (slower) emulation.
         input_col, input_cols = self._get_input_columns()
-        with profiling.trace_session(f"fit-{type(self).__name__}"), _maybe_x64(
+        from . import watch
+
+        # watch.flight_scope: an unhandled exception anywhere in the fit
+        # dumps the always-on flight ring (with the innermost failing span)
+        # to SRML_TRACE_DIR before propagating — the crash-time counterpart
+        # of the trace session, which only exports on success
+        with watch.flight_scope(
+            f"fit-{type(self).__name__}"
+        ), profiling.trace_session(f"fit-{type(self).__name__}"), _maybe_x64(
             self._use_dtype(df, input_col, input_cols)
         ):
             with profiling.phase("srml.ingest"):

@@ -254,6 +254,60 @@ class Precompiler:
             except Exception:  # noqa: BLE001 - surfaced at dispatch instead
                 pass
 
+    def cache_stats(self) -> dict:
+        """Executable-cache introspection for the srml-watch health plane:
+        entry/in-flight counts, per-kernel entry counts and (bounded) the
+        set of leading-argument bucket geometries, plus a best-effort
+        estimated code footprint from XLA's memory analysis.  Read-only and
+        cheap enough for gauge scrapes; estimation failures degrade to
+        None, never raise."""
+        with self._lock:
+            jobs = list(self._jobs.items())
+        per_kernel: dict = {}
+        in_flight = 0
+        est_bytes: Optional[float] = 0.0
+        for key, job in jobs:
+            name = (
+                str(key[0])
+                if isinstance(key, tuple) and key
+                else str(key)[:64]
+            )
+            entry = per_kernel.setdefault(
+                name, {"entries": 0, "bucket_geometries": []}
+            )
+            entry["entries"] += 1
+            # bucket geometry: the first argument's shape in the cache key
+            # (kernel_cache_key layout) — the pow2 row bucket callers pad to
+            if (
+                isinstance(key, tuple)
+                and len(key) > 1
+                and isinstance(key[1], tuple)
+                and key[1]
+                and isinstance(key[1][0], tuple)
+            ):
+                geo = list(key[1][0][0]) if key[1][0] else []
+                if geo not in entry["bucket_geometries"] and len(
+                    entry["bucket_geometries"]
+                ) < 16:
+                    entry["bucket_geometries"].append(geo)
+            if not job.done.is_set():
+                in_flight += 1
+                continue
+            if est_bytes is not None and job.result is not None:
+                try:
+                    ma = job.result.memory_analysis()
+                    est_bytes += float(
+                        getattr(ma, "generated_code_size_in_bytes", 0)
+                    ) + float(getattr(ma, "temp_size_in_bytes", 0))
+                except Exception:  # noqa: BLE001 - backend-dependent surface
+                    est_bytes = None
+        return {
+            "entries": len(jobs),
+            "in_flight": in_flight,
+            "est_code_bytes": est_bytes,
+            "kernels": dict(sorted(per_kernel.items())),
+        }
+
     def cached_call(self, key: Hashable, fn, *args, **static_kwargs):
         """Executable-cache dispatch: run `fn` through the AOT executable for
         `key`, COMPILING IT ON MISS (lowered from the concrete args, so their
@@ -343,6 +397,17 @@ def global_precompiler() -> Precompiler:
     if _global is None:
         _global = Precompiler()
     return _global
+
+
+def executable_cache_stats() -> dict:
+    """cache_stats() of the process-wide precompiler WITHOUT constructing
+    it (a gauge scrape must not spin up 16 worker threads in a process that
+    never compiled anything)."""
+    if _global is None:
+        return {
+            "entries": 0, "in_flight": 0, "est_code_bytes": 0.0, "kernels": {},
+        }
+    return _global.cache_stats()
 
 
 def kernel_cache_key(name: str, args, mesh, statics: dict):

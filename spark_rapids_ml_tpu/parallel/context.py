@@ -54,6 +54,9 @@ class LocalControlPlane:
     """Single-controller control plane: one process drives the whole mesh, so
     gather/barrier are identities."""
 
+    def __init__(self) -> None:
+        self._health: dict = {}
+
     def allGather(self, message: str) -> List[str]:
         return [message]
 
@@ -62,6 +65,21 @@ class LocalControlPlane:
 
     def barrier(self) -> None:
         return None
+
+    # srml-watch health surface (non-collective): trivial in-process store
+    # so thread-mocked rank harnesses can exercise the heartbeat/watchdog
+    # contract without a shared filesystem
+    def publish_health(self, payload: str) -> None:
+        import json as _json
+
+        try:
+            rank = int(_json.loads(payload).get("rank", 0))
+        except (ValueError, TypeError):
+            rank = 0
+        self._health[rank] = payload
+
+    def read_health(self) -> dict:
+        return dict(self._health)
 
 
 def _local_ip() -> str:

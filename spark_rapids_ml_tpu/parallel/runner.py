@@ -106,6 +106,32 @@ class FileControlPlane:
     def barrier(self) -> None:
         self.allGather("")
 
+    # -- srml-watch health surface (NON-collective, unlike the gathers) ------
+    def publish_health(self, payload: str) -> None:
+        """Atomically overwrite this rank's heartbeat file.  Unlike the
+        numbered gather rounds this is fire-and-forget: no rank ever waits
+        on it, so a wedged rank cannot stall the health plane — which is
+        the whole point (watch.HeartbeatPublisher calls this on its own
+        thread while the fit thread may be stuck in a collective)."""
+        path = os.path.join(self._root, f"health_rank{self._rank:05d}.json")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+
+    def read_health(self) -> Dict[int, str]:
+        """Latest heartbeat payload per rank (missing ranks absent) — the
+        watchdog's read side; never blocks."""
+        out: Dict[int, str] = {}
+        for i in range(self._nranks):
+            p = os.path.join(self._root, f"health_rank{i:05d}.json")
+            try:
+                with open(p) as f:
+                    out[i] = f.read()
+            except OSError:
+                continue
+        return out
+
 
 def global_mesh() -> Mesh:
     """1-D data mesh over EVERY device in the (possibly multi-process)
@@ -322,19 +348,27 @@ class DistributedFitSession:
                 "or SRML_SPARK_COLLECT=1 (driver-local fit)."
             )
         df = DataFrame(list(partitions))
-        from .. import profiling
+        from .. import profiling, watch
         from ..sanitize import sanitize_scope
 
         profiling.reset_phase_times()
         counters0 = profiling.counters()
-        with profiling.trace_session(
-            f"fit-{type(estimator).__name__}-rank{self.rank}"
-        ):
-            with profiling.phase("runner.build_inputs"):
-                inputs = self.build_fit_inputs(estimator, df)
-            fit_func = estimator._get_tpu_fit_func(df, extra_params)
-            with sanitize_scope(), profiling.phase("runner.fit"):
-                result = fit_func(inputs, dict(estimator._tpu_params))
+        tag = f"fit-{type(estimator).__name__}-rank{self.rank}"
+        # srml-watch: every rank heartbeats through the control plane's
+        # non-collective publish surface (rank 0 also runs the stall
+        # watchdog when SRML_WATCH_STALL_S > 0), and an unhandled exception
+        # inside the fit task dumps the flight ring before propagating —
+        # the two failure modes (wedge, crash) that previously died silent.
+        health = watch.start_fit_health(self.control_plane, self.rank, self.nranks)
+        try:
+            with watch.flight_scope(tag), profiling.trace_session(tag):
+                with profiling.phase("runner.build_inputs"):
+                    inputs = self.build_fit_inputs(estimator, df)
+                fit_func = estimator._get_tpu_fit_func(df, extra_params)
+                with sanitize_scope(), profiling.phase("runner.fit"):
+                    result = fit_func(inputs, dict(estimator._tpu_params))
+        finally:
+            health.stop()
         # Telemetry snapshot at fit-task exit, merged ACROSS RANKS through
         # the control plane before rank 0's results leave for the driver —
         # this is how the driver-side model sees where every executor's fit

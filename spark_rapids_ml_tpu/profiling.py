@@ -55,6 +55,7 @@ import itertools
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
@@ -63,8 +64,16 @@ _log = logging.getLogger("spark_rapids_ml_tpu.profiling")
 
 PROFILE_ENV = "SRML_PROFILE"
 TRACE_ENV = "SRML_TRACE_DIR"
+METRIC_TTL_ENV = "SRML_METRIC_TTL_S"
 
 _tls = threading.local()
+
+# srml-watch flight-recorder hook (watch.install sets this to the process
+# FlightRecorder).  Unlike trace sessions the recorder is ALWAYS on: span()
+# and incr_counter() feed it bounded O(1) ring events so the last moments
+# before a hang/crash are reconstructable without any session open.  None
+# (SRML_WATCH=0) restores the exact pre-watch code path.
+_flight: Optional[Any] = None
 
 
 def now() -> float:
@@ -135,7 +144,11 @@ _counters: Dict[str, int] = {}
 def incr_counter(name: str, amount: int = 1) -> None:
     """Add `amount` to the process-wide counter `name` (created at 0)."""
     with _counters_lock:
-        _counters[name] = _counters.get(name, 0) + amount
+        total = _counters.get(name, 0) + amount
+        _counters[name] = total
+    fr = _flight
+    if fr is not None:
+        fr.on_counter(name, amount, total)
 
 
 def counter(name: str) -> int:
@@ -179,6 +192,9 @@ def reset_counters(prefix: str = "") -> None:
 # the percentiles a sliding window over the most recent traffic.
 
 _DURATION_CAP = 65536
+# TTL sweeps run at most once per _TTL_SWEEP_EVERY records so the eviction
+# scan cost amortizes to ~zero on hot serving paths
+_TTL_SWEEP_EVERY = 256
 
 _durations_lock = threading.Lock()
 _durations: Dict[str, list] = {}
@@ -187,13 +203,49 @@ _duration_next: Dict[str, int] = {}  # ring-buffer write cursor past the cap
 # are MONOTONIC (evicted samples stay counted), so duration_digests deltas
 # between two snapshots are exact no matter how busy the series is
 _duration_stats: Dict[str, list] = {}
+# last-touch clock per series (only maintained while SRML_METRIC_TTL_S > 0)
+_duration_touched: Dict[str, float] = {}
+_ttl_record_count = 0
+
+
+def metric_ttl_s() -> float:
+    """SRML_METRIC_TTL_S: seconds a duration series may go untouched before
+    eviction (0, the default, disables eviction).  The per-series sample
+    ring is bounded, but the NUMBER of series is not — a long-lived serving
+    process cycling through model names would otherwise leak series."""
+    try:
+        return float(os.environ.get(METRIC_TTL_ENV, "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def _evict_stale_series_locked(ttl: float, now_t: float, keep: str) -> None:
+    """Drop every series untouched for `ttl` seconds (except `keep`, the
+    series being written).  A series recorded before TTL was enabled has no
+    touch stamp — it is stamped now and given a full TTL."""
+    for k in list(_durations):
+        if k == keep:
+            continue
+        touched = _duration_touched.get(k)
+        if touched is None:
+            _duration_touched[k] = now_t
+        elif now_t - touched > ttl:
+            del _durations[k]
+            _duration_next.pop(k, None)
+            _duration_stats.pop(k, None)
+            _duration_touched.pop(k, None)
 
 
 def record_duration(name: str, seconds: float) -> None:
     """Append one duration sample (seconds) to the process-wide series
     `name`.  Cheap enough for per-request recording; capped per name (ring
-    buffer) so recording is observability, never a leak."""
+    buffer) so recording is observability, never a leak.  With
+    SRML_METRIC_TTL_S set, series untouched for the TTL are evicted here
+    (amortized: one sweep per _TTL_SWEEP_EVERY records)."""
+    global _ttl_record_count
     s = float(seconds)
+    ttl = metric_ttl_s()  # env read outside the lock: the hot serving path
+    # records several series per batch and must not serialize on it
     with _durations_lock:
         series = _durations.get(name)
         if series is None:
@@ -215,6 +267,41 @@ def record_duration(name: str, seconds: float) -> None:
                 stats[2] = s
             if s > stats[3]:
                 stats[3] = s
+        if ttl > 0:
+            now_t = time.perf_counter()
+            _duration_touched[name] = now_t
+            _ttl_record_count += 1
+            if _ttl_record_count % _TTL_SWEEP_EVERY == 0:
+                _evict_stale_series_locked(ttl, now_t, keep=name)
+
+
+def series_stats() -> Dict[str, Any]:
+    """Self-description of the duration registry — series count, total ring
+    samples, estimated resident bytes, and per-series lifetime counts +
+    last-touch age — so a long-lived serving process can watch its own
+    metric footprint (the leak this surface exists to catch)."""
+    now_t = time.perf_counter()
+    with _durations_lock:
+        per = {}
+        total_samples = 0
+        for k, v in _durations.items():
+            total_samples += len(v)
+            stats = _duration_stats.get(k) or [len(v), 0.0, 0.0, 0.0]
+            touched = _duration_touched.get(k)
+            per[k] = {
+                "ring_samples": len(v),
+                "lifetime_count": int(stats[0]),
+                "age_s": (
+                    round(now_t - touched, 3) if touched is not None else None
+                ),
+            }
+        return {
+            "series_count": len(per),
+            "ring_samples": total_samples,
+            "est_bytes": total_samples * 8,
+            "ttl_s": metric_ttl_s(),
+            "series": per,
+        }
 
 
 def durations(prefix: str = "") -> Dict[str, list]:
@@ -229,6 +316,7 @@ def reset_durations(prefix: str = "") -> None:
             del _durations[k]
             _duration_next.pop(k, None)
             _duration_stats.pop(k, None)
+            _duration_touched.pop(k, None)
 
 
 def percentiles(prefix: str = "") -> Dict[str, float]:
@@ -390,6 +478,13 @@ def span(name: str, **attrs: Any) -> Iterator[_SpanHandle]:
         handle = _SpanHandle(dict(attrs))
     else:
         handle = _NULL_SPAN
+    # flight recorder (srml-watch): ALWAYS on when installed — one bounded
+    # ring event per span close plus the open-span stack a hang dump and
+    # the stall watchdog read.  Overhead is gated <2% of a warm fit by
+    # tests/test_watch.py.
+    fr = _flight
+    if fr is not None:
+        fr.on_span_open(name)
     t0 = time.perf_counter()
     try:
         with annotation:
@@ -410,6 +505,8 @@ def span(name: str, **attrs: Any) -> Iterator[_SpanHandle]:
                         (name, t0, t1, th.ident, th.name, sid, parent,
                          handle.attrs)
                     )
+        if fr is not None:
+            fr.on_span_close(name, t0, t1, sys.exc_info()[0] is not None)
         _log.debug("phase %s: %.3fs", name, dt)
 
 
@@ -565,18 +662,20 @@ class TelemetrySnapshot:
     the driver in any order: merge(a, b) == merge(b, a) and
     merge(merge(a, b), c) == merge(a, merge(b, c)) on every rollup field."""
 
-    __slots__ = ("phases", "counters", "durations", "meta")
+    __slots__ = ("phases", "counters", "durations", "memory", "meta")
 
     def __init__(
         self,
         phases: Optional[Dict[str, Dict[str, float]]] = None,
         counters: Optional[Dict[str, int]] = None,
         durations: Optional[Dict[str, Dict[str, float]]] = None,
+        memory: Optional[Dict[str, Dict[str, float]]] = None,
         meta: Optional[Dict[str, Any]] = None,
     ):
         self.phases = dict(phases or {})
         self.counters = dict(counters or {})
         self.durations = dict(durations or {})
+        self.memory = dict(memory or {})
         self.meta = dict(meta or {})
         self.meta.setdefault("ranks", [])
 
@@ -590,8 +689,10 @@ class TelemetrySnapshot:
     ) -> "TelemetrySnapshot":
         """Snapshot THIS thread's phase stats plus the process counters
         (delta vs `counters_before` when given, so a fit reports what IT
-        moved, not process history) and optionally duration digests under
-        `duration_prefix`."""
+        moved, not process history), optionally duration digests under
+        `duration_prefix`, and — when the srml-watch recorder is installed —
+        the memory section (per-phase peak-delta attribution + HBM/host
+        watermarks; empty on backends without device memory stats)."""
         ctr = (
             counter_deltas(counters_before, counter_prefix)
             if counters_before is not None
@@ -602,8 +703,18 @@ class TelemetrySnapshot:
             if duration_prefix is not None
             else {}
         )
+        mem: Dict[str, Dict[str, float]] = {}
+        fr = _flight
+        if fr is not None:
+            try:
+                mem = fr.telemetry_memory()
+            except Exception:  # noqa: BLE001 - observability never fails fits
+                mem = {}
         meta: Dict[str, Any] = {"ranks": [int(rank)] if rank is not None else []}
-        return cls(phases=phase_stats(), counters=ctr, durations=dur, meta=meta)
+        return cls(
+            phases=phase_stats(), counters=ctr, durations=dur, memory=mem,
+            meta=meta,
+        )
 
     def merge(self, other: "TelemetrySnapshot") -> "TelemetrySnapshot":
         phases: Dict[str, Dict[str, float]] = {}
@@ -626,13 +737,29 @@ class TelemetrySnapshot:
                     agg["sum_s"] += v["sum_s"]
                     agg["min_s"] = min(agg["min_s"], v["min_s"])
                     agg["max_s"] = max(agg["max_s"], v["max_s"])
+        # memory watermarks: counts sum, peaks MAX (a watermark across ranks
+        # is the worst rank's), deltas sum — still associative+commutative
+        mem: Dict[str, Dict[str, float]] = {}
+        for src in (self.memory, other.memory):
+            for k, v in src.items():
+                agg = mem.get(k)
+                if agg is None:
+                    mem[k] = dict(v)
+                else:
+                    agg["count"] += v.get("count", 0)
+                    agg["peak_bytes"] = max(
+                        agg.get("peak_bytes", 0.0), v.get("peak_bytes", 0.0)
+                    )
+                    agg["sum_delta_bytes"] = agg.get(
+                        "sum_delta_bytes", 0.0
+                    ) + v.get("sum_delta_bytes", 0.0)
         meta = {
             "ranks": sorted(
                 set(self.meta.get("ranks", [])) | set(other.meta.get("ranks", []))
             )
         }
         return TelemetrySnapshot(
-            phases=phases, counters=ctr, durations=dur, meta=meta
+            phases=phases, counters=ctr, durations=dur, memory=mem, meta=meta
         )
 
     def phase_seconds(self, prefix: str = "") -> Dict[str, float]:
@@ -650,6 +777,7 @@ class TelemetrySnapshot:
             "phases": self.phases,
             "counters": self.counters,
             "durations": self.durations,
+            "memory": self.memory,
             "meta": self.meta,
         }
 
@@ -659,6 +787,7 @@ class TelemetrySnapshot:
             phases=d.get("phases"),
             counters=d.get("counters"),
             durations=d.get("durations"),
+            memory=d.get("memory"),
             meta=d.get("meta"),
         )
 
@@ -677,6 +806,46 @@ class TelemetrySnapshot:
 
 
 # -- export surface -----------------------------------------------------------
+
+# Gauge providers: named callables returning {gauge name: float} sampled at
+# export time (unlike counters, gauges describe CURRENT state — memory
+# watermarks, serving health, cache sizes).  srml-watch registers the
+# memory/cache provider; each ModelRegistry registers its health provider.
+_gauges_lock = threading.Lock()
+_gauge_providers: Dict[str, Callable[[], Dict[str, float]]] = {}
+
+
+def register_gauges(key: str, fn: Callable[[], Dict[str, float]]) -> None:
+    """Register (or replace) gauge provider `key`; its dict is merged into
+    export_metrics()['gauges'] at every export."""
+    with _gauges_lock:
+        _gauge_providers[key] = fn
+
+
+def unregister_gauges(key: str) -> None:
+    with _gauges_lock:
+        _gauge_providers.pop(key, None)
+
+
+def collect_gauges(prefix: str = "") -> Dict[str, float]:
+    """Sample every registered gauge provider (best-effort: a provider that
+    raises is skipped — export must never fail on a sick subsystem, that is
+    exactly when it is needed)."""
+    with _gauges_lock:
+        providers = list(_gauge_providers.values())
+    out: Dict[str, float] = {}
+    for fn in providers:
+        try:
+            sampled = fn()
+        except Exception:  # noqa: BLE001 - export over failure
+            continue
+        for k, v in sampled.items():
+            if k.startswith(prefix):
+                try:
+                    out[k] = float(v)
+                except (TypeError, ValueError):
+                    continue
+    return dict(sorted(out.items()))
 
 
 def spread_attribution(
@@ -706,10 +875,12 @@ def spread_attribution(
 
 def export_metrics(prefix: str = "") -> Dict[str, Any]:
     """One stable JSON document of the process's observability state:
-    counters, per-series duration percentile summaries, and this thread's
-    phase stats (all optionally prefix-filtered).  Embedded into benchmark
-    artifacts and round-trippable through json.dumps/loads (asserted by the
-    CI observability gate)."""
+    counters, per-series duration percentile summaries, this thread's
+    phase stats, and sampled gauges (memory watermarks, serving health,
+    executable-cache size — whatever providers are registered), all
+    optionally prefix-filtered.  Embedded into benchmark artifacts and
+    round-trippable through json.dumps/loads (asserted by the CI
+    observability gate)."""
     dur: Dict[str, Dict[str, float]] = {}
     with _durations_lock:
         series = {
@@ -722,6 +893,7 @@ def export_metrics(prefix: str = "") -> Dict[str, Any]:
         "counters": counters(prefix),
         "durations": dur,
         "phases": phase_stats(prefix),
+        "gauges": collect_gauges(prefix),
     }
 
 
@@ -759,6 +931,24 @@ def render_prometheus(metrics: Optional[Dict[str, Any]] = None) -> str:
             f"{d['mean'] * d['count']}"
         )
         lines.append(f'srml_duration_seconds_count{{name="{n}"}} {d["count"]}')
+    # gauges (srml-watch health plane) split into the three families
+    # dashboards alert on: memory watermarks, serving health, and the rest
+    gauges = m.get("gauges", {})
+    if gauges:
+        fams = {"srml_memory_bytes": [], "srml_health": [], "srml_gauge": []}
+        for k, v in sorted(gauges.items()):
+            if k.startswith("mem."):
+                fams["srml_memory_bytes"].append((k, v))
+            elif k.startswith("health."):
+                fams["srml_health"].append((k, v))
+            else:
+                fams["srml_gauge"].append((k, v))
+        for fam, entries in fams.items():
+            if not entries:
+                continue
+            lines.append(f"# TYPE {fam} gauge")
+            for k, v in entries:
+                lines.append(f'{fam}{{name="{_prom_escape(k)}"}} {v}')
     return "\n".join(lines) + "\n"
 
 
@@ -802,3 +992,23 @@ def device_step_annotation(step: int) -> contextlib.AbstractContextManager:
         return jax.profiler.StepTraceAnnotation("step", step_num=step)
     except Exception:  # pragma: no cover
         return contextlib.nullcontext()
+
+
+# -- srml-watch bootstrap ------------------------------------------------------
+# The flight recorder is ALWAYS on (SRML_WATCH=0 opts out): installed here,
+# at the bottom of the module, so watch's own `from . import profiling` sees
+# a fully-initialized namespace.  watch.install() sets _flight and registers
+# the memory/cache gauge provider.
+
+def _bootstrap_watch() -> None:
+    if os.environ.get("SRML_WATCH", "1") == "0":
+        return
+    try:
+        from . import watch
+
+        watch.install()
+    except Exception as exc:  # pragma: no cover - never fail the import
+        _log.warning("srml-watch flight recorder unavailable: %s", exc)
+
+
+_bootstrap_watch()

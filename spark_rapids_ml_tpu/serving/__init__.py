@@ -18,17 +18,33 @@
 #   registry.py  named servers over in-memory or core.load'ed models
 #
 from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
-from .engine import ModelServer
+from .engine import (
+    DEGRADED,
+    DRAINING,
+    READY,
+    STATE_CODES,
+    UNHEALTHY,
+    WARMING,
+    ModelServer,
+    ServerUnhealthy,
+)
 from .entry import ServingEntry, bucket_rows, entry_for, kernel_entry, serve_buckets
 from .registry import ModelRegistry, default_registry
 
 __all__ = [
+    "DEGRADED",
+    "DRAINING",
     "MicroBatcher",
     "ModelRegistry",
     "ModelServer",
+    "READY",
     "RequestTimeout",
+    "STATE_CODES",
     "ServerOverloaded",
+    "ServerUnhealthy",
     "ServingEntry",
+    "UNHEALTHY",
+    "WARMING",
     "bucket_rows",
     "default_registry",
     "entry_for",

@@ -29,12 +29,13 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .. import profiling
+from .. import profiling, watch
 from .batcher import (  # noqa: F401
     MicroBatcher,
     RequestTimeout,
@@ -44,6 +45,50 @@ from .batcher import (  # noqa: F401
 from .entry import ServingEntry, bucket_rows, entry_for, serve_buckets
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+# -- lifecycle states (srml-watch health plane) -------------------------------
+# WARMING   constructing: buckets compiling, worker not yet started
+# READY     serving; SLO burn within budget
+# DEGRADED  serving, but the SLO burn fraction over the latency window
+#           exceeds SRML_SERVE_SLO_BURN (alert, don't page)
+# DRAINING  drain()/shutdown() started; new submits rejected
+# UNHEALTHY the dispatch worker is wedged (one batch in flight longer than
+#           SRML_WATCH_STALL_S): submits fail fast with ServerUnhealthy
+#           instead of backing the queue up behind a dead worker
+WARMING = "WARMING"
+READY = "READY"
+DEGRADED = "DEGRADED"
+DRAINING = "DRAINING"
+UNHEALTHY = "UNHEALTHY"
+
+# numeric codes for the gauge surface (render_prometheus srml_health family)
+STATE_CODES = {WARMING: 0, READY: 1, DEGRADED: 2, DRAINING: 3, UNHEALTHY: 4}
+
+SLO_MS_ENV = "SRML_SERVE_SLO_MS"
+SLO_BURN_ENV = "SRML_SERVE_SLO_BURN"
+_DEFAULT_SLO_BURN = 0.1
+
+
+class ServerUnhealthy(RuntimeError):
+    """Raised by submit() when the server's dispatch worker is wedged
+    (UNHEALTHY state): callers should fail over to another replica rather
+    than queue behind a worker that may never come back."""
+
+
+def _slo_ms() -> float:
+    """SRML_SERVE_SLO_MS: target request latency.  0 (default) disables SLO
+    scoring — attainment reports 1.0 vacuously."""
+    try:
+        return float(os.environ.get(SLO_MS_ENV, "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def _slo_burn_budget() -> float:
+    try:
+        return float(os.environ.get(SLO_BURN_ENV, "") or _DEFAULT_SLO_BURN)
+    except ValueError:
+        return _DEFAULT_SLO_BURN
 
 
 def _compile_watermark() -> int:
@@ -122,6 +167,18 @@ class ModelServer:
         self._wide = np.dtype(self._entry.dtype).itemsize == 8
         self._steady_compiles = 0
         self._warmed = False
+        # health plane: lifecycle state + wedge detection.  _busy_since is
+        # set by the worker around each device dispatch; a dispatch older
+        # than SRML_WATCH_STALL_S flips the server UNHEALTHY (lazily, from
+        # submit()/health() — no extra thread, no extra jax contention).
+        # State/busy transitions happen under _health_lock: a client
+        # flipping UNHEALTHY and the worker clearing busy must not
+        # interleave, or a slow-but-successful dispatch near the threshold
+        # could pin UNHEALTHY with no recovery path left.
+        self._state = WARMING
+        self._busy_since: Optional[float] = None
+        self._drain_begun = False
+        self._health_lock = threading.Lock()
         # one srml-scope trace session spans the server's lifetime (warmup
         # through shutdown) when SRML_TRACE_DIR is set: every queue/dispatch
         # span — recorded on the worker thread — lands in one Perfetto file.
@@ -141,6 +198,7 @@ class ModelServer:
                 target=self._run, name=f"srml-serve-{self.name}", daemon=True
             )
             self._worker.start()
+            self._state = READY
         except BaseException:
             self._trace_stack.close()
             raise
@@ -197,8 +255,46 @@ class ModelServer:
     def submit(self, features: np.ndarray, timeout_ms: Optional[float] = None):
         """Enqueue one request ((D,) row or (n, D) block, n <= max_batch);
         returns a Future resolving to {output column: np array of n rows}.
-        Raises ServerOverloaded when the queue bound is hit."""
+        Raises ServerOverloaded when the queue bound is hit and
+        ServerUnhealthy when the dispatch worker is wedged (the queue must
+        not back up behind a worker that may never return)."""
+        age = self._check_wedged()
+        if age is not None:
+            raise ServerUnhealthy(
+                f"{self.ns}: dispatch worker wedged for {age:.1f}s "
+                f"(> SRML_WATCH_STALL_S={watch.stall_threshold_s():g}); "
+                "fail over to another replica"
+            )
         return self._batcher.submit(features, timeout_ms=timeout_ms)
+
+    def _check_wedged(self) -> Optional[float]:
+        """Seconds the in-flight dispatch has been wedged when the server
+        is UNHEALTHY, else None.  The flip decision (and the age the error
+        message quotes) is taken under the health lock; reporting side
+        effects run outside it.  SRML_WATCH_STALL_S == 0 disables
+        detection; the worker restores the lifecycle state if the dispatch
+        eventually returns."""
+        stall_s = watch.stall_threshold_s()
+        flipped = False
+        with self._health_lock:
+            busy = self._busy_since
+            now = profiling.now()
+            if self._state == UNHEALTHY:
+                return now - busy if busy is not None else 0.0
+            if stall_s <= 0 or busy is None or now - busy <= stall_s:
+                return None
+            self._state = UNHEALTHY
+            flipped = True
+            age = now - busy
+        if flipped:
+            profiling.incr_counter(f"{self.ns}.unhealthy")
+            logger.error(
+                "%s: dispatch worker wedged for %.1fs — flipping UNHEALTHY "
+                "and dumping flight recorder",
+                self.ns, age,
+            )
+            watch.dump(f"serve-wedged-{self.name}")
+        return age
 
     def predict(
         self, features: np.ndarray, timeout_ms: Optional[float] = None
@@ -223,18 +319,40 @@ class ModelServer:
             if item is None:
                 return
             batch, _reason = item
+            with self._health_lock:
+                self._busy_since = profiling.now()
             try:
                 self._dispatch(batch)
-            except BaseException:  # noqa: BLE001 - the worker must survive
+            except BaseException as exc:  # noqa: BLE001 - worker must survive
                 # _dispatch relays model errors to the batch's futures; this
                 # guard is for bookkeeping bugs (e.g. a racing future state)
                 # — one batch may be lost, the server must not wedge
                 logger.exception("%s: dispatch bookkeeping failed", self.ns)
                 profiling.incr_counter(f"{self.ns}.errors")
+                rec = watch.recorder()
+                if rec is not None:
+                    rec.record_exception(exc, f"serve-{self.name}")
                 for r in batch:
                     resolve_future(
                         r.future,
                         exc=RuntimeError(f"{self.ns}: dispatch failed"),
+                    )
+            finally:
+                with self._health_lock:
+                    self._busy_since = None
+                    recovered = self._state == UNHEALTHY
+                    if recovered:
+                        # the wedged dispatch came back after all: recover —
+                        # UNHEALTHY describes the worker, not history (but a
+                        # drain that began meanwhile stays a drain)
+                        self._state = (
+                            DRAINING if self._drain_begun else READY
+                        )
+                if recovered:
+                    profiling.incr_counter(f"{self.ns}.recovered")
+                    logger.warning(
+                        "%s: wedged dispatch returned; %s",
+                        self.ns, self._state,
                     )
 
     def _dispatch(self, batch) -> None:
@@ -264,6 +382,11 @@ class ModelServer:
                 out = self._entry.call(padded)
         except BaseException as exc:  # noqa: BLE001 - relayed to every waiter
             profiling.incr_counter(f"{self.ns}.errors")
+            rec = watch.recorder()
+            if rec is not None:
+                # ring-record the model error (cheap, no dump: per-batch
+                # model errors are relayed to callers, not process fatal)
+                rec.record_exception(exc, f"serve-{self.name}")
             for r in batch:
                 resolve_future(r.future, exc=exc)
             return
@@ -298,6 +421,10 @@ class ModelServer:
         queued request has resolved (quiescence).  The server keeps running
         only in the sense that the worker stays alive for shutdown(); new
         submits are rejected once draining starts."""
+        with self._health_lock:
+            self._drain_begun = True
+            if self._state != UNHEALTHY:
+                self._state = DRAINING
         self._batcher.begin_drain()
         if not self._batcher.wait_quiescent(timeout_s=timeout_s):
             raise TimeoutError(
@@ -337,6 +464,54 @@ class ModelServer:
             "covered by the warm set"
         )
 
+    def state(self) -> str:
+        """Current lifecycle state (wedge detection applied lazily)."""
+        self._check_wedged()
+        return self._state
+
+    def health(self) -> Dict[str, Any]:
+        """SLO-scored health: lifecycle state, p99 vs SRML_SERVE_SLO_MS,
+        and the burn fraction (share of window requests OVER the SLO) —
+        Prometheus-style burn-rate health over the latency sample window.
+        With no SLO configured attainment is vacuously 1.0; a READY server
+        whose burn exceeds SRML_SERVE_SLO_BURN reports DEGRADED."""
+        self._check_wedged()
+        slo_ms = _slo_ms()
+        samples = profiling.durations(f"serve.{self.name}.latency").get(
+            f"serve.{self.name}.latency", []
+        )
+        if slo_ms > 0 and samples:
+            met = sum(1 for s in samples if s * 1000.0 <= slo_ms)
+            attainment = met / len(samples)
+        else:
+            attainment = 1.0
+        burn = 1.0 - attainment
+        state = self._state
+        if state == READY and burn > _slo_burn_budget():
+            state = DEGRADED
+        lat = profiling.percentiles(f"serve.{self.name}.latency")
+        busy = self._busy_since
+        return {
+            "name": self.name,
+            "state": state,
+            "state_code": STATE_CODES[state],
+            "slo_ms": slo_ms,
+            "attainment": round(attainment, 6),
+            "burn": round(burn, 6),
+            "burn_budget": _slo_burn_budget(),
+            "window_count": len(samples),
+            "p99_ms": (
+                round(lat["p99"] * 1000.0, 3) if lat else None
+            ),
+            "queued_rows": self._batcher.queued_rows(),
+            "queued_requests": self._batcher.queued_requests(),
+            "outstanding": self._batcher.outstanding(),
+            "busy_s": (
+                round(profiling.now() - busy, 3) if busy is not None else 0.0
+            ),
+            "steady_compiles": self._steady_compiles,
+        }
+
     def stats(self) -> Dict[str, Any]:
         """One self-describing snapshot: queue gauges, batching counters,
         latency percentiles, and the compile watermark."""
@@ -345,6 +520,7 @@ class ModelServer:
         occ = profiling.percentiles(f"serve.{self.name}.occupancy")
         return {
             "name": self.name,
+            "state": self.state(),
             "entry": self._entry.name,
             "out_cols": list(self._entry.out_cols),
             "buckets": list(self.buckets),

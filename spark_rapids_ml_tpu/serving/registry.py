@@ -15,7 +15,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
-from .engine import ModelServer
+from .engine import STATE_CODES, WARMING, ModelServer
 
 
 class ModelRegistry:
@@ -23,12 +23,31 @@ class ModelRegistry:
 
     `server_kwargs` are the defaults every server is built with
     (max_batch, max_wait_ms, queue_depth, ...); per-model overrides go on
-    register/load."""
+    register/load.  Each registry registers a health gauge provider
+    (srml-watch), so every server's state/attainment/burn flows through
+    profiling.export_metrics() and the Prometheus rendering for as long as
+    the registry lives."""
 
     def __init__(self, **server_kwargs: Any):
         self._defaults = dict(server_kwargs)
         self._lock = threading.Lock()
         self._servers: Dict[str, ModelServer] = {}
+        import weakref
+
+        from .. import profiling
+
+        # the provider holds a WEAK reference: a registry abandoned without
+        # shutdown() must not be pinned alive by the gauge registry (its
+        # servers' __del__ backstops still run, and the provider degrades
+        # to {} instead of scraping a ghost)
+        self._gauge_key = f"serving-registry-{id(self):x}"
+        ref = weakref.ref(self)
+
+        def _provider():
+            reg = ref()
+            return reg._health_gauges() if reg is not None else {}
+
+        profiling.register_gauges(self._gauge_key, _provider)
 
     def register(self, name: str, model: Any, **overrides: Any) -> ModelServer:
         """Serve an in-memory fitted model under `name` (warms buckets and
@@ -89,6 +108,45 @@ class ModelRegistry:
             servers = {n: s for n, s in self._servers.items() if s is not None}
         return {name: s.stats() for name, s in sorted(servers.items())}
 
+    def health(self) -> Dict[str, Any]:
+        """Health of the whole serving plane: per-server SLO-scored health
+        (serving/engine.ModelServer.health) plus the registry's overall
+        state — the WORST server state, so one wedged worker turns the
+        whole plane's headline red.  Servers still warming (reservations)
+        report WARMING."""
+        with self._lock:
+            snapshot = dict(self._servers)
+        models: Dict[str, Any] = {}
+        for name, server in sorted(snapshot.items()):
+            if server is None:  # reserved: register/load still warming
+                models[name] = {
+                    "name": name,
+                    "state": WARMING,
+                    "state_code": STATE_CODES[WARMING],
+                }
+            else:
+                models[name] = server.health()
+        worst = max(
+            (m["state"] for m in models.values()),
+            key=lambda s: STATE_CODES[s],
+            default=WARMING,  # an empty registry is not unhealthy, just idle
+        )
+        return {"state": worst, "models": models}
+
+    def _health_gauges(self) -> Dict[str, float]:
+        """Gauge-provider view of health() for export_metrics()/Prometheus:
+        health.<model>.{state_code,attainment,burn,p99_ms,queued_rows}."""
+        out: Dict[str, float] = {}
+        for name, h in self.health()["models"].items():
+            out[f"health.{name}.state_code"] = float(h["state_code"])
+            if "attainment" in h:
+                out[f"health.{name}.attainment"] = float(h["attainment"])
+                out[f"health.{name}.burn"] = float(h["burn"])
+                out[f"health.{name}.queued_rows"] = float(h["queued_rows"])
+                if h.get("p99_ms") is not None:
+                    out[f"health.{name}.p99_ms"] = float(h["p99_ms"])
+        return out
+
     def telemetry(self, since: Optional[Any] = None) -> Any:
         """TelemetrySnapshot of the whole serving plane: every
         serving.<name>.* counter plus mergeable digests of the serve.<name>.*
@@ -130,6 +188,9 @@ class ModelRegistry:
         return profiling.TelemetrySnapshot(counters=ctr, durations=dur)
 
     def shutdown(self, drain: bool = True) -> None:
+        from .. import profiling
+
+        profiling.unregister_gauges(self._gauge_key)
         with self._lock:
             servers = [s for s in self._servers.values() if s is not None]
             self._servers.clear()

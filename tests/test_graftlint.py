@@ -527,6 +527,66 @@ def test_r6_pragma_escape():
     assert _lint(src, path="spark_rapids_ml_tpu/x.py") == []
 
 
+# -- R7: every thread must be named -------------------------------------------
+
+R7_BAD = """
+    import threading
+
+    def start(fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        return t
+"""
+
+R7_BAD_FROM_IMPORT = """
+    from threading import Thread, Timer
+
+    def start(fn):
+        Timer(1.0, fn).start()
+        return Thread(target=fn)
+"""
+
+R7_GOOD = """
+    import threading
+
+    def start(fn, name):
+        t = threading.Thread(target=fn, name=f"srml-x-{name}", daemon=True)
+        t.start()
+        return t
+"""
+
+
+def test_r7_fires_on_unnamed_thread_in_package_module():
+    findings = _lint(R7_BAD, path="spark_rapids_ml_tpu/serving/engine.py")
+    assert _rules_of(findings) == ["R7"]
+    assert "name=" in findings[0].message
+
+
+def test_r7_resolves_from_import_aliases_and_timer():
+    findings = _lint(
+        R7_BAD_FROM_IMPORT, path="spark_rapids_ml_tpu/watch.py"
+    )
+    assert _rules_of(findings) == ["R7"]
+    assert len(findings) == 2  # Thread AND Timer
+
+
+def test_r7_silent_on_named_threads_and_out_of_scope():
+    assert _lint(R7_GOOD, path="spark_rapids_ml_tpu/serving/engine.py") == []
+    # benchmark/test harness threads may stay anonymous
+    assert _lint(R7_BAD, path="benchmark/bench_serving.py") == []
+    assert _lint(R7_BAD, path="tests/test_x.py") == []
+
+
+def test_r7_pragma_escape():
+    src = """
+        import threading
+
+        def start(fn):
+            return threading.Thread(target=fn)  # graftlint: disable=R7 (3p callback contract)
+    """
+    assert _lint(src, path="spark_rapids_ml_tpu/x.py") == []
+
+
 # -- the gate: the real tree is clean -----------------------------------------
 
 

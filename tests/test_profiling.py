@@ -145,6 +145,43 @@ def test_percentiles_merge_prefix_and_cross_thread():
     profiling.reset_durations("t.merge")
 
 
+def test_metric_ttl_evicts_stale_series(monkeypatch):
+    """SRML_METRIC_TTL_S: a series untouched for the TTL is evicted by the
+    amortized sweep inside record_duration, so a long-lived serving process
+    cycling through model names cannot leak series (default: off)."""
+    monkeypatch.setenv(profiling.METRIC_TTL_ENV, "0.05")
+    monkeypatch.setattr(profiling, "_TTL_SWEEP_EVERY", 2)
+    profiling.reset_durations("t.ttl")
+    profiling.record_duration("t.ttl.stale", 1.0)
+    import time as _time
+
+    _time.sleep(0.12)  # let t.ttl.stale age past the TTL
+    for _ in range(4):  # enough records to cross the sweep cadence
+        profiling.record_duration("t.ttl.live", 2.0)
+    series = profiling.durations("t.ttl")
+    assert "t.ttl.live" in series and "t.ttl.stale" not in series
+    # TTL off (default): nothing is ever evicted
+    monkeypatch.setenv(profiling.METRIC_TTL_ENV, "")
+    profiling.record_duration("t.ttl.stale", 1.0)
+    _time.sleep(0.06)
+    for _ in range(4):
+        profiling.record_duration("t.ttl.live", 2.0)
+    assert "t.ttl.stale" in profiling.durations("t.ttl")
+    profiling.reset_durations("t.ttl")
+
+
+def test_series_stats_reports_registry_footprint():
+    profiling.reset_durations("t.ss")
+    for _ in range(3):
+        profiling.record_duration("t.ss.a", 0.01)
+    stats = profiling.series_stats()
+    assert stats["series_count"] >= 1 and stats["ring_samples"] >= 3
+    assert stats["est_bytes"] >= stats["ring_samples"] * 8
+    a = stats["series"]["t.ss.a"]
+    assert a["ring_samples"] == 3 and a["lifetime_count"] == 3
+    profiling.reset_durations("t.ss")
+
+
 def test_duration_cap_is_a_ring_buffer(monkeypatch):
     monkeypatch.setattr(profiling, "_DURATION_CAP", 4)
     profiling.reset_durations("t.ring")

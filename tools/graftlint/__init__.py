@@ -26,6 +26,11 @@
 #                    modules outside profiling.py — all timing goes through
 #                    srml-scope (profiling.now()/span()) so spans, counters,
 #                    and trace exports share one clock.
+#   R7 unnamed-thread  threading.Thread/Timer without name= in
+#                    spark_rapids_ml_tpu modules — the srml-watch flight
+#                    recorder, trace exports, and watchdog reports attribute
+#                    events by thread name; "Thread-N" is useless in a hang
+#                    dump.
 #
 # Suppression: `# graftlint: disable=R1 (reason)` on the finding line or the
 # line directly above.  Granted pragmas are audited in NOTES.md.
@@ -61,6 +66,7 @@ RULE_NAMES = {
     "R4": "nondeterminism",
     "R5": "dtype",
     "R6": "raw-clock",
+    "R7": "unnamed-thread",
 }
 
 # Findings sanctioned by construction, not by pragma.  Entries are

@@ -23,7 +23,7 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
+RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
 
 FindingTuple = Tuple[str, int, str, str]  # (rule, line, message, func-qualname)
 
@@ -683,6 +683,43 @@ def _r6_check_call(
         )
 
 
+# -- R7: every thread must be named -------------------------------------------
+# The srml-watch flight recorder, trace exports, and watchdog reports all
+# attribute events to thread NAMES ("srml-serve-km", "srml-precompile-3",
+# "srml-watch-hb-r0").  An unnamed threading.Thread shows up as "Thread-7" —
+# useless in a hang dump and unstable across runs — so every Thread
+# constructed inside the package must pass name=.  Scoped like R6 to
+# spark_rapids_ml_tpu/ (tests/benchmarks may thread however they like).
+
+_R7_THREADS = {"threading.Thread", "threading.Timer"}
+
+
+def _r7_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "spark_rapids_ml_tpu/" in norm or norm.startswith(
+        "spark_rapids_ml_tpu"
+    )
+
+
+def _r7_check_call(
+    call: ast.Call, index: ModuleIndex, qualname: str
+) -> Iterator[FindingTuple]:
+    name = index.dotted(call.func)
+    if name not in _R7_THREADS:
+        return
+    if any(kw.arg == "name" for kw in call.keywords):
+        return
+    yield (
+        "R7",
+        call.lineno,
+        f"{name}(...) without name=: the flight recorder, trace exports, "
+        "and watchdog reports attribute events by thread name — an "
+        "anonymous 'Thread-N' is useless in a hang dump.  Pass "
+        "name=\"srml-<subsystem>-...\" (docs/observability.md#r7)",
+        qualname,
+    )
+
+
 # -- driver -------------------------------------------------------------------
 
 def lint_tree(
@@ -751,6 +788,8 @@ def lint_tree(
                 )
             if "R6" in selected and _r6_applies(index.path):
                 findings.extend(_r6_check_call(node, index, qual))
+            if "R7" in selected and _r7_applies(index.path):
+                findings.extend(_r7_check_call(node, index, qual))
         if isinstance(node, ast.For) and "R4" in selected:
             findings.extend(_r4_check_for(node, qual, index))
         if "R5" in selected and _r5_applies(index.path):
