@@ -567,7 +567,10 @@ def run_arm(algo: str, overrides, repeats: int):
     """Build, warm up, and time one arm; returns its stats dict.  cold_sec
     records the first (warmup) call — compiles + device staging included —
     so the first-fit experience is a captured artifact, not a claim."""
+    from spark_rapids_ml_tpu.parallel.exchange import byte_totals
+
     repeats = max(repeats, ARM_MIN_REPEATS.get(algo, 1))
+    _x0_total, x0_per = byte_totals()
     fit, label, rows = build_arm(algo, overrides)
     cold, times, phases = _timed_repeats(fit, repeats)
     med, best = statistics.median(times), min(times)
@@ -584,6 +587,21 @@ def run_arm(algo: str, overrides, repeats: int):
         "cold_sec": round(cold, 3),
         "repeats": repeats,  # can exceed the global knob (ARM_MIN_REPEATS)
     }
+    # per-arm exchange byte totals (parallel/exchange section counters):
+    # host sections count per call, device sections per compiled geometry
+    # (trace time), so the number captures what ONE steady-state dispatch
+    # set moves — which is exactly where the all-gather -> ring-permute
+    # candidate-traffic reduction (~n_dev x) shows up.  standings.py
+    # renders the total as the kNN arm's `bytes moved` column.
+    x1_total, x1_per = byte_totals()
+    sections = {
+        name: v - x0_per.get(name, 0)
+        for name, v in sorted(x1_per.items())
+        if v - x0_per.get(name, 0) > 0
+    }
+    out["exchange_bytes"] = int(sum(sections.values()))
+    if sections:
+        out["exchange_sections"] = sections
     # per-repeat phase breakdown + the phase the spread lives in (srml-scope
     # satellites: standings.py renders the attribution next to the ⚠ flag)
     from spark_rapids_ml_tpu import profiling

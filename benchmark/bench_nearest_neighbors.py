@@ -41,6 +41,14 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
         query_df = transform_df or train_df
         if self.args.mode == "tpu":
             from spark_rapids_ml_tpu import NearestNeighbors, profiling
+            from spark_rapids_ml_tpu.parallel.exchange import byte_totals
+
+            # exchange bytes are counted over the WHOLE run (staging +
+            # warmup + timed repeats): device sections move at trace time,
+            # so the warmup call is where a steady-state search's traffic
+            # is recorded — a window over just the timed repeats would
+            # always read zero on a warm engine
+            _xt0, x0_per = byte_totals()
 
             # Deterministic staging: re-host the loaded frames as
             # block-stashed DataFrames (from_numpy pins ONE contiguous
@@ -77,6 +85,13 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
             inner_repeats = max(1, int(self.args.phase_repeats))
             repeat_times: List[float] = []
             phase_runs: List[Dict[str, float]] = []
+            # zero-new-compile gate across the timed repeats: the warmup
+            # above staged + compiled everything, so any compile counted
+            # here is a steady-state breach (the CI smoke asserts
+            # repeat_new_compiles == 0)
+            pre_compiles = profiling.counters("precompile").get(
+                "precompile.compile", 0
+            )
             for _ in range(inner_repeats):
                 profiling.reset_phase_times()
                 (item_df, q_df, knn_df), transform_time = with_benchmark(
@@ -84,6 +99,16 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
                 )
                 repeat_times.append(transform_time)
                 phase_runs.append(profiling.phase_times())
+            repeat_new_compiles = (
+                profiling.counters("precompile").get("precompile.compile", 0)
+                - pre_compiles
+            )
+            _xt1, x1_per = byte_totals()
+            exchange_sections = {
+                name: v - x0_per.get(name, 0)
+                for name, v in sorted(x1_per.items())
+                if v - x0_per.get(name, 0) > 0
+            }
             phases = {
                 name: round(sec, 4)
                 for name, sec in sorted(phase_runs[-1].items())
@@ -100,6 +125,9 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
                 "score": score,
                 "phase_times": phases,
                 "precompile_counters": profiling.counters("precompile"),
+                "repeat_new_compiles": int(repeat_new_compiles),
+                "exchange_bytes": int(sum(exchange_sections.values())),
+                "exchange_sections": exchange_sections,
             }
             if inner_repeats > 1:
                 out["times_sec"] = [round(t, 4) for t in repeat_times]

@@ -126,6 +126,24 @@ def _delta_cell(name: str, a: dict, prev_arms: dict):
     return cell, regressed
 
 
+def _bytes_cell(a: dict) -> str:
+    """Human-readable `bytes moved` cell from the arm's exchange-section
+    totals (bench.py `exchange_bytes`: host sections per call, device
+    sections per compiled geometry — the steady-state dispatch set's
+    traffic, where the all-gather -> ring candidate reduction shows).
+    Older artifacts without the field render —."""
+    nbytes = a.get("exchange_bytes")
+    if nbytes is None:
+        return "—"
+    if nbytes >= 1 << 30:
+        return f"{nbytes / (1 << 30):.2f} GiB"
+    if nbytes >= 1 << 20:
+        return f"{nbytes / (1 << 20):.1f} MiB"
+    if nbytes >= 1 << 10:
+        return f"{nbytes / (1 << 10):.1f} KiB"
+    return f"{nbytes} B"
+
+
 def _shape_note(metric: str) -> str:
     """Human-readable shape from the metric label's suffix tokens."""
     toks = metric.split("_")
@@ -161,14 +179,16 @@ def render(path: str) -> str:
         f"{n_timed} timed calls each"
         f"). Do not edit the table by hand.",
         "",
-        f"| arm | shape | rows/s (median) | vs reference GPU cluster | {vs_prev} | spread | cold first call |",
-        "|---|---|---|---|---|---|---|",
+        f"| arm | shape | rows/s (median) | vs reference GPU cluster | {vs_prev} | spread | bytes moved | cold first call |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     flagged = []
     regressed = []
     for name, vsb, a in rows:
         if vsb is None:
-            lines.append(f"| {name} | — | ERROR | {a['error']} | — | — | — |")
+            lines.append(
+                f"| {name} | — | ERROR | {a['error']} | — | — | — | — |"
+            )
             continue
         floor = " (floor)" if name in FLOOR_ARMS else ""
         val = f"{a['value']:,.0f}"
@@ -181,9 +201,10 @@ def render(path: str) -> str:
             spread += " ⚠"
             flagged.append(name)
         cold = f"{a['cold_sec']:.1f} s" if "cold_sec" in a else "—"
+        moved = _bytes_cell(a)
         lines.append(
             f"| {name} | {_shape_note(a['metric'])} | {val} "
-            f"| **{vsb:.2f}×**{floor} | {delta} | {spread} | {cold} |"
+            f"| **{vsb:.2f}×**{floor} | {delta} | {spread} | {moved} | {cold} |"
         )
     if regressed:
         lines += [
@@ -223,6 +244,13 @@ def render(path: str) -> str:
     if notes:
         lines += ["", "Measurement assumptions carried by the artifact:", *notes]
     lines += [
+        "",
+        "`bytes moved` totals the arm's `exchange.<section>.bytes` "
+        "counters (parallel/exchange typed sections): host collectives "
+        "count per call, device collectives per compiled geometry — the "
+        "steady-state dispatch set's interconnect traffic.  For the kNN "
+        "arm this is where the all-gather → ring-permute candidate "
+        "exchange's ~n_dev× reduction is visible round over round.",
         "",
         "`Δ vs prev` compares each arm's rows/s against the previous "
         "captured round (the artifact's `prev_round` pointer, emitted by "

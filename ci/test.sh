@@ -249,6 +249,47 @@ print("watch smoke OK:", km["state"], f"attainment={km['attainment']}")
 EOF
 rm -rf "$WATCH_SMOKE"
 
+# 3i. focused gates for the kNN exchange + fused epilogue (also inside the
+#     full suite; re-asserted here by name so marker drift can never
+#     silently drop them).  Runs on the 8-device CPU mesh, forced
+#     explicitly:
+#     - BITWISE parity matrix: ring-permute exchange == all-gather
+#       exchange == single-device reference on 1/2/8-device meshes
+#       (lex (d2, pos) total order + fixed-tile scans)
+#     - distributed_kneighbors ring route == allgather route == sklearn,
+#       including the collective fallback when a rank's items overflow
+#     - repeat same-shape ring search performs ZERO new compilations
+#     - fused merge epilogue in interpret mode: nb>1 K-block geometry,
+#       the lex tie contract vs the numpy oracle, and the forced
+#       self-verify fallback through the fused path
+#     plus a graftlint-clean re-check (incl. R8 remote-dma confinement) of
+#     the touched modules by name, and a bench_nearest_neighbors smoke
+#     asserting zero new compiles on repeat search and the bytes-moved
+#     fields present.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_knn_exchange.py -q
+python -m pytest tests/test_pallas.py -q -k "fused"
+python -m tools.graftlint spark_rapids_ml_tpu/ops/knn.py \
+    spark_rapids_ml_tpu/ops/pallas_knn.py spark_rapids_ml_tpu/parallel/exchange.py \
+    spark_rapids_ml_tpu/models/knn.py spark_rapids_ml_tpu/ann \
+    benchmark/bench_nearest_neighbors.py
+KNN_SMOKE=$(mktemp -d)
+python -m benchmark.gen_data blobs --num_rows 2000 --num_cols 16 --n_clusters 8 \
+    --output_dir "$KNN_SMOKE/blobs" --output_num_files 2
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.benchmark_runner knn \
+    --train_path "$KNN_SMOKE/blobs" --k 10 \
+    --report_path "$KNN_SMOKE/knn.jsonl"
+python - "$KNN_SMOKE/knn.jsonl" <<'EOF'
+import json, sys
+rec = json.loads(open(sys.argv[1]).readline())
+assert rec["repeat_new_compiles"] == 0, rec
+# 8-device mesh: the ring exchange must have moved (and counted) bytes
+assert rec["exchange_bytes"] > 0, rec
+assert any(s.startswith("knn.ring") for s in rec["exchange_sections"]), rec
+EOF
+rm -rf "$KNN_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

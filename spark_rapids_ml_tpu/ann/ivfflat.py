@@ -123,42 +123,16 @@ def _probe_chunk(block: int, nprobe: int, l_pad: int, dim: int) -> int:
 
 
 def _lex_topk(d2: jax.Array, pos: jax.Array, k: int, group: int = 1024):
-    """Smallest k candidates by the lexicographic (d2, pos) key, ascending.
+    """Smallest k candidates by the lexicographic (d2, pos) key, ascending —
+    ONE implementation shared with the exact engine's exchange kernels
+    (ops/knn.lex_topk, moved there when the ring/gather candidate exchange
+    adopted the same total-order tie contract this engine's mesh-parity
+    gate established).  Positions are unique among valid candidates, so
+    the key is a TOTAL order: the result is identical no matter how the
+    input pool was concatenated."""
+    from ..ops.knn import lex_topk
 
-    Exact two-stage selection (same shape as ops/knn._grouped_topk_exact):
-    group-wise two-key sorts keep each group's lex-top-k, then one final
-    two-key sort over the ng*k survivors — every global lex-top-k member is
-    necessarily in its own group's lex-top-k (k <= group by construction).
-    Positions are unique among valid candidates, so the key is a TOTAL
-    order: the result is identical no matter how the input pool was
-    concatenated — the property the mesh-parity gate rests on."""
-    Qn, C = d2.shape
-    group = max(group, 1 << (max(k, 1) - 1).bit_length())
-    if C > 2 * group:
-        ng = -(-C // group)
-        pad = ng * group - C
-        if pad:
-            d2 = jnp.pad(d2, ((0, 0), (0, pad)), constant_values=jnp.inf)
-            pos = jnp.pad(
-                pos, ((0, 0), (0, pad)), constant_values=_POS_SENTINEL
-            )
-        gd, gp = jax.lax.sort(
-            (d2.reshape(Qn, ng, group), pos.reshape(Qn, ng, group)),
-            dimension=2,
-            num_keys=2,
-        )
-        kk = min(k, group)
-        d2 = gd[:, :, :kk].reshape(Qn, ng * kk)
-        pos = gp[:, :, :kk].reshape(Qn, ng * kk)
-    sd, sp = jax.lax.sort((d2, pos), dimension=1, num_keys=2)
-    kk = min(k, sd.shape[1])
-    sd, sp = sd[:, :kk], sp[:, :kk]
-    if kk < k:
-        sd = jnp.pad(sd, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
-        sp = jnp.pad(
-            sp, ((0, 0), (0, k - kk)), constant_values=_POS_SENTINEL
-        )
-    return sd, sp
+    return lex_topk(d2, pos, k, group=group, sentinel=_POS_SENTINEL)
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "chunk"))
@@ -246,13 +220,15 @@ def ivf_probe_kernel(
         best_d = ds.reshape(Q, k)
         best_p = ps.reshape(Q, k)
         if mesh.shape[DATA_AXIS] > 1:
-            from ..parallel.exchange import psum_merge_parts
+            from ..parallel.exchange import device_collective
 
             # the ONE cross-shard collective: per-shard (Q, k) candidates
             # scattered into a (n_dev, Q, k) slab and psum'd (exact — each
-            # element is one shard's value plus zeros)
-            all_d = psum_merge_parts(best_d, DATA_AXIS)
-            all_p = psum_merge_parts(best_p, DATA_AXIS)
+            # element is one shard's value plus zeros).  Typed exchange
+            # section: uniform exchange.ann.probe_merge.* counters.
+            sec = device_collective("ann.probe_merge")
+            all_d = sec.psum_merge(best_d, DATA_AXIS)
+            all_p = sec.psum_merge(best_p, DATA_AXIS)
             cand_d = jnp.moveaxis(all_d, 0, 1).reshape(Q, -1)
             cand_p = jnp.moveaxis(all_p, 0, 1).reshape(Q, -1)
             best_d, best_p = _lex_topk(cand_d, cand_p, k)

@@ -9,7 +9,9 @@
 # exactNearestNeighborsJoin builds the exploded join frame (knn.py:604-672),
 # and neither estimator nor model is persistable (knn.py:333-345, 674-683).
 # The UCX p2p partition exchange is replaced by the mesh block schedule in
-# ops/knn.py.
+# ops/knn.py — on multi-shard meshes the candidate exchange is the
+# ring-permute route by default (query blocks rotate neighbor-to-neighbor
+# with a traveling top-k; SRML_KNN_EXCHANGE selects; docs/knn_pipeline.md).
 #
 
 from __future__ import annotations
@@ -245,6 +247,12 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         mesh = get_mesh(self.num_workers)
         from .. import profiling
 
+        # the candidate-exchange route each dispatched block actually took
+        # lands in the knn.exchange_route.<route> counters (incremented at
+        # the ops-layer dispatch chokepoint, so the adaptive Pallas route —
+        # which runs no exchange — is never misattributed); traces and
+        # metric exports distinguish ring from all-gather deployments
+        # without reading env state
         with profiling.trace_session("search-NearestNeighbors"):
             per_part = self._search_partitions(
                 id_col, dtype, mesh, q_parts, _query_feats, self.getK()
@@ -564,7 +572,11 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
         The engine's pow2 buckets feed the search's own >=64 query-block
         bucketing (_query_block_bucket), so warm_search_kernels covers every
         geometry the steady state dispatches."""
-        from ..ops.knn import knn_search_prepared, warm_search_kernels
+        from ..ops.knn import (
+            _exchange_route,
+            knn_search_prepared,
+            warm_search_kernels,
+        )
         from ..serving.entry import ServingEntry
 
         mesh = mesh or get_mesh(self.num_workers)
@@ -599,7 +611,13 @@ class NearestNeighborsModel(_NearestNeighborsParams, _TpuModel):
             out_cols=["indices", "distances"],
             call=call,
             warm=warm,
-            info={"k": int(min(k, prepared.n_items)), "n_items": int(prepared.n_items)},
+            info={
+                "k": int(min(k, prepared.n_items)),
+                "n_items": int(prepared.n_items),
+                # the CONFIGURED exact-exchange route for this mesh (per-
+                # dispatch actuals land in knn.exchange_route.* counters)
+                "exchange_route": _exchange_route(mesh),
+            },
         )
 
     def write(self):

@@ -656,7 +656,10 @@ def _forest_block_body(
     if axis_name is not None:
         from ..parallel.exchange import psum_parts
 
-        combine = lambda h: psum_parts(h, axis_name)  # noqa: E731
+        # typed section name: uniform exchange.forest.hist_parts.* counters
+        combine = lambda h: psum_parts(  # noqa: E731
+            h, axis_name, section="forest.hist_parts"
+        )
 
     def level_step(rel_l, li):
         active = rel_l < _SENTINEL

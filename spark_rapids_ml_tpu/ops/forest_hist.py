@@ -326,7 +326,7 @@ def node_histograms_sharded(
             b_loc, nr_loc, st_loc, t_pack=t_pack, nodes=nodes, s_dim=s_dim,
             n_bins=n_bins, interpret=interpret,
         )
-        return psum_parts(H, DATA_AXIS)
+        return psum_parts(H, DATA_AXIS, section="forest.hist_parts")
 
     return shard_map(
         body,

@@ -81,6 +81,51 @@ def _topk_approx_verified(vals: jax.Array, k: int, group: int = 1024):
     return jax.lax.cond(all_exact, approx, exact, None)
 
 
+# lexicographic-(d2, pos) padding sentinel: sorts after every genuine
+# candidate (inf distance, max int32 position)
+LEX_POS_SENTINEL = np.int32(np.iinfo(np.int32).max)
+
+
+def lex_topk(d2: jax.Array, pos: jax.Array, k: int, group: int = 1024,
+             sentinel=LEX_POS_SENTINEL):
+    """Smallest k candidates by the lexicographic (d2, pos) key, ascending.
+
+    Exact two-stage selection (same shape as _grouped_topk_exact):
+    group-wise two-key sorts keep each group's lex-top-k, then one final
+    two-key sort over the ng*k survivors — every global lex-top-k member is
+    necessarily in its own group's lex-top-k (k <= group by construction).
+    Positions are unique among valid candidates, so the key is a TOTAL
+    order: the result is identical no matter how the input pool was
+    partitioned or concatenated.  That is the property the kNN exchange
+    parity matrix rests on (ring-permute hops merge candidates in a
+    DIFFERENT order than an all-gather concat — lex uniqueness makes both
+    orders land on the same bits), the same device-side tie contract the
+    ANN engine's mesh-parity gate established (ann/ivfflat imports this)."""
+    Qn, C = d2.shape
+    group = max(group, 1 << (max(k, 1) - 1).bit_length())
+    if C > 2 * group:
+        ng = -(-C // group)
+        pad = ng * group - C
+        if pad:
+            d2 = jnp.pad(d2, ((0, 0), (0, pad)), constant_values=jnp.inf)
+            pos = jnp.pad(pos, ((0, 0), (0, pad)), constant_values=sentinel)
+        gd, gp = jax.lax.sort(
+            (d2.reshape(Qn, ng, group), pos.reshape(Qn, ng, group)),
+            dimension=2,
+            num_keys=2,
+        )
+        kk = min(k, group)
+        d2 = gd[:, :, :kk].reshape(Qn, ng * kk)
+        pos = gp[:, :, :kk].reshape(Qn, ng * kk)
+    sd, sp = jax.lax.sort((d2, pos), dimension=1, num_keys=2)
+    kk = min(k, sd.shape[1])
+    sd, sp = sd[:, :kk], sp[:, :kk]
+    if kk < k:
+        sd = jnp.pad(sd, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
+        sp = jnp.pad(sp, ((0, 0), (0, k - kk)), constant_values=sentinel)
+    return sd, sp
+
+
 def _grouped_topk(vals: jax.Array, k: int, group: int = 1024):
     """Exact top-k, accelerated by the TPU's PartialReduce unit.
 
@@ -314,9 +359,13 @@ def knn_block_kernel(
             # sorted); the gather + re-sort below would be a pure no-op
             # costing a full (Q, k) sort
             return best_d, best_ids
-        # (n_dev, Q, k) candidates — the only cross-shard traffic
-        all_d = jax.lax.all_gather(best_d, DATA_AXIS)
-        all_ids = jax.lax.all_gather(best_ids, DATA_AXIS)
+        # (n_dev, Q, k) candidates — the only cross-shard traffic (typed
+        # exchange section: uniform exchange.knn.block_cand.* counters)
+        from ..parallel.exchange import device_collective
+
+        sec = device_collective("knn.block_cand")
+        all_d = sec.gather_stack(best_d, DATA_AXIS)
+        all_ids = sec.gather_stack(best_ids, DATA_AXIS)
         cand_d = jnp.moveaxis(all_d, 0, 1).reshape(q.shape[0], -1)
         cand_ids = jnp.moveaxis(all_ids, 0, 1).reshape(q.shape[0], -1)
         neg_final, fidx = jax.lax.top_k(-cand_d, min(k, cand_d.shape[1]))
@@ -331,6 +380,270 @@ def knn_block_kernel(
         check_vma=False,
     )(items, item_norm, item_pos, valid, queries)
     return jnp.sqrt(jnp.maximum(d2, 0.0)), pos
+
+
+# ---------------------------------------------------------------------------
+# Candidate-exchange block kernels: ring permute vs all-gather.
+#
+# The mesh schedule above replicates every query block on every shard and
+# all-gathers an (n_dev, Q, k) candidate slab — n_dev^2 * Q * k scalars of
+# interconnect traffic for Q * k * n_dev useful ones.  The ring route
+# reshapes the exchange to ring attention's block rotation (SURVEY.md §5):
+# query blocks are ROW-SHARDED, each shard scans the visiting block against
+# its resident items, merges into the block's traveling top-k, and passes
+# block + running candidates to its +1 neighbor (DeviceSection.ring_shift —
+# remote-DMA on TPU hardware, lax.ppermute everywhere else).  After n_dev
+# hops every block is home carrying the global top-k: total candidate
+# traffic is n_dev * Qb * k per hop * n_dev hops = Q * k * n_dev scalars,
+# an n_dev-fold reduction, and every transfer is neighbor-to-neighbor.
+#
+# Both routes select with the lexicographic (d2, pos) key at EVERY stage
+# (lex_topk): positions are globally unique, the key is a total order, so
+# the merged top-k is independent of merge order — which is what makes
+# "ring == all-gather == single-device reference" a BITWISE gate rather
+# than a tolerance test.  The scans tile queries in fixed qt-row sub-tiles
+# and items in fixed chunk-wide slices so every matmul has the same shape
+# on every mesh size (the ANN engine's mesh-independence recipe); parity is
+# bitwise whenever qt and chunk land mesh-independent (q >= qt * n_dev,
+# n_loc >= chunk), which the _exchange_geometry docstring spells out.
+# ---------------------------------------------------------------------------
+
+_EXCHANGE_ENV = "SRML_KNN_EXCHANGE"
+_RING_CHUNK_ENV = "SRML_KNN_RING_CHUNK"
+_RING_CHUNK = 16384
+_RING_QT = 64
+
+
+def _exchange_env() -> str:
+    """Canonicalized SRML_KNN_EXCHANGE value — the ONE env read shared by
+    the in-mesh route (_exchange_route) and the distributed_kneighbors
+    protocol decision, so an unrecognized value coerces to the same
+    default ('ring') everywhere instead of splitting the two layers onto
+    different routes."""
+    import os
+
+    r = os.environ.get(_EXCHANGE_ENV, "ring")
+    return r if r in ("ring", "gather", "legacy") else "ring"
+
+
+def _exchange_route(mesh: Mesh, q_rows: int = None) -> str:
+    """Candidate-exchange route for this mesh: 'local' (one shard — no
+    exchange at all), or SRML_KNN_EXCHANGE in {'ring' (default), 'gather',
+    'legacy'} — 'gather' is the lex all-gather comparator the parity matrix
+    pins against the ring, 'legacy' the pre-exchange knn_block_kernel.
+    When `q_rows` is given, ring additionally requires the query rows to
+    shard evenly (pow2 blocks on pow2 meshes always do) — ONE derivation
+    shared by dispatch and warm, so the two can never key different
+    executables."""
+    n_dev = mesh.shape[DATA_AXIS]
+    if n_dev == 1:
+        return "local"
+    route = _exchange_env()
+    if route == "ring" and q_rows is not None and q_rows % n_dev:
+        return "gather"
+    return route
+
+
+def _exchange_geometry(n_loc: int, q_rows: int, n_dev: int, route: str):
+    """(chunk, qt) statics for the exchange kernels — ONE derivation shared
+    by the dispatch path and warm_search_kernels.  Both are derived to be
+    MESH-INDEPENDENT in the parity regime: chunk = min(cap, n_loc) equals
+    the cap whenever every tested shard holds >= cap rows, and qt (the
+    fixed query sub-tile) is the largest power-of-two divisor of the
+    per-shard query rows up to 64 — equal across mesh sizes whenever
+    q_rows is a multiple of 64 * n_dev.  Inside that regime every distance
+    tile is the same (qt, chunk) shape on every mesh, so per-candidate d2
+    bits are mesh-independent and the lex merges make the rest exact."""
+    import math
+    import os
+
+    try:
+        cap = int(os.environ.get(_RING_CHUNK_ENV, _RING_CHUNK))
+    except ValueError:
+        cap = _RING_CHUNK
+    chunk = max(1, min(cap, n_loc))
+    rows = q_rows // n_dev if route == "ring" else q_rows
+    qt = max(1, math.gcd(max(rows, 1), _RING_QT))
+    return chunk, qt
+
+
+def _lex_local_scan(items_loc, x_norm, pos_loc, valid_loc, q, k, chunk, qt):
+    """Per-shard lex-(d2, pos) top-k of `q` against the resident items:
+    lax.scan over fixed qt-row query sub-tiles (outer) and fixed chunk-wide
+    item slices (inner), with a running 2-way lex merge per chunk.  Every
+    matmul is exactly (qt, D) @ (D, chunk) — the fixed-tile contract the
+    parity matrix rests on (module header)."""
+    n_loc = items_loc.shape[0]
+    n_chunks = -(-n_loc // chunk)
+    n_sub = q.shape[0] // qt
+
+    def sub_body(c, si):
+        qs = jax.lax.dynamic_slice_in_dim(q, si * qt, qt)
+        qn = (qs * qs).sum(axis=1)
+
+        def chunk_body(carry, ci):
+            bd, bp = carry
+            d2, start = _chunk_d2(items_loc, x_norm, valid_loc, qs, qn, ci, chunk)
+            pos = (
+                (start + pos_loc[0] + jnp.arange(chunk, dtype=jnp.int32))[None]
+                + jnp.zeros((qt, 1), jnp.int32)
+            )
+            # masked slots (invalid rows, ragged-tail overlap) carry inf d2;
+            # sentinel their positions so the lex key sorts them last
+            pos = jnp.where(jnp.isfinite(d2), pos, LEX_POS_SENTINEL)
+            cd, cp = lex_topk(d2, pos.astype(jnp.int32), k)
+            md, mp = lex_topk(
+                jnp.concatenate([bd, cd], axis=1),
+                jnp.concatenate([bp, cp], axis=1),
+                k,
+            )
+            return (md, mp), None
+
+        init = (
+            jnp.full((qt, k), jnp.inf, jnp.float32),
+            jnp.full((qt, k), LEX_POS_SENTINEL, jnp.int32),
+        )
+        (bd, bp), _ = jax.lax.scan(
+            chunk_body, init, jnp.arange(n_chunks, dtype=jnp.int32)
+        )
+        return c, (bd, bp)
+
+    _, (ds, ps) = jax.lax.scan(
+        sub_body, 0, jnp.arange(n_sub, dtype=jnp.int32)
+    )
+    return ds.reshape(-1, k), ps.reshape(-1, k)
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "route", "chunk", "qt"))
+def knn_block_kernel_exchange(
+    items: jax.Array,      # (N_pad, D) row-sharded
+    item_norm: jax.Array,  # (N_pad,) row-sharded
+    item_pos: jax.Array,   # (N_pad,) int32 row-sharded
+    valid: jax.Array,      # (N_pad,) bool row-sharded
+    queries: jax.Array,    # (Q, D): ring route row-shards it, gather
+                           # replicates it
+    mesh: Mesh,
+    k: int,
+    route: str,            # "ring" | "gather"
+    chunk: int,
+    qt: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k nearest items per query over the candidate-exchange routes
+    (module header).  Same output contract as knn_block_kernel: (distances
+    (Q, k) ascending euclidean, positions (Q, k) int32 into the padded item
+    set, clamped in-bounds — unfillable slots carry inf distance, which the
+    callers' -1 id sentinel logic keys on).  Tie order is the lex (d2, pos)
+    contract — deterministic and mesh-independent, unlike the legacy
+    kernel's arbitrary sort order."""
+    from ..parallel.exchange import device_collective
+
+    if queries.shape[1] != items.shape[1]:
+        queries = jnp.pad(
+            queries, ((0, 0), (0, items.shape[1] - queries.shape[1]))
+        )
+    n_dev = mesh.shape[DATA_AXIS]
+    n_pad = items.shape[0]
+
+    def per_shard_ring(items_loc, x_norm, pos_loc, valid_loc, q_blk):
+        sec_q = device_collective("knn.ring_q")
+        sec_c = device_collective("knn.ring_cand")
+        bd = jnp.full((q_blk.shape[0], k), jnp.inf, jnp.float32)
+        bp = jnp.full((q_blk.shape[0], k), LEX_POS_SENTINEL, jnp.int32)
+        for _hop in range(n_dev):
+            # kick the NEXT hop's query block onto the wire FIRST: the
+            # rotation has no data dependence on this hop's scan, so the
+            # (big) query frame crosses the interconnect while the local
+            # distance scan runs — the double-buffered compute/communicate
+            # overlap, now on the exchange itself
+            q_next = sec_q.ring_shift(q_blk)
+            cd, cp = _lex_local_scan(
+                items_loc, x_norm, pos_loc, valid_loc, q_blk, k, chunk, qt
+            )
+            md, mp = lex_topk(
+                jnp.concatenate([bd, cd], axis=1),
+                jnp.concatenate([bp, cp], axis=1),
+                k,
+            )
+            # the running candidates travel WITH their block (+1 together)
+            bd = sec_c.ring_shift(md)
+            bp = sec_c.ring_shift(mp)
+            q_blk = q_next
+        # n_dev rotations = identity: block and candidates are home
+        return jnp.sqrt(jnp.maximum(bd, 0.0)), jnp.minimum(bp, n_pad - 1)
+
+    def per_shard_gather(items_loc, x_norm, pos_loc, valid_loc, q):
+        cd, cp = _lex_local_scan(
+            items_loc, x_norm, pos_loc, valid_loc, q, k, chunk, qt
+        )
+        Q = q.shape[0]
+        sec = device_collective("knn.gather_cand")
+        all_d = sec.psum_merge(cd, DATA_AXIS)   # (n_dev, Q, k) slabs —
+        all_p = sec.psum_merge(cp, DATA_AXIS)   # exact as a gather
+        fd, fp = lex_topk(
+            jnp.moveaxis(all_d, 0, 1).reshape(Q, -1),
+            jnp.moveaxis(all_p, 0, 1).reshape(Q, -1),
+            k,
+        )
+        return jnp.sqrt(jnp.maximum(fd, 0.0)), jnp.minimum(fp, n_pad - 1)
+
+    if route == "ring":
+        return shard_map(
+            per_shard_ring,
+            mesh=mesh,
+            in_specs=(
+                P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                P(DATA_AXIS),
+            ),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False,
+        )(items, item_norm, item_pos, valid, queries)
+    return shard_map(
+        per_shard_gather,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(items, item_norm, item_pos, valid, queries)
+
+
+def _exact_block_search(items, item_norm, item_pos, valid, qd, mesh, k):
+    """Dispatch ONE exact block search through the routed exchange kernel —
+    the single chokepoint every exact-route caller (block pipeline, adaptive
+    fallback rerun) and warm_search_kernels key through, so a warmed
+    executable is always the one a later dispatch runs.  The route — incl.
+    the ring even-sharding fallback — comes from the ONE _exchange_route
+    derivation warm also uses; the per-dispatch
+    knn.exchange_route.<route> counter records the exchange that actually
+    ran (the adaptive Pallas route never passes through here, so it can
+    never be misattributed to an exchange)."""
+    n_dev = mesh.shape[DATA_AXIS]
+    route = _exchange_route(mesh, qd.shape[0])
+    profiling.incr_counter(f"knn.exchange_route.{route}")
+    if route in ("local", "legacy"):
+        return _cached_kernel(
+            "knn_block", knn_block_kernel,
+            items, item_norm, item_pos, valid, qd, mesh=mesh, k=k,
+            tile_budget=_TILE_BUDGET, collect_budget=_COLLECT_MERGE_BUDGET,
+        )
+    chunk, qt = _exchange_geometry(
+        items.shape[0] // n_dev, qd.shape[0], n_dev, route
+    )
+    if route == "ring":
+        from ..parallel.mesh import data_sharding
+
+        # commit the block to the row sharding the compiled executable
+        # expects (the warm path submits a P(DATA_AXIS) aval)
+        qd = jax.device_put(qd, data_sharding(mesh))
+        return _cached_kernel(
+            "knn_ring", knn_block_kernel_exchange,
+            items, item_norm, item_pos, valid, qd,
+            mesh=mesh, k=k, route="ring", chunk=chunk, qt=qt,
+        )
+    return _cached_kernel(
+        "knn_gather", knn_block_kernel_exchange,
+        items, item_norm, item_pos, valid, qd,
+        mesh=mesh, k=k, route="gather", chunk=chunk, qt=qt,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -486,12 +799,15 @@ def _adaptive_candidates_sharded(items, item_norm, item_pos, valid, queries, mes
     holds, and multi-chip meshes still win from sharding the matmuls."""
 
     def per_shard(items_loc, x_norm, pos_loc, valid_loc, q):
+        from ..parallel.exchange import device_collective
+
         cand_v, cand_i = _candidates_scan(
             items_loc, x_norm, pos_loc, valid_loc, q, k, chunk
         )
         Q = q.shape[0]
-        all_v = jax.lax.all_gather(cand_v, DATA_AXIS)
-        all_i = jax.lax.all_gather(cand_i, DATA_AXIS)
+        sec = device_collective("knn.cand_pool")
+        all_v = sec.gather_stack(cand_v, DATA_AXIS)
+        all_i = sec.gather_stack(cand_i, DATA_AXIS)
         return (
             jnp.moveaxis(all_v, 0, 1).reshape(Q, -1),
             jnp.moveaxis(all_i, 0, 1).reshape(Q, -1),
@@ -635,15 +951,22 @@ def _adaptive_count(items, item_norm, valid, queries, thresh, mesh, chunk):
     )(items, item_norm, valid, queries, thresh)
 
 
-def _adaptive_pallas_phases(items, item_norm, valid, qd, k, m, n_items):
+def _adaptive_pallas_phases(items, item_norm, valid, qd, k, m, n_items,
+                            fused=False):
     """candidates -> self-verified merge on the pallas kernel — the ONE
     definition of the pallas-route phase sequence, dispatched either as
-    separate jits or fused under one (below).  Verification reads the
-    pool's per-group m-th kept values (_adaptive_merge_self) instead of
-    re-scanning the item set; SRML_KNN_AUDIT_COUNT=1 restores the global
-    count scan (knn_count_pallas) for auditing the flag against ground
-    truth."""
-    from .pallas_knn import knn_candidates_pallas
+    separate jits or fused under one (below).  `fused=True` routes through
+    the FUSED merge epilogue (pallas_knn.knn_fused_pallas): the candidates
+    kernel's pool feeds a second Pallas kernel that emits the final
+    per-block (distance, position, flag) arrays in one pass over the
+    VMEM-resident pool — no XLA transpose slab, no sort-shaped merge, the
+    structural fix for the knn.collect spread named by BENCH_r05's
+    attribution.  `fused=False` keeps the XLA merge (_adaptive_merge_self),
+    which is also the fallback for pools past the fused VMEM budget.
+    Verification reads the pool's per-group m-th kept values either way;
+    SRML_KNN_AUDIT_COUNT=1 restores the global count scan
+    (knn_count_pallas) for auditing the flag against ground truth."""
+    from .pallas_knn import knn_candidates_pallas, knn_fused_pallas
 
     if _audit_count_enabled():
         from .pallas_knn import knn_count_pallas
@@ -657,6 +980,8 @@ def _adaptive_pallas_phases(items, item_norm, valid, qd, k, m, n_items):
         fv, fpos, tu, sg = _adaptive_merge(cv, ci, k)
         sa = knn_count_pallas(items, item_norm, valid, qd, tu, n_items)
         return _neg_to_dist(fv), fpos, sg, sa
+    if fused:
+        return knn_fused_pallas(items, item_norm, valid, qd, k, m, n_items)
     cv, ci = knn_candidates_pallas(items, item_norm, valid, qd, k, m, n_items)
     return _adaptive_merge_self(cv, ci, k, m=m)
 
@@ -667,24 +992,42 @@ def _audit_count_enabled() -> bool:
     return os.environ.get("SRML_KNN_AUDIT_COUNT", "") == "1"
 
 
+_FUSED_EPILOGUE_ENV = "SRML_KNN_FUSED_EPILOGUE"
+
+
+def _fused_epilogue_route(n_al: int, m: int) -> bool:
+    """Whether the pallas route takes the fused merge epilogue at this
+    aligned item count — ONE derivation shared by dispatch and warm (the
+    flag is a cache-key static, so the two must always agree).
+    SRML_KNN_FUSED_EPILOGUE=0 pins the XLA merge for A/B comparison."""
+    import os
+
+    from .pallas_knn import knn_fused_eligible
+
+    if os.environ.get(_FUSED_EPILOGUE_ENV, "1") == "0":
+        return False
+    return knn_fused_eligible(n_al, m)
+
+
 # audit-route shim: the self-verify merge emits euclidean distances on
 # device; the audit merge keeps negated-d2 (its threshold feeds the count
 # kernel), so its first output converts here to keep ONE dispatch contract
 _neg_to_dist = jax.jit(lambda fv: jnp.sqrt(jnp.maximum(-fv, 0.0)))
 
 
-# Single-dispatch variant: candidates -> self-verified merge as ONE jit.
-# With the count scan gone this wins (or ties) in BOTH regimes: in the
-# latency-bound regime (small item sets like UMAP's 50k self-join) it
-# halves per-block dispatch round-trips through the tunneled device
-# (hardware A/B: 5.4 s -> 4.7 s per UMAP fit), and in the compute-bound
-# regime it lets XLA overlap the pool transpose/merge with the kernel
-# epilogue (400k x 3000 block: 0.59 s separate -> 0.54 s fused; the OLD
-# three-phase program with the count kernel scheduled worse fused, 2.2 s
-# -> 3.0 s, which is why a size gate used to exist here).  Audit mode
-# (SRML_KNN_AUDIT_COUNT) keeps the separate dispatches.
+# Single-dispatch variant: candidates -> merge as ONE jit.  With the count
+# scan gone this wins (or ties) in BOTH regimes: in the latency-bound
+# regime (small item sets like UMAP's 50k self-join) it halves per-block
+# dispatch round-trips through the tunneled device (hardware A/B: 5.4 s ->
+# 4.7 s per UMAP fit), and in the compute-bound regime it lets XLA overlap
+# the merge with the kernel epilogue.  The `fused` static selects the
+# FUSED Pallas merge epilogue (the default whenever the pool fits the
+# fused kernel's VMEM budget) vs the XLA merge — it is part of the cache
+# key, so toggling SRML_KNN_FUSED_EPILOGUE can never reuse a stale
+# executable.  Audit mode (SRML_KNN_AUDIT_COUNT) keeps the separate
+# dispatches.
 _adaptive_dispatch_fused = partial(
-    jax.jit, static_argnames=("k", "m", "n_items")
+    jax.jit, static_argnames=("k", "m", "n_items", "fused")
 )(_adaptive_pallas_phases)
 
 
@@ -693,8 +1036,9 @@ def _adaptive_plan(n_pad: int, d_al: int, q_rows: int, mesh: Mesh, k: int,
     """Route + geometry the adaptive dispatch at these shapes will take —
     ONE derivation shared by knn_block_adaptive_dispatch and the AOT warm
     path (warm_search_kernels), so a warmed executable is always the one
-    the dispatch later runs.  Returns ("pallas", m) for the fused Pallas
-    kernel or ("scan", clamped_chunk, m) for the XLA candidates scan."""
+    the dispatch later runs.  Returns ("pallas", m, fused) for the Pallas
+    kernel (fused = the merge epilogue runs in-kernel too) or
+    ("scan", clamped_chunk, m) for the XLA candidates scan."""
     from .pallas_knn import pallas_knn_eligible
 
     n_shards = max(1, mesh.shape[DATA_AXIS])
@@ -710,7 +1054,7 @@ def _adaptive_plan(n_pad: int, d_al: int, q_rows: int, mesh: Mesh, k: int,
     if pallas_knn_eligible(n_shards, d_al, q_rows):
         m = _select_m(k, 1024, n_pad)
         if m <= _ADAPTIVE_MAX_M:
-            return ("pallas", m)
+            return ("pallas", m, _fused_epilogue_route(n_pad, m))
     # per-shard row count; chunk never wider than the shard (the scan's
     # dynamic_slice has static size, so an over-wide chunk would be a
     # lowering error rather than a clamp)
@@ -757,10 +1101,13 @@ def knn_block_adaptive_dispatch(
             return _adaptive_pallas_phases(
                 items, item_norm, valid, qd, k=k, m=m, n_items=n_pad
             )
-        # the default self-verify route fuses everything into one jit
+        # the default self-verify route fuses everything into one jit; the
+        # merge epilogue is the fused Pallas kernel whenever the pool fits
+        # its VMEM budget (plan[2] — derived once, shared with warm)
         return _cached_kernel(
             "knn_fused", _adaptive_dispatch_fused,
             items, item_norm, valid, qd, k=k, m=m, n_items=n_pad,
+            fused=plan[2],
         )
     _, chunk, m = plan
     cv, ci = _adaptive_candidates(
@@ -796,10 +1143,8 @@ def knn_block_adaptive_collect(
         b = shape_bucket(fail.size)
         qf = np.zeros((b, qd.shape[1]), dtype=qd.dtype)
         qf[: fail.size] = np.asarray(qd)[fail]
-        d_f, p_f = _cached_kernel(
-            "knn_block", knn_block_kernel,
-            items, item_norm, item_pos, valid, jnp.asarray(qf),
-            mesh=mesh, k=k,
+        d_f, p_f = _exact_block_search(
+            items, item_norm, item_pos, valid, jnp.asarray(qf), mesh, k
         )
         d_out[fail] = np.asarray(d_f)[: fail.size]
         p_out[fail] = np.asarray(p_f)[: fail.size]
@@ -1173,13 +1518,37 @@ def distributed_kneighbors(
     identical to what a single-process knn_search over the concatenated
     data would give those rows.
 
-    Protocol (two control-plane rounds, binary frames —
-    parallel/exchange.py):
-      round 1: every rank broadcasts its concatenated query block + item
-               count as ONE length-prefixed binary frame
-               (exchange.allgather_bytes).  Queries are broadcast — the
-               reference ships query partitions to every index worker the
-               same way — while items, the big side, stay put.
+    Protocol (binary frames — parallel/exchange.py): one tiny METADATA
+    allgather first (per-rank query rows, item count, feature dim, and a
+    ring-capability flag), then one of two routes, chosen GLOBALLY from the
+    gathered metadata so every rank runs the same collective sequence:
+
+    ring route (default, SRML_KNN_EXCHANGE=ring, when every rank's item
+    set fits its device budget): the host-plane shape of the in-mesh ring
+    permute.  Each rank searches its OWN query block locally, then the
+    (query block, running candidates) frame rotates rank -> rank+1 for
+    nranks hops (exchange.ring_pass_bytes): each hop the receiving rank
+    scans the visiting block against its RESIDENT items and merges into
+    the block's traveling top-k (native.topk_merge).  Queries are never
+    broadcast — each rank only ever decodes its predecessor's frame — and
+    candidate frames are p2p-shaped and binary by construction.
+
+    Transport economics, stated honestly: per-rank DECODE volume (the
+    measured round-4 bottleneck on string planes — base64 + join +
+    unpack) is O(one neighbor's frame) per hop, nranks x below the
+    broadcast protocol's.  Raw WIRE bytes go the other way on planes
+    whose only collective is a broadcast allGather (Spark RPC): every
+    hop's frames reach every rank, ~nranks x the allgather protocol's
+    wire total.  On a transport that is genuinely p2p (or
+    bandwidth-bound RPC where wire dominates decode),
+    SRML_KNN_EXCHANGE=gather pins the broadcast protocol.
+
+    allgather route (fallback: a rank's items exceed its device budget —
+    streaming items once over ALL queries beats restreaming per hop — or
+    SRML_KNN_EXCHANGE != ring):
+      round 1: every rank broadcasts its concatenated query block
+               (exchange.allgather_bytes) — the reference ships query
+               partitions to every index worker the same way.
       local:   each rank streams its item partitions into device-resident
                blocks (HBM-budgeted) and computes exact top-k of the GLOBAL
                query set via the block kernels above.
@@ -1192,11 +1561,11 @@ def distributed_kneighbors(
                full-matrix broadcast it replaced.  The owner merges the
                nranks sorted lists (native.topk_merge) and emits them per
                input partition.
-    Both rounds chunk payloads under the transport's per-message frame
+    All rounds chunk payloads under the transport's per-message frame
     limit; bytes-capable planes (shared-FS, local) skip base64 entirely.
 
-    Every rank must call this (a rank with zero rows still joins both
-    gathers — bailing out would hang the barrier)."""
+    Every rank must call this (a rank with zero rows still joins every
+    collective — bailing out would hang the barrier)."""
     from .. import native
     from ..parallel.exchange import (
         allgather_bytes, alltoall_bytes, pack_arrays, unpack_arrays,
@@ -1235,27 +1604,42 @@ def distributed_kneighbors(
     )
     n_items_loc = int(sum(np.asarray(f).shape[0] for f, _ in item_parts))
 
-    frames = allgather_bytes(
-        control_plane,
-        pack_arrays([q_cat, np.array([n_items_loc], np.int64)]),
+    # metadata round: per-rank query rows / item count / dims / ring
+    # capability — the ROUTE must be decided identically on every rank
+    # BEFORE the first data collective, or the barrier desyncs.  A rank can
+    # ring only if its whole local item set fits its device budget (ring
+    # re-scans resident items once per visiting block; out-of-core sets
+    # would restream per hop, where the one-pass allgather route wins).
+    d_q = int(q_cat.shape[1]) if q_cat.shape[0] else -1
+    d_i = -1
+    for f, _ in item_parts:
+        f = np.asarray(f)
+        if f.ndim == 2:
+            d_i = int(f.shape[1])
+            break
+    est_bytes = n_items_loc * max(d_i, 0) * np.dtype(dtype).itemsize
+    ring_ok = int(
+        _exchange_env() == "ring"
+        and nranks > 1
+        and est_bytes
+        <= _hbm_budget_bytes() * max(1, mesh.shape[DATA_AXIS])
     )
-    blocks, item_counts = [], []
-    for fr in frames:  # allGather returns rank order
-        qb, ni = unpack_arrays(fr)
-        blocks.append(qb)
-        item_counts.append(int(ni[0]))
-    total_items = sum(item_counts)
-    dims = {b.shape[1] for b in blocks if b.shape[0]}
+    meta = np.array(
+        [q_cat.shape[0], n_items_loc, d_q, d_i, ring_ok], np.int64
+    )
+    metas = [
+        unpack_arrays(fr)[0]
+        for fr in allgather_bytes(control_plane, pack_arrays([meta]))
+    ]
+    q_counts = [int(m[0]) for m in metas]
+    item_counts = [int(m[1]) for m in metas]
+    dims = {int(m[2]) for m in metas if int(m[2]) >= 0}
     if len(dims) > 1:
         raise ValueError(f"ranks disagree on query dimensionality: {sorted(dims)}")
-    D = dims.pop() if dims else (
-        np.asarray(item_parts[0][0]).shape[1] if item_parts else 0
-    )
-    blocks = [
-        b if b.shape[0] else np.zeros((0, D), dtype=dtype) for b in blocks
-    ]
-    offs = np.cumsum([0] + [b.shape[0] for b in blocks])
-    q_total = int(offs[-1])
+    item_dims = {int(m[3]) for m in metas if int(m[3]) >= 0}
+    D = dims.pop() if dims else (item_dims.pop() if item_dims else 0)
+    total_items = sum(item_counts)
+    q_total = sum(q_counts)
     k_eff = min(k, total_items)
 
     def _empty_results():
@@ -1266,8 +1650,27 @@ def distributed_kneighbors(
 
     if q_total == 0 or total_items == 0:
         # consistent across ranks (both counts are globally agreed), so
-        # skipping round 2 everywhere cannot desync the barrier
+        # skipping the data rounds everywhere cannot desync the barrier
         return _empty_results()
+
+    # record the COLLECTIVE decision (not the env preference): a single
+    # out-of-core rank flips every rank to the allgather protocol, and the
+    # counter must say what actually ran
+    if all(int(m[4]) for m in metas):
+        profiling.incr_counter("knn.exchange_route.dist_ring")
+        return _distributed_ring(
+            control_plane, rank, nranks, q_cat, q_rows, item_parts,
+            n_items_loc, D, k, k_eff, mesh, dtype,
+        )
+    profiling.incr_counter("knn.exchange_route.dist_allgather")
+
+    # allgather route: round 1 broadcasts every rank's query block
+    frames = allgather_bytes(control_plane, pack_arrays([q_cat]))
+    blocks = [unpack_arrays(fr)[0] for fr in frames]  # rank order
+    blocks = [
+        b if b.shape[0] else np.zeros((0, D), dtype=dtype) for b in blocks
+    ]
+    offs = np.cumsum([0] + [b.shape[0] for b in blocks])
     q_global = np.concatenate(blocks) if len(blocks) > 1 else blocks[0]
 
     if n_items_loc:
@@ -1320,6 +1723,75 @@ def distributed_kneighbors(
     out, at = [], 0
     for r in q_rows:
         out.append((best_d[at : at + r, :k_eff], best_i[at : at + r, :k_eff]))
+        at += r
+    return out
+
+
+def _distributed_ring(
+    control_plane, rank, nranks, q_cat, q_rows, item_parts,
+    n_items_loc, D, k, k_eff, mesh, dtype,
+):
+    """Ring route of distributed_kneighbors (docstring there): the (query
+    block, running candidates) frame rotates rank -> rank+1 for nranks
+    hops; each hop the receiving rank scans the visiting block against its
+    RESIDENT item blocks and merges into the block's traveling top-k.  The
+    last rotation delivers every block home, so no result scatter round is
+    needed.  COLLECTIVE: exactly nranks ring_pass_bytes calls per rank,
+    empty blocks included."""
+    from .. import native
+    from ..parallel.exchange import pack_arrays, ring_pass_bytes, unpack_arrays
+
+    def _parts():
+        for f, i in item_parts:
+            f = np.asarray(f, dtype=dtype)
+            if f.shape[0]:
+                yield f, np.asarray(i, np.int64)
+
+    # resident index: the ring capability flag guaranteed the estimate fits
+    # the device budget, so every packed block stays staged for all hops
+    blocks = (
+        list(iter_prepared_item_blocks(_parts(), mesh, dtype))
+        if n_items_loc
+        else []
+    )
+
+    def _search(qb):
+        best = None
+        for prepared in blocks:
+            d, i = knn_search_prepared(prepared, qb, k, mesh)
+            d, i = _pad_topk_to_k(d.astype(np.float32, copy=False), i, k)
+            best = (
+                (d, i)
+                if best is None
+                else native.topk_merge(best[0], best[1], d, i)
+            )
+        return best
+
+    qb = q_cat if q_cat.shape[0] else np.zeros((0, D), dtype=dtype)
+    best = _search(qb) if qb.shape[0] and blocks else None
+    if best is None:
+        best = (
+            np.full((qb.shape[0], k), np.inf, np.float32),
+            np.full((qb.shape[0], k), -1, np.int64),
+        )
+    d_cur, i_cur = best
+    for hop in range(nranks):
+        payload = pack_arrays([qb, d_cur, i_cur])
+        got = ring_pass_bytes(control_plane, rank, nranks, payload)
+        qb, d_cur, i_cur = unpack_arrays(got)
+        qb = qb.astype(dtype, copy=False)
+        if hop < nranks - 1 and qb.shape[0] and blocks:
+            d_new, i_new = _search(qb)
+            d_cur, i_cur = native.topk_merge(d_cur, i_cur, d_new, i_new)
+    # nranks rotations = identity: d_cur/i_cur hold THIS rank's queries
+    out, at = [], 0
+    for r in q_rows:
+        out.append(
+            (
+                d_cur[at : at + r, :k_eff].astype(dtype, copy=False),
+                i_cur[at : at + r, :k_eff],
+            )
+        )
         at += r
     return out
 
@@ -1437,10 +1909,9 @@ def knn_search_prepared(
                 )
                 qf = np.zeros((shape_bucket(rows.size), q.shape[1]), dtype=dtype)
                 qf[: rows.size] = q[rows]
-                d_f, p_f = _cached_kernel(
-                    "knn_block", knn_block_kernel,
+                d_f, p_f = _exact_block_search(
                     prepared.items, prepared.norm, prepared.pos,
-                    prepared.valid, jnp.asarray(qf), mesh=mesh, k=k,
+                    prepared.valid, jnp.asarray(qf), mesh, k,
                 )
                 d_f = np.asarray(d_f)[: rows.size]
                 ids_f = prepared.ids[np.asarray(p_f)[: rows.size]]
@@ -1467,13 +1938,13 @@ def knn_search_prepared(
         start = starts[bi]
         qb = q[start : start + block]
         n_q = qb.shape[0]
-        d, pos = _cached_kernel(
-            "knn_block", knn_block_kernel,
+        # the routed exchange kernel: ring permute by default on multi-shard
+        # meshes (SRML_KNN_EXCHANGE), the legacy all-gather block kernel on
+        # single shards — budgets read at call time inside the local route
+        # so tests can shrink them to exercise the multi-chunk branches
+        d, pos = _exact_block_search(
             prepared.items, prepared.norm, prepared.pos, prepared.valid,
-            jnp.asarray(_pad_block(qb, n_q)), mesh=mesh, k=k,
-            # read at call time so tests can shrink the budgets to exercise
-            # the multi-chunk and running-merge branches
-            tile_budget=_TILE_BUDGET, collect_budget=_COLLECT_MERGE_BUDGET,
+            jnp.asarray(_pad_block(qb, n_q)), mesh, k,
         )
         for h in (d, pos):
             try:
@@ -1546,7 +2017,7 @@ def warm_search_kernels(
         if plan[0] == "pallas":
             m = plan[1]
             args = (prepared.items, prepared.norm, prepared.valid, q_aval)
-            statics = dict(k=k, m=m, n_items=n_pad)
+            statics = dict(k=k, m=m, n_items=n_pad, fused=plan[2])
             key = _kernel_cache_key("knn_fused", args, None, statics)
             pc.submit(key, _adaptive_dispatch_fused, *args, **statics)
             keys.append(key)
@@ -1589,14 +2060,43 @@ def warm_search_kernels(
             pc.submit(mkey, _adaptive_merge_self, *margs, **mstatics)
             keys.append(mkey)
         return keys
-    q_aval = aval((block, d_query or d_al), dtype)
+    # exact route: warm the kernel the routed dispatch (_exact_block_search)
+    # will actually run.  NOTE the adaptive path above pads queries to the
+    # item width, but the exact route dispatches the UNPADDED query block
+    # (knn_block_kernel_exchange pads inside the jit) — d_query is the
+    # dispatch-time width.
+    route = _exchange_route(mesh, block)
+    q_shape = (block, d_query or d_al)
+    if route in ("local", "legacy"):
+        q_aval = aval(q_shape, dtype)
+        args = (
+            prepared.items, prepared.norm, prepared.pos, prepared.valid,
+            q_aval,
+        )
+        statics = dict(
+            k=k, tile_budget=_TILE_BUDGET, collect_budget=_COLLECT_MERGE_BUDGET
+        )
+        key = _kernel_cache_key("knn_block", args, mesh, statics)
+        pc.submit(key, knn_block_kernel, *args, mesh=mesh, **statics)
+        keys.append(key)
+        return keys
+    chunk, qt = _exchange_geometry(n_pad // n_shards, block, n_shards, route)
+    if route == "ring":
+        from jax.sharding import NamedSharding
+
+        # the dispatch path commits ring query blocks to the row sharding;
+        # the warmed executable must be compiled for that placement
+        q_aval = jax.ShapeDtypeStruct(
+            q_shape, np.dtype(dtype), sharding=NamedSharding(mesh, P(DATA_AXIS))
+        )
+    else:
+        q_aval = aval(q_shape, dtype)
     args = (
         prepared.items, prepared.norm, prepared.pos, prepared.valid, q_aval,
     )
-    statics = dict(
-        k=k, tile_budget=_TILE_BUDGET, collect_budget=_COLLECT_MERGE_BUDGET
-    )
-    key = _kernel_cache_key("knn_block", args, mesh, statics)
-    pc.submit(key, knn_block_kernel, *args, mesh=mesh, **statics)
+    name = "knn_ring" if route == "ring" else "knn_gather"
+    statics = dict(k=k, route=route, chunk=chunk, qt=qt)
+    key = _kernel_cache_key(name, args, mesh, statics)
+    pc.submit(key, knn_block_kernel_exchange, *args, mesh=mesh, **statics)
     keys.append(key)
     return keys

@@ -331,34 +331,24 @@ def _knn_count_kernel(
         out_ref[:] += cnt[:, None]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "k", "m", "n_items", "interpret", "tile_q", "tile_i", "tile_d",
-        "legacy",
-    ),
-)
-def knn_candidates_pallas(
-    items: jax.Array,       # (N_pad, D) f32, device-resident
-    item_norm: jax.Array,   # (N_pad,) f32 squared norms
-    valid: jax.Array,       # (N_pad,) bool
-    queries: jax.Array,     # (Q, D) f32
-    k: int,
+def _candidates_pool(
+    items: jax.Array,
+    item_norm: jax.Array,
+    valid: jax.Array,
+    queries: jax.Array,
     m: int,
-    n_items: int,           # static: N_pad (cols past it are masked)
-    interpret: bool = False,
-    tile_q: int = _TILE_Q,
-    tile_i: int = _TILE_I,
-    tile_d: int = 0,  # 0 = route default (legacy 512, qres cap 3072)
-    legacy: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
-    """Per-group top-m candidate pool for every query: returns
-    (values (Q, ng*m) negated squared distances, positions (Q, ng*m) int32
-    into the padded item set), ready for ops.knn._adaptive_merge_self with
-    stride=m.  The kernel stores m_pad = round_up(m, 8) rows per group to
-    satisfy the f32/int32 min-tile; the wrapper's transpose drops the
-    padding rows so the downstream merge sort never pays for them (44% of
-    the pool at the bench shape's m=9)."""
+    n_items: int,
+    interpret: bool,
+    tile_q: int,
+    tile_i: int,
+    tile_d: int,
+    legacy: bool,
+):
+    """The candidates pallas_call shared by knn_candidates_pallas (which
+    transposes the pool to the (Q, ng*m) merge layout) and knn_fused_pallas
+    (which keeps the pool in its native (ng, m_pad, q_pad) layout and feeds
+    it straight into the fused merge kernel — no transpose ever
+    materializes in HBM).  Returns (vals, idxs, (ng, m_pad, q_pad, tq))."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -483,10 +473,189 @@ def knn_candidates_pallas(
             ),
             interpret=interpret,
         )(qn, inorm, qp, items)
+    return vals, idxs, (ng, m_pad, q_pad, tq)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "m", "n_items", "interpret", "tile_q", "tile_i", "tile_d",
+        "legacy",
+    ),
+)
+def knn_candidates_pallas(
+    items: jax.Array,       # (N_pad, D) f32, device-resident
+    item_norm: jax.Array,   # (N_pad,) f32 squared norms
+    valid: jax.Array,       # (N_pad,) bool
+    queries: jax.Array,     # (Q, D) f32
+    k: int,
+    m: int,
+    n_items: int,           # static: N_pad (cols past it are masked)
+    interpret: bool = False,
+    tile_q: int = _TILE_Q,
+    tile_i: int = _TILE_I,
+    tile_d: int = 0,  # 0 = route default (legacy 512, qres cap 3072)
+    legacy: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-group top-m candidate pool for every query: returns
+    (values (Q, ng*m) negated squared distances, positions (Q, ng*m) int32
+    into the padded item set), ready for ops.knn._adaptive_merge_self with
+    stride=m.  The kernel stores m_pad = round_up(m, 8) rows per group to
+    satisfy the f32/int32 min-tile; the wrapper's transpose drops the
+    padding rows so the downstream merge sort never pays for them (44% of
+    the pool at the bench shape's m=9)."""
+    Q = queries.shape[0]
+    vals, idxs, (ng, _m_pad, q_pad, _tq) = _candidates_pool(
+        items, item_norm, valid, queries, m, n_items, interpret,
+        tile_q, tile_i, tile_d, legacy,
+    )
     # (ng, m_pad, q_pad) -> compact (Q, ng*m) pool layout for the merge
     cand_v = jnp.transpose(vals[:, :m], (2, 0, 1)).reshape(q_pad, ng * m)[:Q]
     cand_i = jnp.transpose(idxs[:, :m], (2, 0, 1)).reshape(q_pad, ng * m)[:Q]
     return cand_v, cand_i
+
+
+# -- fused merge epilogue ------------------------------------------------------
+# The candidates kernel's (ng, m_pad, q_pad) pool used to flow through an
+# XLA transpose + grouped top-k + flag pass (_adaptive_merge_self): a second
+# full HBM materialization of the pool, a sort-shaped selection, and the
+# epilogue BENCH_r05's spread attribution pinned as the kNN arm's 26%
+# "knn.collect" culprit.  The fused merge kernel below consumes the pool in
+# its NATIVE layout — one (ng, m_pad, tq) VMEM block per query tile — and
+# emits the FINAL per-block (distance, position, self-verify flag) arrays,
+# so the only thing left for the host is the id map: no transpose slab, no
+# XLA merge, one kernel boundary fewer.
+#
+# Selection contract: lexicographic (-d2, pos) — the pool's column order is
+# position-increasing within equal values by construction (groups are
+# position-base-ordered and _select_topm_store's argmax keeps ties in
+# first-occurrence order), so the k iterated first-occurrence argmax passes
+# return the UNIQUE lex top-k of the pool.  That makes the fused route's
+# output deterministic under any pool partitioning — the same total-order
+# property the ANN engine's mesh-parity gate rides — and testable against a
+# plain numpy lexsort oracle (tests/test_pallas.py).
+
+# pool-block VMEM budget for the fused merge kernel: the (ng, m_pad, tq)
+# f32+i32 blocks plus the selection temporaries must fit the scoped budget;
+# beyond it the route falls back to the XLA merge (knn_fused_eligible).
+_FUSED_POOL_BUDGET = 48 << 20
+
+
+def _knn_fused_merge_kernel(
+    pool_v_ref, pool_i_ref, dist_ref, pos_ref, flag_ref,
+    *, k: int, m: int, m_pad: int, ng: int, tq: int, k_pad: int,
+):
+    """Merge one query tile's pool: k iterated (argmax, max, one-hot
+    position read, mask) passes over the VMEM-resident (ng*m_pad, tq) pool
+    view — first-occurrence argmax IS the lex (-d2, pos) order (header).
+    Also computes the self-verify overflow flag in-kernel: a group whose
+    m-th kept value beats the margined k-th threshold might have overflowed
+    (same contract as ops/knn._adaptive_merge_self)."""
+    C = ng * m_pad
+    v = pool_v_ref[:].reshape(C, tq)
+    pidx = pool_i_ref[:].reshape(C, tq)
+    iota0 = jax.lax.broadcasted_iota(jnp.int32, (C, tq), 0)
+    vals, poss = [], []
+    for _ in range(k):
+        am = jnp.argmax(v, axis=0).astype(jnp.int32)  # (tq,)
+        vals.append(jnp.max(v, axis=0))
+        sel = iota0 == am[None, :]
+        # one-hot read: exactly one pool row selected per query column
+        poss.append(jnp.where(sel, pidx, 0).sum(axis=0).astype(jnp.int32))
+        v = jnp.where(sel, -jnp.inf, v)
+    fv = jnp.stack(vals)   # (k, tq) negated d2, descending
+    fp = jnp.stack(poss)   # (k, tq)
+    # margined threshold + per-group overflow flag (ops/knn._merge_pool's
+    # delta contract: entries within ~8 ulps of the kth value are
+    # computational ties, excluded from the must-be-present set)
+    t = fv[k - 1]
+    delta = jnp.abs(t) * 1e-6 + 1e-30
+    tu = jnp.where(jnp.isfinite(t), t + delta, t)
+    worst_kept = pool_v_ref[:, m - 1, :].reshape(ng, tq)
+    flags = (worst_kept > tu[None, :]).any(axis=0).astype(jnp.int32)
+    dist = jnp.sqrt(jnp.maximum(-fv, 0.0))
+    if k_pad > k:
+        dist = jnp.concatenate(
+            [dist, jnp.full((k_pad - k, tq), jnp.inf, jnp.float32)]
+        )
+        fp = jnp.concatenate([fp, jnp.zeros((k_pad - k, tq), jnp.int32)])
+    dist_ref[:] = dist.T   # (tq, k_pad): lane-aligned store
+    pos_ref[:] = fp.T
+    flag_ref[:] = flags[:, None]
+
+
+def knn_fused_eligible(n_al: int, m: int, tile_i: int = _TILE_I,
+                       tile_q: int = _TILE_Q) -> bool:
+    """Whether the fused merge's pool block fits the VMEM budget at this
+    aligned item count (ng = n_al / tile_i groups of m_pad kept rows)."""
+    ng = n_al // tile_i
+    m_pad = _round_up(m, 8)
+    return ng * m_pad * tile_q * 8 <= _FUSED_POOL_BUDGET
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "m", "n_items", "interpret", "tile_q", "tile_i", "tile_d",
+    ),
+)
+def knn_fused_pallas(
+    items: jax.Array,       # (N_pad, D) f32, device-resident
+    item_norm: jax.Array,   # (N_pad,) f32 squared norms
+    valid: jax.Array,       # (N_pad,) bool
+    queries: jax.Array,     # (Q, D) f32
+    k: int,
+    m: int,
+    n_items: int,
+    interpret: bool = False,
+    tile_q: int = _TILE_Q,
+    tile_i: int = _TILE_I,
+    tile_d: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Device-complete fused route: candidates kernel -> fused merge kernel,
+    both Pallas, one jit.  Returns (distances (Q, k) ascending euclidean,
+    positions (Q, k) int32, flags (Q,) int32, zeros (Q,) int32) — the exact
+    dispatch contract of ops/knn._adaptive_merge_self, so the collect /
+    fallback machinery is route-agnostic.  Rows with flags != 0 need the
+    exact per-row rerun (possible group overflow), same as ever."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Q = queries.shape[0]
+    vals, idxs, (ng, m_pad, q_pad, tq) = _candidates_pool(
+        items, item_norm, valid, queries, m, n_items, interpret,
+        tile_q, tile_i, tile_d, legacy=False,
+    )
+    k_pad = _round_up(k, 128)
+    dist, pos, flags = pl.pallas_call(
+        functools.partial(
+            _knn_fused_merge_kernel,
+            k=k, m=m, m_pad=m_pad, ng=ng, tq=tq, k_pad=k_pad,
+        ),
+        grid=(q_pad // tq,),
+        in_specs=[
+            pl.BlockSpec((ng, m_pad, tq), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, m_pad, tq), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tq, k_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tq, k_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tq, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, k_pad), jnp.int32),
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        ],
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=100 << 20),
+        interpret=interpret,
+    )(vals, idxs)
+    zeros = jnp.zeros((Q,), jnp.int32)
+    return dist[:Q, :k], pos[:Q, :k], flags[:Q, 0], zeros
 
 
 @functools.partial(jax.jit, static_argnames=("n_items", "interpret"))

@@ -593,7 +593,9 @@ def _layout_step_sharded(
     matches umap-learn's both-directions + move_other firing accounting
     (see the reference layout's history).  Edge firing draws are counter-
     based threefry over GLOBAL grid positions — mesh-shape independent."""
-    from ..parallel.exchange import allgather_rows
+    from ..parallel.exchange import device_collective
+
+    _layout_sec = device_collective("umap.layout_rows")
 
     n_pad, c = emb.shape
     M = table_size
@@ -646,7 +648,9 @@ def _layout_step_sharded(
                 g_rep = jnp.clip(rep * dnj, -4.0, 4.0).sum(axis=0)
                 new_cols.append(cj + alpha * (upd + scale * g_rep))
             new_loc = jnp.stack(new_cols, axis=1)        # (n_loc, c)
-            return allgather_rows(new_loc), None
+            # typed exchange section: uniform exchange.umap.layout_rows.*
+            # counters (the per-epoch embedding rebuild collective)
+            return _layout_sec.allgather_rows(new_loc), None
 
         emb_out, _ = jax.lax.scan(epoch, emb, e0 + jnp.arange(block))
         return emb_out

@@ -192,30 +192,186 @@ def allgather_bytes(
         return [b"".join(p) for p in parts]
 
 
-# -- device-side collectives ---------------------------------------------------
+# -- device-side collectives: typed sections -----------------------------------
 # The helpers above move HOST bytes over whatever allGather the cluster
-# control plane offers.  allgather_rows is their IN-MESH analog for code
-# running inside shard_map bodies (a jax collective over ICI/DCN): the UMAP
-# layout engine combines per-device head-block updates with one tiled
-# all-gather per epoch, the same "partial result per rank -> full result
-# everywhere" shape allgather_bytes gives the host planes.  Kept here so
-# every exchange primitive — host or device — lives in one module.
+# control plane offers.  DeviceSection is their IN-MESH analog for code
+# running inside shard_map bodies (jax collectives over ICI/DCN), as TYPED
+# SECTIONS: every engine names its call site (`device_collective("umap.
+# layout_rows")`, `device_collective("knn.ring_q")`, ...) and gets the same
+# uniform `exchange.<name>.bytes/traces` counters regardless of which idiom
+# moved the data — the consolidated comms layer of ROADMAP item 5.  The
+# legacy module-level functions (allgather_rows/psum_parts/psum_merge_parts)
+# remain as un-named-section shims over the same implementations.
+#
+# ring_shift is the one NEW idiom: a +shift neighbor permute along the mesh
+# ring.  On TPU hardware it lowers to a Pallas `pltpu.make_async_remote_copy`
+# kernel (neighbor-to-neighbor ICI DMA, the SNIPPETS.md exemplar) — the ONLY
+# module allowed to touch the remote-DMA API (graftlint R8).  Every other
+# backend (XLA:CPU meshes, interpret mode, remote-DMA disabled via
+# SRML_EXCHANGE_REMOTE_DMA=0) takes the identical-semantics lax.ppermute
+# fallback, which is what the tier-1 parity gates run everywhere.
 
 
-def allgather_rows(x, axis_name: str = None):
-    """Concatenate per-device row blocks along axis 0 (lax.all_gather,
-    tiled).  Call ONLY inside a shard_map body bound over `axis_name`."""
+class DeviceSection:
+    """Typed handle for one named in-mesh collective section.  Construct
+    via device_collective(name); every method must be called ONLY inside a
+    shard_map body bound over `axis_name` (default DATA_AXIS)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def allgather_rows(self, x, axis_name: str = None):
+        """Concatenate per-device row blocks along axis 0 (tiled)."""
+        import jax
+
+        from .mesh import DATA_AXIS
+
+        with device_section(self.name, x):
+            return jax.lax.all_gather(
+                x, axis_name or DATA_AXIS, axis=0, tiled=True
+            )
+
+    def gather_stack(self, x, axis_name: str = None):
+        """Stack per-device blocks into a leading (n_dev, ...) axis —
+        the candidate-list gather shape of the exact kNN block kernel."""
+        import jax
+
+        from .mesh import DATA_AXIS
+
+        with device_section(self.name, x):
+            return jax.lax.all_gather(x, axis_name or DATA_AXIS)
+
+    def psum(self, x, axis_name: str = None):
+        """Element-wise sum of per-device partials (lax.psum)."""
+        import jax
+
+        from .mesh import DATA_AXIS
+
+        with device_section(self.name, *jax.tree_util.tree_leaves(x)):
+            return jax.lax.psum(x, axis_name or DATA_AXIS)
+
+    def psum_merge(self, x, axis_name: str = None):
+        """Stack per-device candidate blocks into one (n_dev, ...) slab via
+        a single psum (zeros-slab scatter; exact as a gather — every element
+        receives one shard's value plus zeros, and x + 0.0 is exact for the
+        finite/+inf distances and int32 positions the merges carry)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .mesh import DATA_AXIS
+
+        axis = axis_name or DATA_AXIS
+        with device_section(self.name, x):
+            n_dev = jax.lax.psum(1, axis)
+            idx = jax.lax.axis_index(axis)
+            slab = jnp.zeros((n_dev,) + x.shape, x.dtype).at[idx].set(x)
+            return jax.lax.psum(slab, axis)
+
+    def ring_shift(self, x, axis_name: str = None, shift: int = 1):
+        """Send this shard's block to the (index + shift) % n_dev neighbor
+        and receive the (index - shift) one's — the ring-permute hop of the
+        kNN candidate exchange.  Counters record the per-hop payload, so a
+        full ring pass shows n_dev x block bytes (vs the n_dev^2 x block an
+        all-gather replicates).  TPU: Pallas remote-DMA kernel; elsewhere:
+        lax.ppermute (identical semantics, the tier-1/parity path)."""
+        import jax
+
+        from .mesh import DATA_AXIS
+
+        axis = axis_name or DATA_AXIS
+        with device_section(self.name, x):
+            n_dev = jax.lax.psum(1, axis)
+            if n_dev == 1:
+                return x
+            if _remote_dma_enabled():
+                return _ring_shift_remote_dma(x, axis, shift, n_dev)
+            from .mesh import ring_permutation
+
+            return jax.lax.ppermute(x, axis, ring_permutation(n_dev, shift))
+
+
+def device_collective(name: str) -> DeviceSection:
+    """The typed-section constructor: one named handle per call site."""
+    return DeviceSection(name)
+
+
+# remote-DMA gate: TPU hardware with pallas enabled, unless explicitly
+# disabled.  Interpret-mode and CPU meshes cannot run remote copies, so the
+# ppermute fallback is also what every tier-1 test exercises; the two paths
+# are semantics-identical by construction (one block in, the left
+# neighbor's block out).
+_REMOTE_DMA_ENV = "SRML_EXCHANGE_REMOTE_DMA"
+
+
+def _remote_dma_enabled() -> bool:
+    import os
+
     import jax
 
-    from .mesh import DATA_AXIS
+    if os.environ.get(_REMOTE_DMA_ENV, "1") == "0":
+        return False
+    try:
+        from ..ops.pallas_tpu import pallas_enabled
+    except ImportError:  # pragma: no cover - circular-import guard
+        return False
+    return jax.default_backend() == "tpu" and pallas_enabled()
 
-    with device_section("allgather_rows", x):
-        return jax.lax.all_gather(
-            x, axis_name or DATA_AXIS, axis=0, tiled=True
+
+def _ring_shift_remote_dma(x, axis_name: str, shift: int, n_dev: int):
+    """+shift ring permute as a Pallas remote-DMA kernel (the SNIPPETS.md
+    `make_async_remote_copy` exemplar, generalized to any shift): the whole
+    block rides one neighbor-to-neighbor ICI DMA with send/recv semaphores
+    providing the synchronization — no cross-chip collective schedule, no
+    replication.  Runs on TPU hardware only (guarded by callers); this
+    module is the single audited home of the remote-DMA API (graftlint
+    R8)."""
+    import jax
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        my = jax.lax.axis_index(axis_name)
+        dst = jax.lax.rem(my + shift + n_dev, n_dev)
+        copy = pltpu.make_async_remote_copy(
+            src_ref=x_ref,
+            dst_ref=o_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=(dst,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
+        copy.start()
+        # the wait covers BOTH directions: send_sem fires when the local
+        # block has left, recv_sem when the left neighbor's block landed in
+        # o_ref — the hop's compute/communicate overlap happens at the
+        # caller (the next hop's block is in flight while this hop merges)
+        copy.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid_spec=grid_spec,
+    )(x)
 
 
-def psum_parts(x, axis_name: str = None):
+# -- legacy un-named-section shims ---------------------------------------------
+
+
+def allgather_rows(x, axis_name: str = None, section: str = "allgather_rows"):
+    """Concatenate per-device row blocks along axis 0 (lax.all_gather,
+    tiled).  Call ONLY inside a shard_map body bound over `axis_name`."""
+    return device_collective(section).allgather_rows(x, axis_name)
+
+
+def psum_parts(x, axis_name: str = None, section: str = "psum_parts"):
     """Element-wise sum of per-device partial arrays (lax.psum) — the
     "partial result per shard -> full result everywhere" reduction shape of
     the forest engine's histogram combine: each device builds per-node
@@ -223,35 +379,65 @@ def psum_parts(x, axis_name: str = None):
     histograms replicated on every device (ops/forest._forest_block_kernel,
     ops/forest_hist.node_histograms_sharded).  Call ONLY inside a shard_map
     body bound over `axis_name`."""
-    import jax
-
-    from .mesh import DATA_AXIS
-
-    with device_section("psum_parts", *jax.tree_util.tree_leaves(x)):
-        return jax.lax.psum(x, axis_name or DATA_AXIS)
+    return device_collective(section).psum(x, axis_name)
 
 
-def psum_merge_parts(x, axis_name: str = None):
+def psum_merge_parts(x, axis_name: str = None, section: str = "psum_merge_parts"):
     """Stack per-device candidate blocks into one (n_dev, ...) slab via a
-    single psum — the IVF-Flat probed search's ONE cross-shard collective
-    (ops-level: each shard scatters its local top-k into its slot of a
-    zeros slab; the psum leaves the full slab replicated everywhere).
-    Bitwise-safe as a gather: every slab element receives exactly one
-    shard's value plus zeros, and x + 0.0 is exact for the finite/+inf
-    distances and int32 positions the merge carries (no -0.0, no NaN by
-    construction).  Call ONLY inside a shard_map body bound over
-    `axis_name`."""
-    import jax
-    import jax.numpy as jnp
+    single psum — the IVF-Flat probed search's ONE cross-shard collective.
+    Call ONLY inside a shard_map body bound over `axis_name`."""
+    return device_collective(section).psum_merge(x, axis_name)
 
-    from .mesh import DATA_AXIS
 
-    axis = axis_name or DATA_AXIS
-    with device_section("psum_merge_parts", x):
-        n_dev = jax.lax.psum(1, axis)
-        idx = jax.lax.axis_index(axis)
-        slab = jnp.zeros((n_dev,) + x.shape, x.dtype).at[idx].set(x)
-        return jax.lax.psum(slab, axis)
+def ring_shift(x, axis_name: str = None, shift: int = 1,
+               section: str = "ring_shift"):
+    """Module-level shim over DeviceSection.ring_shift (docstring there)."""
+    return device_collective(section).ring_shift(x, axis_name, shift)
+
+
+def byte_totals(prefix: str = "exchange."):
+    """(total_bytes, {section: bytes}) over every exchange section counter —
+    host sections count per call, device sections per compiled geometry
+    (trace time).  bench.py snapshots this around each arm so the round
+    standings can print a `bytes moved` column and make the all-gather ->
+    ring traffic reduction a captured artifact."""
+    per = {}
+    for name, v in profiling.counters(prefix).items():
+        if name.endswith(".bytes"):
+            per[name[len(prefix):-len(".bytes")]] = int(v)
+    return sum(per.values()), per
+
+
+def ring_pass_bytes(
+    cp: Any,
+    rank: int,
+    nranks: int,
+    payload: bytes,
+    chunk: int = CHUNK_BYTES,
+) -> bytes:
+    """One ring hop over the control plane: send `payload` to the
+    (rank + 1) % nranks neighbor and return the payload received from
+    (rank - 1) % nranks — the HOST-plane analog of DeviceSection.ring_shift,
+    used by distributed_kneighbors' ring route to rotate query blocks +
+    running candidate lists between ranks as binary frames.
+
+    The wire rides the broadcast allGather (the only collective a Spark
+    barrier offers) but the decode is p2p-shaped: a receiver b64-decodes /
+    joins ONLY its predecessor's chunks and drops the rest by reference, so
+    per-rank decode volume is O(one neighbor's payload) per hop instead of
+    O(sum of all ranks').  COLLECTIVE: every rank must call it once per
+    hop, empty payloads included."""
+    with section("ring", nbytes=len(payload)):
+        use_bytes = hasattr(cp, "allGatherBytes")
+        src = (rank - 1) % nranks
+        mine = _chunks(payload, chunk)
+        counts = [int(c) for c in cp.allGather(str(len(mine)))]
+        parts: List[bytes] = []
+        for r in range(max(counts)):
+            got = _send(cp, mine[r] if r < len(mine) else b"", use_bytes)
+            if r < counts[src]:
+                parts.append(_recv(got[src], use_bytes))
+        return b"".join(parts)
 
 
 def alltoall_bytes(

@@ -76,6 +76,21 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def ring_permutation(n_dev: int, shift: int = 1):
+    """The (source, destination) pairs of a +shift rotation along the
+    1-D data mesh — the ONE definition of the mesh's ring order, used by
+    parallel/exchange.DeviceSection.ring_shift (lax.ppermute fallback) so
+    the XLA and remote-DMA paths agree on who "the +1 neighbor" is.
+
+    get_mesh builds the data axis in jax.devices() order, which on a TPU
+    slice enumerates chips along the physical ICI ring — so the +1 logical
+    neighbor is (one hop of) the wired neighbor and a full ring pass never
+    crosses the bisection.  A custom Mesh with a shuffled device order
+    still computes CORRECT results (ppermute/remote-DMA route by logical
+    index); it just pays longer physical paths per hop."""
+    return [(i, (i + shift) % n_dev) for i in range(n_dev)]
+
+
 # Row-pad multiple shared by sharded kernels whose RNG streams index GLOBAL
 # padded positions (the UMAP layout's counter-based threefry draws): padding
 # to lcm(64, n_shards) keeps the padded geometry — and therefore every

@@ -587,6 +587,85 @@ def test_r7_pragma_escape():
     assert _lint(src, path="spark_rapids_ml_tpu/x.py") == []
 
 
+# -- R8: remote-DMA confinement + paired start/wait ---------------------------
+
+R8_REMOTE_OUTSIDE = """
+    from jax.experimental.pallas import tpu as pltpu
+
+    def ring_kernel(x_ref, o_ref, send_sem, recv_sem, dst):
+        copy = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=o_ref,
+            send_sem=send_sem, recv_sem=recv_sem, device_id=(dst,),
+        )
+        copy.start()
+        copy.wait()
+"""
+
+R8_UNPAIRED_START = """
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(hbm_ref, vmem_ref, sem):
+        dma = pltpu.make_async_copy(hbm_ref, vmem_ref, sem)
+        dma.start()
+        vmem_ref[...] = vmem_ref[...] * 2.0
+"""
+
+R8_PAIRED_OK = """
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(hbm_ref, vmem_ref, sem):
+        dma = pltpu.make_async_copy(hbm_ref, vmem_ref, sem)
+        dma.start()
+        dma.wait()
+"""
+
+
+def test_r8_fires_on_remote_copy_outside_exchange():
+    findings = _lint(
+        R8_REMOTE_OUTSIDE, path="spark_rapids_ml_tpu/ops/pallas_knn.py"
+    )
+    assert _rules_of(findings) == ["R8"]
+    assert "parallel/exchange.py" in findings[0].message
+
+
+def test_r8_remote_copy_allowed_in_exchange():
+    assert (
+        _lint(
+            R8_REMOTE_OUTSIDE, path="spark_rapids_ml_tpu/parallel/exchange.py"
+        )
+        == []
+    )
+
+
+def test_r8_fires_on_unpaired_start():
+    findings = _lint(
+        R8_UNPAIRED_START, path="spark_rapids_ml_tpu/ops/pallas_knn.py"
+    )
+    assert _rules_of(findings) == ["R8"]
+    assert "wait()" in findings[0].message
+
+
+def test_r8_silent_on_paired_start_wait_and_out_of_scope():
+    assert (
+        _lint(R8_PAIRED_OK, path="spark_rapids_ml_tpu/ops/pallas_knn.py")
+        == []
+    )
+    # non-package code (docs snippets, tests) is out of scope
+    assert _lint(R8_UNPAIRED_START, path="tests/test_x.py") == []
+
+
+def test_r8_pragma_escape():
+    src = """
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(hbm_ref, vmem_ref, sem):
+            dma = pltpu.make_async_copy(hbm_ref, vmem_ref, sem)
+            dma.start()  # graftlint: disable=R8 (waited by the out_shape semaphore)
+            return dma
+    """
+    assert _lint(src, path="spark_rapids_ml_tpu/ops/x.py") == []
+
+
 # -- the gate: the real tree is clean -----------------------------------------
 
 

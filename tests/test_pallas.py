@@ -200,6 +200,151 @@ def test_knn_candidates_duplicate_distances_stay_distinct():
     assert (pos[:, 0] % (n // 2) == pos[:, 1] % (n // 2)).all()
 
 
+# -- fused merge epilogue (ops/pallas_knn.knn_fused_pallas) ------------------
+
+from spark_rapids_ml_tpu.ops.pallas_knn import knn_fused_pallas
+
+
+def _lex_oracle(items, Q, k):
+    """numpy lexicographic (d2, pos) top-k oracle: unique total order, so
+    the comparison against the fused kernel is EXACT on positions whenever
+    d2 bits agree — and on crafted integer-valued data they do."""
+    d2 = ((Q[:, None, :].astype(np.float64)
+           - items[None].astype(np.float64)) ** 2).sum(-1)
+    order = np.lexsort((np.arange(items.shape[0])[None].repeat(len(Q), 0),
+                        d2), axis=1)[:, :k]
+    return np.sqrt(np.take_along_axis(d2, order, axis=1)), order
+
+
+@pytest.mark.parametrize(
+    "n,d,q,k",
+    [
+        (2048, 128, 256, 16),   # aligned
+        (2100, 300, 256, 10),   # ragged N and ragged D tail
+        (1024, 64, 130, 7),     # q pads up to a tile
+    ],
+)
+def test_knn_fused_epilogue_matches_merge_and_oracle(n, d, q, k):
+    """The fused merge kernel must agree with the XLA merge route
+    (identical pool in, identical distances out) AND with brute force."""
+    rng = np.random.default_rng(n + d + k)
+    items = rng.standard_normal((n, d)).astype(np.float32)
+    Q = rng.standard_normal((q, d)).astype(np.float32)
+    norms = (items**2).sum(axis=1)
+    valid = np.ones(n, bool)
+    m = max(_select_m(k, 1024, n), k)
+    dist, pos, flags, zeros = knn_fused_pallas(
+        jnp.asarray(items), jnp.asarray(norms), jnp.asarray(valid),
+        jnp.asarray(Q), k, m, n, interpret=KERNEL_INTERPRET,
+    )
+    assert not np.asarray(flags).any() and not np.asarray(zeros).any()
+    # route parity: same pool -> same distances as the XLA merge
+    fv_d, _fv_p = _knn_pool_topk(items, norms, valid, Q, k, m)
+    np.testing.assert_allclose(np.asarray(dist), fv_d, rtol=1e-5, atol=1e-6)
+    # ground truth
+    d2 = ((Q[:, None, :] - items[None]) ** 2).sum(-1)
+    order = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    want = np.sqrt(np.take_along_axis(d2, order, axis=1))
+    np.testing.assert_allclose(np.asarray(dist), want, rtol=1e-3, atol=1e-3)
+    assert (np.asarray(pos) == order).mean() > 0.95
+
+
+def test_knn_fused_epilogue_lex_tie_contract():
+    """The tie contract vs the numpy oracle: on integer-valued data with
+    every item DUPLICATED, d2 values tie in pairs and the fused merge must
+    return the lexicographically smaller position first — exact equality
+    against np.lexsort, not a tolerance check."""
+    rng = np.random.default_rng(11)
+    n, d, q, k = 1024, 128, 128, 8
+    base = rng.integers(-3, 4, size=(n // 2, d)).astype(np.float32)
+    items = np.concatenate([base, base])     # every distance tied pairwise
+    Q = base[:q].astype(np.float32)
+    norms = (items**2).sum(axis=1)
+    valid = np.ones(n, bool)
+    m = max(_select_m(k, 1024, n), k)
+    dist, pos, flags, _z = knn_fused_pallas(
+        jnp.asarray(items), jnp.asarray(norms), jnp.asarray(valid),
+        jnp.asarray(Q), k, m, n, interpret=KERNEL_INTERPRET,
+    )
+    assert not np.asarray(flags).any()
+    want_d, want_pos = _lex_oracle(items, Q, k)
+    # integer-valued inputs: the 3-pass bf16 dot is exact, so positions
+    # must match the lex oracle EXACTLY — including which duplicate of
+    # each tied pair comes first
+    np.testing.assert_array_equal(np.asarray(pos), want_pos)
+    np.testing.assert_allclose(np.asarray(dist), want_d, rtol=1e-5, atol=1e-5)
+
+
+def test_knn_fused_epilogue_multi_kblock():
+    """nb > 1 K-block geometry through the fused route: tile_d=128 at
+    d=330 (d_pad=384 -> 3 K blocks) must keep the same results as the
+    single-block default."""
+    rng = np.random.default_rng(13)
+    n, d, q, k = 1056, 330, 128, 6
+    items = rng.standard_normal((n, d)).astype(np.float32)
+    Q = rng.standard_normal((q, d)).astype(np.float32)
+    norms = (items**2).sum(axis=1)
+    valid = np.ones(n, bool)
+    m = max(_select_m(k, 1024, n), k)
+    out_multi = knn_fused_pallas(
+        jnp.asarray(items), jnp.asarray(norms), jnp.asarray(valid),
+        jnp.asarray(Q), k, m, n, interpret=KERNEL_INTERPRET, tile_d=128,
+    )
+    d2 = ((Q[:, None, :] - items[None]) ** 2).sum(-1)
+    order = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    want = np.sqrt(np.take_along_axis(d2, order, axis=1))
+    np.testing.assert_allclose(
+        np.asarray(out_multi[0]), want, rtol=1e-3, atol=1e-3
+    )
+    assert (np.asarray(out_multi[1]) == order).mean() > 0.95
+
+
+def test_knn_fused_epilogue_flags_route_exact_fallback():
+    """Forced self-verify failure through the fused path: an m far below
+    the _select_m envelope with the whole true top-k packed into ONE item
+    group must (a) raise the in-kernel overflow flag and (b) come back
+    EXACT after knn_block_adaptive_collect's per-row rerun."""
+    import jax
+
+    from jax.sharding import Mesh
+    from spark_rapids_ml_tpu.ops.knn import knn_block_adaptive_collect
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    rng = np.random.default_rng(17)
+    n, d, q, k, m = 2048, 128, 128, 10, 4
+    items = rng.standard_normal((n, d)).astype(np.float32) + 50.0
+    Q = rng.standard_normal((q, d)).astype(np.float32)
+    # rows 0..k-1 live in group 0 and are the UNIQUE top-k of every query:
+    # group 0 keeps only m=4 of them, so the merged list misses 6 and the
+    # worst-kept-vs-threshold flag MUST fire
+    items[:k] = Q[:k].mean(axis=0) + 0.01 * rng.standard_normal(
+        (k, d)
+    ).astype(np.float32)
+    Q[:] = items[:k].mean(axis=0) + 0.01 * rng.standard_normal(
+        (q, d)
+    ).astype(np.float32)
+    norms = (items**2).sum(axis=1)
+    valid = np.ones(n, bool)
+    handles = knn_fused_pallas(
+        jnp.asarray(items), jnp.asarray(norms), jnp.asarray(valid),
+        jnp.asarray(Q), k, m, n, interpret=KERNEL_INTERPRET,
+    )
+    flags = np.asarray(handles[2])
+    assert flags.any(), "crafted overflow did not raise the fused flag"
+    mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
+    d_out, p_out = knn_block_adaptive_collect(
+        handles,
+        jnp.asarray(items), jnp.asarray(norms),
+        jnp.arange(n, dtype=jnp.int32), jnp.asarray(valid),
+        jnp.asarray(Q), mesh, k,
+    )
+    d2 = ((Q[:, None, :].astype(np.float64) - items[None]) ** 2).sum(-1)
+    order = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    want = np.sqrt(np.take_along_axis(d2, order, axis=1))
+    np.testing.assert_allclose(d_out, want, rtol=1e-3, atol=1e-3)
+    assert (p_out == order).mean() > 0.95
+
+
 # -- fused feature binning kernel (ops/pallas_tpu.bin_features_fm_pallas) ----
 
 from spark_rapids_ml_tpu.ops.pallas_tpu import bin_features_fm_pallas

@@ -31,6 +31,12 @@
 #                    recorder, trace exports, and watchdog reports attribute
 #                    events by thread name; "Thread-N" is useless in a hang
 #                    dump.
+#   R8 remote-dma    pltpu.make_async_remote_copy outside parallel/
+#                    exchange.py (the ONE audited home of the inter-chip
+#                    DMA surface), and DMA handles .start()ed without a
+#                    matching .wait() in the same kernel body — an
+#                    unwaited remote copy races the output block's flush
+#                    and can wedge the device in FAILED_PRECONDITION.
 #
 # Suppression: `# graftlint: disable=R1 (reason)` on the finding line or the
 # line directly above.  Granted pragmas are audited in NOTES.md.
@@ -67,6 +73,7 @@ RULE_NAMES = {
     "R5": "dtype",
     "R6": "raw-clock",
     "R7": "unnamed-thread",
+    "R8": "remote-dma",
 }
 
 # Findings sanctioned by construction, not by pragma.  Entries are

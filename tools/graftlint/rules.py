@@ -23,7 +23,7 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
+RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
 
 FindingTuple = Tuple[str, int, str, str]  # (rule, line, message, func-qualname)
 
@@ -720,6 +720,93 @@ def _r7_check_call(
     )
 
 
+# -- R8: remote-DMA confinement + paired start/wait ---------------------------
+# pltpu.make_async_remote_copy is inter-chip RDMA: a wrong device_id or a
+# mis-sequenced semaphore does not raise — it wedges the ring (or silently
+# corrupts a neighbor's HBM).  The API therefore lives in ONE audited
+# module, parallel/exchange.py (DeviceSection.ring_shift), and every other
+# engine composes ring exchanges through it.  Additionally, a DMA handle
+# (remote or local make_async_copy) that is .start()ed but never .wait()ed
+# in the same kernel body races the output block's flush — the same
+# undefined-DMA-ordering hazard the qres grid restructure fixed — so the
+# pairing is checked per function body.
+
+_R8_REMOTE = "make_async_remote_copy"
+_R8_DMA_MAKERS = {"make_async_remote_copy", "make_async_copy"}
+
+
+def _r8_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "spark_rapids_ml_tpu/" in norm or norm.startswith(
+        "spark_rapids_ml_tpu"
+    )
+
+
+def _r8_confined(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return norm.endswith("parallel/exchange.py")
+
+
+def _r8_short(func: ast.AST, index: ModuleIndex) -> Optional[str]:
+    name = index.dotted(func)
+    if name:
+        return name.rsplit(".", 1)[-1]
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _r8_check_call(
+    call: ast.Call, index: ModuleIndex, qualname: str, path: str
+) -> Iterator[FindingTuple]:
+    if _r8_short(call.func, index) == _R8_REMOTE and not _r8_confined(path):
+        yield (
+            "R8",
+            call.lineno,
+            "make_async_remote_copy outside parallel/exchange.py: the "
+            "inter-chip DMA surface is confined to the ONE audited module "
+            "— compose ring exchanges through "
+            "exchange.DeviceSection.ring_shift (docs/graftlint.md#r8)",
+            qualname,
+        )
+
+
+def _r8_check_function(
+    fn: ast.FunctionDef, index: ModuleIndex, qualname: str
+) -> Iterator[FindingTuple]:
+    dma_vars: Dict[str, int] = {}   # local name -> assignment line
+    started: Dict[str, int] = {}    # local name -> first .start() line
+    waited: Set[str] = set()
+    for node in _walk_own_body(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _r8_short(node.value.func, index) in _R8_DMA_MAKERS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        dma_vars[t.id] = node.lineno
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+        ):
+            base = node.func.value.id
+            if node.func.attr == "start":
+                started.setdefault(base, node.lineno)
+            elif node.func.attr == "wait":
+                waited.add(base)
+    for name, line in sorted(started.items(), key=lambda kv: kv[1]):
+        if name in dma_vars and name not in waited:
+            yield (
+                "R8",
+                line,
+                f"DMA handle '{name}' is start()ed but never wait()ed in "
+                "this kernel body: an unwaited async copy races the output "
+                "block's flush (undefined ordering; can wedge the device) "
+                "— pair every start() with a wait() before the body "
+                "returns (docs/graftlint.md#r8)",
+                qualname,
+            )
+
+
 # -- driver -------------------------------------------------------------------
 
 def lint_tree(
@@ -742,6 +829,12 @@ def lint_tree(
                     )
                 if "R2" in selected and isinstance(stmt, ast.FunctionDef):
                     findings.extend(_r2_check_function(stmt, index, qual))
+                if (
+                    "R8" in selected
+                    and isinstance(stmt, ast.FunctionDef)
+                    and _r8_applies(index.path)
+                ):
+                    findings.extend(_r8_check_function(stmt, index, qual))
                 visit_functions(stmt.body, f"{qual}.", is_jit)
             elif isinstance(stmt, ast.ClassDef):
                 visit_functions(stmt.body, f"{prefix}{stmt.name}.", enclosing_jit)
@@ -790,6 +883,8 @@ def lint_tree(
                 findings.extend(_r6_check_call(node, index, qual))
             if "R7" in selected and _r7_applies(index.path):
                 findings.extend(_r7_check_call(node, index, qual))
+            if "R8" in selected and _r8_applies(index.path):
+                findings.extend(_r8_check_call(node, index, qual, index.path))
         if isinstance(node, ast.For) and "R4" in selected:
             findings.extend(_r4_check_for(node, qual, index))
         if "R5" in selected and _r5_applies(index.path):
