@@ -32,6 +32,13 @@ if [ "${SRML_CI_FULL:-0}" = "1" ]; then
     t1=$SECONDS
     python -m pytest tests/ -x -q --runslow -m slow
     echo "CI budget: slow-marked remainder took $((SECONDS - t1))s"
+    # srml-shield slow gates, re-asserted by name: the 3- and 4-process
+    # multicontroller fit + kneighbors parity variants (uneven partitions,
+    # one empty rank — rank-indexing bugs cannot hide at nranks=2) and the
+    # hardware kNN audit (TPU-gated; skips cleanly on CPU)
+    python -m pytest tests/test_multicontroller.py -q --runslow \
+        -k "three_plus or multirank"
+    python -m pytest tests/test_knn_audit.py -q --runslow
 fi
 
 # 3b. focused gates for the kNN query-engine contracts (cheap; both files
@@ -289,6 +296,36 @@ assert rec["exchange_bytes"] > 0, rec
 assert any(s.startswith("knn.ring") for s in rec["exchange_sections"]), rec
 EOF
 rm -rf "$KNN_SMOKE"
+
+# 3j. srml-shield chaos gates (also inside the full suite; re-asserted by
+#     name so marker drift can never silently drop them —
+#     docs/robustness.md):
+#     - CHAOS MATRIX on 3 real OS processes: a rank killed mid-collective
+#       (SRML_FAULTS cp.gather action=die) makes every survivor raise
+#       RemoteRankError NAMING the dead rank in < 10 s (vs the 300 s round
+#       timeout), with clean teardown and no orphan alive/heartbeat files;
+#       the orderly-abort variant carries exception type + failing span
+#       through the abort marker
+#     - unarmed-path overhead: SRML_FAULTS unset adds no measurable work at
+#       injection sites (structural gate, test_watch style)
+#     - serving recovery: injected worker death and watchdog-confirmed
+#       wedge each return the server to READY via supervised restart, with
+#       queued/in-flight requests failed by the typed retryable
+#       ServerRecovering (never a hang) and ZERO new compiles across the
+#       recovery (buckets re-warm from the retained AOT cache)
+#     plus a graftlint-clean re-check (incl. R9 unbounded-wait) of the
+#     touched modules by name.
+# the explicit full-file run IS the by-name gate: nothing in it is
+# marker-filtered, so no subset re-run is needed (the chaos matrix is the
+# most expensive piece of 3j — run it once)
+python -m pytest tests/test_faults.py -q
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_serving.py -q \
+    -k "shield or worker_death or wedge_then or drain_during or budget or rolls_up"
+python -m tools.graftlint spark_rapids_ml_tpu/parallel \
+    spark_rapids_ml_tpu/serving spark_rapids_ml_tpu/watch.py \
+    spark_rapids_ml_tpu/core.py spark_rapids_ml_tpu/ops/knn.py \
+    spark_rapids_ml_tpu/compat.py
 
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)

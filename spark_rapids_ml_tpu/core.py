@@ -560,6 +560,13 @@ class _TpuCaller(_TpuParams):
         ), profiling.trace_session(f"fit-{type(self).__name__}"), _maybe_x64(
             self._use_dtype(df, input_col, input_cols)
         ):
+            # srml-shield: the runner.fit injection site fires on BOTH fit
+            # paths — here (driver-local) and in parallel/runner.fit (the
+            # barrier task) — so a fault plan written against the site name
+            # covers whichever launcher ran the fit
+            from .parallel import faults
+
+            faults.site("runner.fit", rank=0)
             with profiling.phase("srml.ingest"):
                 inputs = self._build_fit_inputs(df)
             extra_params = None

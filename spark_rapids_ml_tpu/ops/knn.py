@@ -26,6 +26,7 @@ from ..compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import profiling
+from ..parallel import faults
 from ..parallel.mesh import DATA_AXIS, data_sharding, get_mesh
 
 
@@ -1776,13 +1777,18 @@ def _distributed_ring(
         )
     d_cur, i_cur = best
     for hop in range(nranks):
-        payload = pack_arrays([qb, d_cur, i_cur])
-        got = ring_pass_bytes(control_plane, rank, nranks, payload)
-        qb, d_cur, i_cur = unpack_arrays(got)
-        qb = qb.astype(dtype, copy=False)
-        if hop < nranks - 1 and qb.shape[0] and blocks:
-            d_new, i_new = _search(qb)
-            d_cur, i_cur = native.topk_merge(d_cur, i_cur, d_new, i_new)
+        # srml-shield: the per-hop injection site INSIDE the named span, so
+        # a rank killed/raised mid-ring leaves "knn.ring.hop" as the
+        # failing span in its abort marker / the survivors' flight dumps
+        with profiling.span("knn.ring.hop", hop=hop):
+            faults.site("knn.ring_hop", rank=rank)
+            payload = pack_arrays([qb, d_cur, i_cur])
+            got = ring_pass_bytes(control_plane, rank, nranks, payload)
+            qb, d_cur, i_cur = unpack_arrays(got)
+            qb = qb.astype(dtype, copy=False)
+            if hop < nranks - 1 and qb.shape[0] and blocks:
+                d_new, i_new = _search(qb)
+                d_cur, i_cur = native.topk_merge(d_cur, i_cur, d_new, i_new)
     # nranks rotations = identity: d_cur/i_cur hold THIS rank's queries
     out, at = [], 0
     for r in q_rows:

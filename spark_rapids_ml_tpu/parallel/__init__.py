@@ -1,11 +1,14 @@
 from .mesh import default_num_workers, get_mesh, shard_rows
 from .partition import PartitionDescriptor
-from .context import TpuContext
+from .context import RemoteRankError, TpuContext
+from . import faults
 
 __all__ = [
     "default_num_workers",
     "get_mesh",
     "shard_rows",
     "PartitionDescriptor",
+    "RemoteRankError",
     "TpuContext",
+    "faults",
 ]

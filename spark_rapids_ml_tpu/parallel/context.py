@@ -28,6 +28,32 @@ from typing import Any, List, Optional, Protocol
 import jax
 
 from ..utils import get_logger
+from . import faults
+
+
+class RemoteRankError(RuntimeError):
+    """Another rank of the cooperating job failed (orderly abort) or died
+    (no marker — killed/OOMed) while this rank waited on a collective.
+    Raised by the control plane's gather waits within one poll interval of
+    the abort marker / dead pid appearing, instead of the full round
+    timeout — and it NAMES the culprit: origin rank, its exception type,
+    and the innermost span it was in (from the srml-watch health surface),
+    so the survivor's traceback reads "rank 1 died in exchange.ring", not
+    "TimeoutError after 300 s"."""
+
+    def __init__(
+        self,
+        rank: int,
+        message: str,
+        span: Optional[str] = None,
+        etype: Optional[str] = None,
+    ):
+        self.rank = int(rank)
+        self.span = span
+        self.etype = etype
+        where = f" in span {span!r}" if span else ""
+        what = f"{etype}: {message}" if etype else message
+        super().__init__(f"remote rank {self.rank}{where}: {what}")
 
 
 class ControlPlane(Protocol):
@@ -138,7 +164,17 @@ class TpuContext:
         return self._nranks
 
     def __enter__(self) -> "TpuContext":
+        faults.site("context.init", rank=self._rank)
         if self._nranks > 1:
+            # CPU pods (virtual-device CI, mc tests, CPU-only clusters)
+            # need gloo collectives armed BEFORE the backend initializes,
+            # or every cross-process GSPMD computation fails to compile.
+            # Unconditional: probing the backend kind here would itself
+            # initialize it, and the flag is inert off-CPU
+            # (compat.ensure_cpu_collectives docstring has the story)
+            from ..compat import ensure_cpu_collectives
+
+            ensure_cpu_collectives()
             # rank 0 advertises coordinator host:port; everyone gathers it.
             if self._rank == 0:
                 addr = f"{_local_ip()}:{_free_port()}"
@@ -164,10 +200,45 @@ class TpuContext:
         return self
 
     def __exit__(self, exc_type: Any, exc_val: Any, exc_tb: Any) -> None:
+        # Abort-vs-clean semantics — the reference deliberately
+        # distinguishes NCCL abort()-on-error from destroy()-on-clean
+        # (cuml_context.py:149-166); here the exception path BROADCASTS an
+        # abort marker through the control plane FIRST, so peers blocked
+        # in a collective wait raise RemoteRankError within one poll
+        # interval instead of riding out the round timeout.  A
+        # RemoteRankError is itself a relayed abort: re-broadcasting it
+        # would cascade markers around the ring, so only ORIGINAL failures
+        # publish.
+        if (
+            exc_type is not None
+            and self._nranks > 1
+            and not isinstance(exc_val, RemoteRankError)
+            and hasattr(self._cp, "abort")
+        ):
+            try:
+                from .. import watch
+
+                self._cp.abort(json.dumps({
+                    "rank": self._rank,
+                    "etype": exc_type.__name__,
+                    "message": str(exc_val)[:512],
+                    "span": watch.failing_span(),
+                }))
+            except Exception as abort_exc:  # noqa: BLE001 - best effort
+                # the abort broadcast must never mask the real error, but
+                # its failure is LOGGED, not swallowed (graftlint R9)
+                self._logger.warning("abort broadcast failed: %s", abort_exc)
         if self._initialized_distributed:
             try:
                 jax.distributed.shutdown()
-            except Exception:  # noqa: BLE001 - mirror nccl abort-on-error path
+            except Exception as exc:  # noqa: BLE001 - nccl abort-path mirror
                 if exc_type is None:
                     raise
+                # abort path: a shutdown failure while unwinding a real
+                # error is expected (the coordinator may already be gone);
+                # log it, never mask the original exception
+                self._logger.warning(
+                    "jax.distributed.shutdown failed during abort "
+                    "teardown: %s", exc,
+                )
         return None

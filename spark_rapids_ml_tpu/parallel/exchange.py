@@ -36,6 +36,7 @@ from typing import Any, Iterator, List, Optional, Sequence
 import numpy as np
 
 from .. import profiling
+from . import faults
 
 # per-frame chunk bound: Spark's allGather rides the RPC channel
 # (spark.rpc.message.maxSize default 128 MiB); 8 MiB keeps each frame far
@@ -347,7 +348,7 @@ def _ring_shift_remote_dma(x, axis_name: str, shift: int, n_dev: int):
         # block has left, recv_sem when the left neighbor's block landed in
         # o_ref — the hop's compute/communicate overlap happens at the
         # caller (the next hop's block is in flight while this hop merges)
-        copy.wait()
+        copy.wait()  # graftlint: disable=R9 (DMA completion has no timeout; R8 requires the start/wait pair)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
@@ -427,6 +428,10 @@ def ring_pass_bytes(
     per-rank decode volume is O(one neighbor's payload) per hop instead of
     O(sum of all ranks').  COLLECTIVE: every rank must call it once per
     hop, empty payloads included."""
+    # srml-shield: corrupt here flips bytes in the outgoing frame (the
+    # receiver's SRX1 magic check must fail loudly); die/raise simulate a
+    # rank lost mid-ring
+    payload = faults.site("exchange.ring_pass", rank=rank, payload=payload)
     with section("ring", nbytes=len(payload)):
         use_bytes = hasattr(cp, "allGatherBytes")
         src = (rank - 1) % nranks

@@ -32,6 +32,7 @@ import base64
 import contextlib
 import json
 import os
+import random
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
@@ -41,9 +42,27 @@ import pandas as pd
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .context import ControlPlane, LocalControlPlane, TpuContext
+from . import faults
+from .context import ControlPlane, LocalControlPlane, RemoteRankError, TpuContext
 from .mesh import DATA_AXIS
 from .partition import PartitionDescriptor
+
+from .. import profiling
+
+# -- srml-shield control-plane knobs (docs/robustness.md) ---------------------
+# Per-ROUND bounded timeout: every gather round gets its own budget instead
+# of one session-wide 300 s cliff, so a wedged round is diagnosed at round
+# granularity.  Retries: shared-FS I/O (NFS on TPU-VM pods) throws transient
+# OSErrors under churn; each read/write retries with exponential backoff and
+# deterministic per-rank jitter before giving up.
+ROUND_TIMEOUT_ENV = "SRML_CP_ROUND_TIMEOUT_S"
+RETRIES_ENV = "SRML_CP_RETRIES"
+BACKOFF_ENV = "SRML_CP_BACKOFF_S"
+_DEFAULT_ROUND_TIMEOUT_S = 300.0
+_DEFAULT_RETRIES = 3
+_DEFAULT_BACKOFF_S = 0.05
+
+from ..utils import env_float as _env_float  # noqa: E402 - knob parsing
 
 
 class FileControlPlane:
@@ -52,18 +71,133 @@ class FileControlPlane:
 
     Stands in for Spark's BarrierTaskContext wherever there is no Spark —
     subprocess launchers, mpirun-style deployments with a shared FS, and the
-    multi-controller tests.  Rendezvous root must be empty per job."""
+    multi-controller tests.  Rendezvous root must be empty per job.
+
+    srml-shield fast-abort surface (docs/robustness.md):
+
+      - every plane writes an `alive_rank<k>.pid` liveness file at
+        construction and holds an EXCLUSIVE flock on it for the process
+        lifetime; gather waits probe peers' locks (the kernel releases a
+        dead process's locks even while it is an unreaped zombie, which a
+        bare `kill(pid, 0)` cannot see) with a pid check as fallback, so a
+        rank KILLED mid-collective (no marker, no teardown — the
+        SIGKILL/OOM shape) is detected within one poll interval and
+        surfaces as RemoteRankError naming the dead rank, not as a
+        round-timeout 300 s later.
+      - abort(payload) atomically publishes an `abort-r<k>.json` marker (the
+        encoded exception + failing span, written by TpuContext.__exit__ on
+        the exception path); gather waits poll for foreign markers and raise
+        RemoteRankError quoting the origin rank, exception type, and span.
+      - close() removes this rank's presence files (alive + heartbeat) and
+        reaps those of peers whose process is gone — the no-orphan-files
+        half of the teardown contract."""
 
     def __init__(self, root: str, rank: int, nranks: int,
-                 timeout: float = 300.0, poll: float = 0.02):
+                 timeout: Optional[float] = None, poll: float = 0.02):
         self._root = root
         self._rank = rank
         self._nranks = nranks
         self._round = 0
-        self._timeout = timeout
+        self._timeout = (
+            timeout
+            if timeout is not None
+            else _env_float(ROUND_TIMEOUT_ENV, _DEFAULT_ROUND_TIMEOUT_S)
+        )
         self._poll = poll
+        # deterministic per-rank backoff jitter (explicitly seeded: R4)
+        self._jitter = random.Random(10007 + rank)
         os.makedirs(root, exist_ok=True)
+        # liveness: pid + an exclusive flock held for the process lifetime.
+        # The LOCK is the primary death signal — the kernel releases it the
+        # instant the process exits, including the unreaped-zombie window
+        # where kill(pid, 0) still succeeds.  The pid is the fallback (and
+        # the error message's evidence) for filesystems without working
+        # flock, recorded in the file so peers know which probe to trust.
+        self._alive_fd: Optional[int] = None
+        self._register_alive()
 
+    # -- file paths ----------------------------------------------------------
+    def _alive_path(self, rank: int) -> str:
+        return os.path.join(self._root, f"alive_rank{rank:05d}.pid")
+
+    def _abort_path(self, rank: int) -> str:
+        return os.path.join(self._root, f"abort-r{rank:05d}.json")
+
+    def _register_alive(self) -> None:
+        """Publish `<pid> flock|nolock` and (where the FS supports it) hold
+        an exclusive flock on the file for the process lifetime — the mode
+        word tells peers which death probe to trust.  A sibling plane
+        instance of this SAME process (thread-mocked rank harnesses) may
+        already hold the path's lock; replacing its inode would orphan
+        that lock, so an entry already naming our pid is left alone."""
+        path = self._alive_path(self._rank)
+        try:
+            with open(path) as f:
+                parts = f.read().split()
+            if parts and parts[0] == str(os.getpid()):
+                return  # a sibling instance of this process registered us
+        except OSError:
+            pass
+        self._write_atomic(path, f"{os.getpid()} nolock")
+        try:
+            import fcntl
+
+            fd = os.open(path, os.O_RDWR)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                return
+            self._alive_fd = fd  # held until close() / process death
+            content = f"{os.getpid()} flock".encode()
+            os.pwrite(fd, content, 0)
+            os.ftruncate(fd, len(content))
+        except (ImportError, OSError):
+            pass
+
+    # -- retrying I/O ---------------------------------------------------------
+    def _retry_io(self, fn, what: str):
+        """Run `fn` retrying transient OSErrors with exponential backoff +
+        deterministic jitter (SRML_CP_RETRIES / SRML_CP_BACKOFF_S)."""
+        retries = int(_env_float(RETRIES_ENV, _DEFAULT_RETRIES))
+        backoff = _env_float(BACKOFF_ENV, _DEFAULT_BACKOFF_S)
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except OSError:
+                if attempt >= retries:
+                    raise
+                delay = backoff * (2 ** attempt) * (
+                    1.0 + 0.25 * self._jitter.random()
+                )
+                profiling.incr_counter("cp.io_retries")
+                attempt += 1
+                time.sleep(delay)
+
+    def _write_atomic(self, path: str, text_or_bytes) -> None:
+        data = (
+            text_or_bytes.encode("utf-8")
+            if isinstance(text_or_bytes, str)
+            else text_or_bytes
+        )
+        tmp = path + f".tmp{os.getpid()}"
+
+        def _write():
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)  # atomic publish
+
+        self._retry_io(_write, path)
+
+    def _read_bytes(self, path: str) -> bytes:
+        def _read():
+            with open(path, "rb") as f:
+                return f.read()
+
+        return self._retry_io(_read, path)
+
+    # -- the gather protocol --------------------------------------------------
     def allGather(self, message: str) -> List[str]:
         return [
             b.decode("utf-8")
@@ -79,32 +213,197 @@ class FileControlPlane:
     def _gather_round(self, message: bytes) -> List[bytes]:
         r = self._round
         self._round += 1
+        message = faults.site("cp.gather", rank=self._rank, payload=message)
         path = os.path.join(self._root, f"round{r:05d}_rank{self._rank:05d}.msg")
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(message)
-        os.replace(tmp, path)  # atomic publish
+        self._write_atomic(path, message)
         expected = [
             os.path.join(self._root, f"round{r:05d}_rank{i:05d}.msg")
             for i in range(self._nranks)
         ]
         deadline = time.monotonic() + self._timeout
         while not all(os.path.exists(p) for p in expected):
+            missing = [
+                i for i, p in enumerate(expected) if not os.path.exists(p)
+            ]
+            # fast-abort scan: a foreign abort marker or a dead peer ends
+            # the wait within ONE poll interval, naming the culprit —
+            # instead of the full round timeout naming nobody
+            self._raise_if_aborted()
+            self._raise_if_peer_dead(missing)
             if time.monotonic() > deadline:
-                missing = [i for i, p in enumerate(expected) if not os.path.exists(p)]
                 raise TimeoutError(
-                    f"FileControlPlane round {r}: ranks {missing} never posted "
-                    f"within {self._timeout}s"
+                    f"FileControlPlane round {r}: ranks {missing} never "
+                    f"posted within {self._timeout}s "
+                    f"({ROUND_TIMEOUT_ENV} bounds each round)"
                 )
             time.sleep(self._poll)
         out = []
         for p in expected:
-            with open(p, "rb") as f:
-                out.append(f.read())
+            out.append(self._read_bytes(p))
         return out
 
     def barrier(self) -> None:
+        faults.site("cp.barrier", rank=self._rank)
         self.allGather("")
+
+    # -- srml-shield abort protocol -------------------------------------------
+    def abort(self, payload: str) -> None:
+        """Atomically publish this rank's abort marker (JSON: rank, etype,
+        message, span).  Fire-and-forget like publish_health: no rank ever
+        waits on it — peers polling in a gather wait pick it up and raise
+        RemoteRankError within one poll interval."""
+        profiling.incr_counter("cp.abort_markers")
+        self._write_atomic(self._abort_path(self._rank), payload)
+
+    def check_abort(self) -> Optional[Dict[str, Any]]:
+        """First foreign abort marker's decoded payload, or None.  Never
+        blocks; a torn/garbled marker degrades to a minimal payload naming
+        the origin rank (the marker's existence IS the abort signal)."""
+        for i in range(self._nranks):
+            if i == self._rank:
+                continue
+            p = self._abort_path(i)
+            if not os.path.exists(p):
+                continue
+            try:
+                info = json.loads(self._read_bytes(p).decode("utf-8"))
+                if isinstance(info, dict):
+                    info.setdefault("rank", i)
+                    return info
+            except (OSError, ValueError):
+                pass
+            return {"rank": i}
+        return None
+
+    def _raise_if_aborted(self) -> None:
+        info = self.check_abort()
+        if info is None:
+            return
+        profiling.incr_counter("cp.remote_aborts")
+        raise RemoteRankError(
+            rank=int(info.get("rank", -1)),
+            message=info.get("message", "aborted"),
+            span=info.get("span"),
+            etype=info.get("etype"),
+        )
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except (PermissionError, OSError):
+            return True  # exists but not ours (or unknowable): assume alive
+        return True
+
+    def _peer_dead_reason(self, rank: int) -> Optional[str]:
+        """Why rank `rank` is believed dead, or None (alive / not yet
+        registered).  Primary signal: its liveness flock is FREE (the
+        kernel releases it at process exit — including the unreaped-zombie
+        window where kill(pid, 0) still succeeds); fallback for nolock
+        registrations: the pid is gone."""
+        path = self._alive_path(rank)
+        try:
+            with open(path) as f:
+                parts = f.read().split()
+        except OSError:
+            return None  # not registered yet (or already cleanly closed)
+        try:
+            pid = int(parts[0])
+        except (IndexError, ValueError):
+            return None  # torn write: the retry-backed publisher fixes it
+        if len(parts) > 1 and parts[1] == "flock":
+            # the mode word says the registrant HOLDS the lock: the probe is
+            # authoritative (and works across hosts on lock-honoring shared
+            # FS).  The local pid check must NOT run first here — on a
+            # multi-host deployment a remote rank's pid means nothing to
+            # this kernel and kill(pid, 0) would declare a healthy peer
+            # dead.  Only an unprobeable lock falls through to the pid.
+            try:
+                import fcntl
+
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    return None  # lock held: alive
+                else:
+                    fcntl.flock(fd, fcntl.LOCK_UN)
+                    return (
+                        f"process (pid {pid}) released its liveness lock "
+                        "(exited; possibly an unreaped zombie)"
+                    )
+                finally:
+                    os.close(fd)
+            except (ImportError, OSError):
+                pass  # cannot probe: fall through to the pid best-effort
+        if not self._pid_alive(pid):
+            return f"process (pid {pid}) is gone"
+        return None
+
+    def _raise_if_peer_dead(self, missing_ranks: List[int]) -> None:
+        """A rank that REGISTERED (alive file present) but is provably gone
+        died without a marker — killed, OOMed, segfaulted.  Only ranks we
+        are actually waiting on are scanned; a rank that has not
+        registered yet is merely slow (the round timeout still bounds
+        it)."""
+        for i in missing_ranks:
+            reason = self._peer_dead_reason(i)
+            if reason is None:
+                continue
+            profiling.incr_counter("cp.dead_peers")
+            raise RemoteRankError(
+                rank=i,
+                message=(
+                    f"{reason} mid-collective without an abort marker "
+                    "(killed / OOM / segfault)"
+                ),
+            )
+
+    def close(self) -> None:
+        """Release this rank's liveness lock, remove its presence files
+        (alive pid + heartbeat), and — ONLY once no other survivor remains
+        — reap dead peers' too.  A dead rank's alive file is the death
+        EVIDENCE every still-blocked survivor polls to raise its own
+        RemoteRankError: the first survivor to close must not destroy it,
+        or the slower survivors ride out the full round timeout (the exact
+        hang this plane exists to kill).  The LAST closer sees no live
+        registered peer left and sweeps, so after every surviving rank
+        closes, no alive_*/health_* file remains for any rank (the
+        no-orphan-files teardown contract; gated by the chaos tests).
+        Round messages and abort markers are the session's record and are
+        left for the per-job rendezvous root to be deleted wholesale."""
+        for path in (
+            self._alive_path(self._rank),
+            os.path.join(self._root, f"health_rank{self._rank:05d}.json"),
+        ):
+            with contextlib.suppress(OSError):
+                os.remove(path)
+        if self._alive_fd is not None:
+            with contextlib.suppress(OSError):
+                os.close(self._alive_fd)  # releases the flock
+            self._alive_fd = None
+        # a peer whose alive file is present AND whose death probe says
+        # "alive" is a survivor that has not closed yet: leave the dead
+        # ranks' evidence for it
+        for i in range(self._nranks):
+            if i == self._rank:
+                continue
+            if (
+                os.path.exists(self._alive_path(i))
+                and self._peer_dead_reason(i) is None
+            ):
+                return
+        for i in range(self._nranks):
+            if i == self._rank:
+                continue
+            for path in (
+                self._alive_path(i),
+                os.path.join(self._root, f"health_rank{i:05d}.json"),
+            ):
+                with contextlib.suppress(OSError):
+                    os.remove(path)
 
     # -- srml-watch health surface (NON-collective, unlike the gathers) ------
     def publish_health(self, payload: str) -> None:
@@ -362,6 +661,10 @@ class DistributedFitSession:
         health = watch.start_fit_health(self.control_plane, self.rank, self.nranks)
         try:
             with watch.flight_scope(tag), profiling.trace_session(tag):
+                # srml-shield: the fit-task injection site (action=die here
+                # is the chaos matrix's "rank killed mid-fit"; action=raise
+                # exercises the abort-marker broadcast in TpuContext)
+                faults.site("runner.fit", rank=self.rank)
                 with profiling.phase("runner.build_inputs"):
                     inputs = self.build_fit_inputs(estimator, df)
                 fit_func = estimator._get_tpu_fit_func(df, extra_params)
@@ -411,8 +714,17 @@ def distributed_session(
     from ..ops.precompile import initialize_persistent_cache
 
     initialize_persistent_cache()
-    with TpuContext(rank, nranks, cp):
-        yield DistributedFitSession(rank, nranks, cp)
+    try:
+        with TpuContext(rank, nranks, cp):
+            yield DistributedFitSession(rank, nranks, cp)
+    finally:
+        # srml-shield teardown contract: remove this rank's control-plane
+        # presence files (alive pid, heartbeat) and reap dead peers' — runs
+        # AFTER TpuContext.__exit__ so an abort marker broadcast on the
+        # exception path is already published
+        closer = getattr(cp, "close", None)
+        if closer is not None:
+            closer()
 
 
 def run_distributed_fit(

@@ -22,10 +22,13 @@ from .engine import (
     DEGRADED,
     DRAINING,
     READY,
+    RECOVERING,
+    SEVERITY,
     STATE_CODES,
     UNHEALTHY,
     WARMING,
     ModelServer,
+    ServerRecovering,
     ServerUnhealthy,
 )
 from .entry import ServingEntry, bucket_rows, entry_for, kernel_entry, serve_buckets
@@ -38,9 +41,12 @@ __all__ = [
     "ModelRegistry",
     "ModelServer",
     "READY",
+    "RECOVERING",
     "RequestTimeout",
+    "SEVERITY",
     "STATE_CODES",
     "ServerOverloaded",
+    "ServerRecovering",
     "ServerUnhealthy",
     "ServingEntry",
     "UNHEALTHY",

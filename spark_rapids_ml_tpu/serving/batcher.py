@@ -24,7 +24,6 @@
 from __future__ import annotations
 
 import collections
-import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -65,9 +64,13 @@ class ServerOverloaded(RuntimeError):
     fast-rejection half of admission control (callers shed or retry with
     backoff; queueing would only convert overload into unbounded latency)."""
 
+    retryable = True  # with backoff — the queue drains at dispatch rate
+
 
 class RequestTimeout(TimeoutError):
     """Set on a request's future when its deadline expires while queued."""
+
+    retryable = True  # the request was never dispatched
 
 
 class _Request:
@@ -83,11 +86,7 @@ class _Request:
         )
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+from ..utils import env_float as _env_float  # noqa: E402 - knob parsing
 
 
 class MicroBatcher:
@@ -210,7 +209,11 @@ class MicroBatcher:
         with self._lock:
             while True:
                 while not self._queue and not self._stopped:
-                    self._nonempty.wait()
+                    # bounded wait (graftlint R9): re-checking the predicate
+                    # once a second costs nothing and means a lost notify —
+                    # or a recovery path that swapped consumers — can never
+                    # park this worker forever
+                    self._nonempty.wait(timeout=1.0)
                 if not self._queue:
                     return None  # stopped and drained
                 # coalesce-until-deadline, anchored at the OLDEST request:
@@ -291,6 +294,22 @@ class MicroBatcher:
                         return False
                 self._quiescent.wait(remaining)
             return True
+
+    def fail_pending(self, exc: Exception) -> int:
+        """Pop EVERY queued request and resolve its future with `exc` — the
+        srml-shield recovery shed: queued work gets a typed retryable error
+        the moment the supervisor restarts the worker, instead of waiting
+        out a dead consumer.  Admission stays open (the recovered worker
+        serves new traffic); returns the number of requests failed."""
+        with self._lock:
+            popped = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+        n = 0
+        for req in popped:
+            if resolve_future(req.future, exc=exc):
+                n += 1
+        return n
 
     def begin_drain(self) -> None:
         """Stop admitting; pending batches flush immediately (the worker's

@@ -31,11 +31,13 @@ import contextlib
 import logging
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .. import profiling, watch
+from ..parallel import faults
 from .batcher import (  # noqa: F401
     MicroBatcher,
     RequestTimeout,
@@ -46,33 +48,86 @@ from .entry import ServingEntry, bucket_rows, entry_for, serve_buckets
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 
-# -- lifecycle states (srml-watch health plane) -------------------------------
-# WARMING   constructing: buckets compiling, worker not yet started
-# READY     serving; SLO burn within budget
-# DEGRADED  serving, but the SLO burn fraction over the latency window
-#           exceeds SRML_SERVE_SLO_BURN (alert, don't page)
-# DRAINING  drain()/shutdown() started; new submits rejected
-# UNHEALTHY the dispatch worker is wedged (one batch in flight longer than
-#           SRML_WATCH_STALL_S): submits fail fast with ServerUnhealthy
-#           instead of backing the queue up behind a dead worker
+# -- lifecycle states (srml-watch health plane + srml-shield recovery) --------
+# WARMING    constructing: buckets compiling, worker not yet started
+# READY      serving; SLO burn within budget
+# DEGRADED   serving, but the SLO burn fraction over the latency window
+#            exceeds SRML_SERVE_SLO_BURN (alert, don't page)
+# DRAINING   drain()/shutdown() started; new submits rejected
+# UNHEALTHY  the dispatch worker is wedged or dead and the supervisor is
+#            out of restart budget: submits fail fast with ServerUnhealthy
+#            (fail over to another replica — this server will not recover
+#            by itself)
+# RECOVERING the supervisor is restarting the worker after a death or a
+#            watchdog-confirmed wedge: queued and in-flight requests were
+#            failed with the typed retryable ServerRecovering; submits
+#            fail fast with the same until the restart completes
 WARMING = "WARMING"
 READY = "READY"
 DEGRADED = "DEGRADED"
 DRAINING = "DRAINING"
 UNHEALTHY = "UNHEALTHY"
+RECOVERING = "RECOVERING"
 
-# numeric codes for the gauge surface (render_prometheus srml_health family)
-STATE_CODES = {WARMING: 0, READY: 1, DEGRADED: 2, DRAINING: 3, UNHEALTHY: 4}
+# numeric codes for the gauge surface (render_prometheus srml_health family).
+# Codes are STABLE identifiers (dashboards key on them), so RECOVERING takes
+# the next free code; severity ORDER for worst-state rollups is SEVERITY.
+STATE_CODES = {
+    WARMING: 0, READY: 1, DEGRADED: 2, DRAINING: 3, UNHEALTHY: 4,
+    RECOVERING: 5,
+}
+# least- to most-severe, for ModelRegistry.health()'s worst-state rollup
+# (RECOVERING outranks DRAINING — it is an active failure being repaired —
+# but UNHEALTHY stays worst: it means the supervisor gave up)
+SEVERITY = (WARMING, READY, DEGRADED, DRAINING, RECOVERING, UNHEALTHY)
 
 SLO_MS_ENV = "SRML_SERVE_SLO_MS"
 SLO_BURN_ENV = "SRML_SERVE_SLO_BURN"
 _DEFAULT_SLO_BURN = 0.1
 
+# -- srml-shield recovery policy (docs/robustness.md) -------------------------
+# A worker death (exception escaping the dispatch loop) or a watchdog-
+# confirmed wedge triggers a bounded-restart-with-backoff: up to
+# SRML_SERVE_MAX_RESTARTS supervised restarts per server lifetime, each
+# preceded by SRML_SERVE_RESTART_BACKOFF_S * 2^(n-1) seconds of backoff and
+# a re-warm of every bucket from the RETAINED AOT executable cache (zero
+# new steady-state compiles — gated).  Budget exhausted => UNHEALTHY, for
+# good: restart storms hide real breakage.
+MAX_RESTARTS_ENV = "SRML_SERVE_MAX_RESTARTS"
+RESTART_BACKOFF_ENV = "SRML_SERVE_RESTART_BACKOFF_S"
+_DEFAULT_MAX_RESTARTS = 3
+_DEFAULT_RESTART_BACKOFF_S = 0.05
+
+
+def _max_restarts() -> int:
+    from ..utils import env_float
+
+    return int(env_float(MAX_RESTARTS_ENV, _DEFAULT_MAX_RESTARTS))
+
+
+def _restart_backoff_s() -> float:
+    from ..utils import env_float
+
+    return env_float(RESTART_BACKOFF_ENV, _DEFAULT_RESTART_BACKOFF_S)
+
 
 class ServerUnhealthy(RuntimeError):
-    """Raised by submit() when the server's dispatch worker is wedged
-    (UNHEALTHY state): callers should fail over to another replica rather
-    than queue behind a worker that may never come back."""
+    """Raised by submit() when the server's dispatch worker is wedged or
+    the supervisor has exhausted its restart budget (UNHEALTHY state):
+    callers should fail over to another replica rather than queue behind a
+    worker that may never come back."""
+
+    retryable = True  # on ANOTHER replica, not this server
+
+
+class ServerRecovering(RuntimeError):
+    """The typed RETRYABLE error of the self-healing path: set on queued
+    and in-flight requests when the supervisor restarts the dispatch
+    worker, and raised by submit() while the restart is underway.  The
+    same request retried after the (sub-second) recovery window succeeds —
+    unlike ServerUnhealthy, the server IS coming back."""
+
+    retryable = True
 
 
 def _slo_ms() -> float:
@@ -179,6 +234,17 @@ class ModelServer:
         self._busy_since: Optional[float] = None
         self._drain_begun = False
         self._health_lock = threading.Lock()
+        # srml-shield supervisor state: restart budget spent so far, the
+        # CURRENT worker generation (a wedge recovery SUPERSEDES the stuck
+        # worker by bumping the generation — when its blocked dispatch
+        # finally returns it sees the stale generation and exits instead of
+        # double-consuming the batcher), and the in-flight batch (so a
+        # recovery can fail those requests from outside the worker thread)
+        self._restarts = 0
+        self._worker_gen = 0
+        self._inflight: Optional[list] = None
+        self._shutdown_begun = False
+        self._recovery_epoch = 0  # guards stale recoveries (see _recover)
         # one srml-scope trace session spans the server's lifetime (warmup
         # through shutdown) when SRML_TRACE_DIR is set: every queue/dispatch
         # span — recorded on the worker thread — lands in one Perfetto file.
@@ -194,19 +260,30 @@ class ModelServer:
         try:
             if warm:
                 self._warm_buckets()
-            self._worker = threading.Thread(
-                target=self._run, name=f"srml-serve-{self.name}", daemon=True
-            )
-            self._worker.start()
+            self._start_worker()
             self._state = READY
         except BaseException:
             self._trace_stack.close()
             raise
 
+    def _start_worker(self) -> int:
+        """Start a (new-generation) dispatch worker thread; returns its
+        generation.  Called at construction and by the recovery path."""
+        with self._health_lock:
+            self._worker_gen += 1
+            gen = self._worker_gen
+            worker = threading.Thread(
+                target=self._worker_main, args=(gen,),
+                name=f"srml-serve-{self.name}-g{gen}", daemon=True,
+            )
+            self._worker = worker
+        worker.start()
+        return gen
+
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self._trace_stack.close()  # idempotent
-        except Exception:
+        except Exception:  # graftlint: disable=R9 (GC-time close; logging itself can fail at interpreter teardown)
             pass
 
     # -- warmup -------------------------------------------------------------
@@ -255,15 +332,29 @@ class ModelServer:
     def submit(self, features: np.ndarray, timeout_ms: Optional[float] = None):
         """Enqueue one request ((D,) row or (n, D) block, n <= max_batch);
         returns a Future resolving to {output column: np array of n rows}.
-        Raises ServerOverloaded when the queue bound is hit and
-        ServerUnhealthy when the dispatch worker is wedged (the queue must
-        not back up behind a worker that may never return)."""
+        Raises ServerOverloaded when the queue bound is hit, ServerRecovering
+        (retryable: the supervisor is restarting the worker — retry HERE
+        after the sub-second recovery window) while a restart is underway,
+        and ServerUnhealthy when the worker is wedged with no restart
+        budget left (fail over to ANOTHER replica)."""
         age = self._check_wedged()
-        if age is not None:
+        with self._health_lock:
+            state = self._state
+        if state == RECOVERING:
+            # also the path the DETECTING submit takes when restart budget
+            # remains: _maybe_restart_wedged flips to RECOVERING
+            # synchronously, so the caller that noticed the wedge is told
+            # "retry here" — not to abandon a replica that is seconds from
+            # READY
+            raise ServerRecovering(
+                f"{self.ns}: restarting the dispatch worker after a "
+                "failure; retry shortly"
+            )
+        if age is not None or state == UNHEALTHY:
             raise ServerUnhealthy(
-                f"{self.ns}: dispatch worker wedged for {age:.1f}s "
-                f"(> SRML_WATCH_STALL_S={watch.stall_threshold_s():g}); "
-                "fail over to another replica"
+                f"{self.ns}: dispatch worker wedged for {age or 0.0:.1f}s "
+                f"(> SRML_WATCH_STALL_S={watch.stall_threshold_s():g}) "
+                "with no restart budget left; fail over to another replica"
             )
         return self._batcher.submit(features, timeout_ms=timeout_ms)
 
@@ -294,6 +385,11 @@ class ModelServer:
                 self.ns, age,
             )
             watch.dump(f"serve-wedged-{self.name}")
+            # srml-shield: the watchdog ACTS (dump + supervised restart)
+            # instead of only flagging — wedge detection is lazy (driven
+            # by submit()/state()/health() calls), so the restart launches
+            # from whichever caller noticed
+            self._maybe_restart_wedged()
         return age
 
     def predict(
@@ -307,8 +403,18 @@ class ModelServer:
             wait_s = timeout_ms / 1000.0 + 60.0  # dispatch slack
         return fut.result(timeout=wait_s)
 
-    # -- dispatch worker ----------------------------------------------------
-    def _run(self) -> None:
+    # -- dispatch worker + srml-shield supervisor ----------------------------
+    def _worker_main(self, gen: int) -> None:
+        """Worker thread top frame: a BaseException escaping the dispatch
+        loop is a WORKER DEATH (not a per-batch model error — those are
+        relayed to futures inside _dispatch) and triggers the supervised
+        restart."""
+        try:
+            self._run(gen)
+        except BaseException as exc:  # noqa: BLE001 - the supervisor catches
+            self._on_worker_death(exc, gen)
+
+    def _run(self, gen: int) -> None:
         while True:
             # the queue span covers the worker's wait for a coalesced batch:
             # in a trace, long serve.<n>.queue spans between short dispatch
@@ -321,12 +427,19 @@ class ModelServer:
             batch, _reason = item
             with self._health_lock:
                 self._busy_since = profiling.now()
+                self._inflight = batch
+            dying = True  # a BaseException escaping _dispatch = worker death
             try:
                 self._dispatch(batch)
-            except BaseException as exc:  # noqa: BLE001 - worker must survive
+                dying = False
+            except Exception as exc:  # noqa: BLE001 - worker must survive
+                dying = False
                 # _dispatch relays model errors to the batch's futures; this
                 # guard is for bookkeeping bugs (e.g. a racing future state)
-                # — one batch may be lost, the server must not wedge
+                # — one batch may be lost, the server must not wedge.
+                # BaseExceptions (InjectedWorkerDeath, interpreter teardown)
+                # deliberately ESCAPE to _worker_main: they are deaths, not
+                # batch errors.
                 logger.exception("%s: dispatch bookkeeping failed", self.ns)
                 profiling.incr_counter(f"{self.ns}.errors")
                 rec = watch.recorder()
@@ -339,23 +452,232 @@ class ModelServer:
                     )
             finally:
                 with self._health_lock:
-                    self._busy_since = None
-                    recovered = self._state == UNHEALTHY
-                    if recovered:
-                        # the wedged dispatch came back after all: recover —
-                        # UNHEALTHY describes the worker, not history (but a
-                        # drain that began meanwhile stays a drain)
-                        self._state = (
-                            DRAINING if self._drain_begun else READY
-                        )
+                    superseded = self._worker_gen != gen
+                    recovered = False
+                    if not superseded and not dying:
+                        # on the DEATH path _inflight must survive this
+                        # finally: _on_worker_death fails those futures
+                        # with the typed retryable error
+                        self._busy_since = None
+                        self._inflight = None
+                        recovered = self._state == UNHEALTHY
+                        if recovered:
+                            # the wedged dispatch came back after all (no
+                            # restart budget was left, so no supersede):
+                            # recover — UNHEALTHY describes the worker, not
+                            # history (but a drain that began meanwhile
+                            # stays a drain)
+                            self._state = (
+                                DRAINING if self._drain_begun else READY
+                            )
                 if recovered:
                     profiling.incr_counter(f"{self.ns}.recovered")
                     logger.warning(
                         "%s: wedged dispatch returned; %s",
                         self.ns, self._state,
                     )
+            if self._worker_gen != gen:
+                # a wedge recovery superseded this worker while its dispatch
+                # was blocked: a new generation owns the batcher now — exit
+                # instead of double-consuming (the blocked batch's futures
+                # were already failed with ServerRecovering; resolve_future
+                # made this worker's late scatter a harmless no-op)
+                logger.warning(
+                    "%s: superseded worker generation %d exiting after its "
+                    "blocked dispatch returned", self.ns, gen,
+                )
+                return
+
+    # -- the supervisor: bounded restart with backoff -------------------------
+    def _on_worker_death(self, exc: BaseException, gen: int) -> None:
+        """The dispatch worker died (exception escaped its loop).  Fail the
+        in-flight batch with the typed retryable error, then run the
+        bounded-restart policy."""
+        profiling.incr_counter(f"{self.ns}.worker_deaths")
+        logger.error("%s: dispatch worker died: %s: %s",
+                     self.ns, type(exc).__name__, exc)
+        rec = watch.recorder()
+        if rec is not None:
+            rec.record_exception(exc, f"serve-{self.name}")
+        watch.dump(f"serve-died-{self.name}")
+        with self._health_lock:
+            if self._worker_gen != gen:
+                return  # already superseded by a wedge recovery
+            inflight, self._inflight = self._inflight, None
+            self._busy_since = None
+        for r in inflight or []:
+            resolve_future(
+                r.future,
+                exc=ServerRecovering(
+                    f"{self.ns}: dispatch worker died mid-batch; retry"
+                ),
+            )
+        self._recover("worker-death")
+
+    def _maybe_restart_wedged(self) -> None:
+        """Wedge half of the supervisor: SUPERSEDE the stuck worker (bump
+        the generation; its eventual return becomes a no-op exit), fail its
+        in-flight batch, and restart — on a helper thread, because the
+        caller is a client inside submit()/health()."""
+        with self._health_lock:
+            if self._state != UNHEALTHY or self._drain_begun:
+                return
+            if self._restarts >= _max_restarts():
+                return  # budget spent: stay UNHEALTHY (legacy lazy-recover
+                #         path still applies if the dispatch ever returns)
+            # flip RECOVERING synchronously so the caller that DETECTED the
+            # wedge (this very submit/state call) already reports the
+            # retryable "restarting" verdict, not fail-over
+            self._state = RECOVERING
+            self._worker_gen += 1
+            inflight, self._inflight = self._inflight, None
+            self._busy_since = None
+        threading.Thread(
+            target=self._wedge_recovery, args=(inflight,),
+            name=f"srml-serve-{self.name}-recover", daemon=True,
+        ).start()
+
+    def _wedge_recovery(self, inflight) -> None:
+        for r in inflight or []:
+            resolve_future(
+                r.future,
+                exc=ServerRecovering(
+                    f"{self.ns}: dispatch wedged past the stall threshold; "
+                    "worker superseded — retry"
+                ),
+            )
+        self._recover("wedged-dispatch")
+
+    def _recover(self, reason: str) -> None:
+        """Bounded-restart-with-backoff: shed everything queued with the
+        typed retryable error (never a hang), back off, re-warm every
+        bucket from the RETAINED AOT executable cache (zero new compiles —
+        a recovery that would have to compile is a recovery into a cold
+        replica, which defeats the SLO), then start a new worker
+        generation.  Budget exhausted => UNHEALTHY, permanently.  A
+        recovery racing drain()/shutdown() sheds (so quiescence resolves)
+        but never restarts — a shut-down server must not resurrect a
+        worker or report READY."""
+        t0 = profiling.now()
+        with self._health_lock:
+            aborting = self._drain_begun or self._shutdown_begun
+            if aborting:
+                budget_spent = False
+                attempt = self._restarts
+            elif self._restarts >= _max_restarts():
+                self._state = UNHEALTHY
+                budget_spent = True
+                attempt = self._restarts
+            else:
+                self._restarts += 1
+                attempt = self._restarts
+                self._state = RECOVERING
+                budget_spent = False
+            self._recovery_epoch += 1
+            my_epoch = self._recovery_epoch
+        shed = self._batcher.fail_pending(
+            ServerRecovering(
+                f"{self.ns}: recovering from {reason}; retry shortly"
+            )
+        )
+        if shed:
+            profiling.incr_counter(f"{self.ns}.shed_recovery", shed)
+        if aborting:
+            logger.warning(
+                "%s: %s during drain/shutdown — shed %d request(s), no "
+                "restart", self.ns, reason, shed,
+            )
+            return
+        if budget_spent:
+            logger.error(
+                "%s: %s after %d restart(s) — budget (%s=%d) exhausted; "
+                "UNHEALTHY until replaced",
+                self.ns, reason, attempt, MAX_RESTARTS_ENV, _max_restarts(),
+            )
+            return
+        time.sleep(_restart_backoff_s() * (2 ** (attempt - 1)))
+        try:
+            self._rewarm()
+        except BaseException:  # noqa: BLE001 - a broken model must not loop
+            logger.exception(
+                "%s: bucket re-warm failed during recovery — UNHEALTHY",
+                self.ns,
+            )
+            with self._health_lock:
+                self._state = UNHEALTHY
+            return
+        with self._health_lock:
+            # a recovery superseded while it was re-warming (another wedge
+            # escalation consumed the budget, or shutdown began) must not
+            # resurrect a worker or clobber a terminal state.  The check,
+            # the worker-generation reservation, AND the state transition
+            # share ONE lock acquisition: a shutdown landing between them
+            # would otherwise get its worker resurrected and its state
+            # flipped READY after teardown.
+            stale = (
+                self._recovery_epoch != my_epoch
+                or self._shutdown_begun
+                or self._state == UNHEALTHY
+            )
+            if not stale:
+                self._worker_gen += 1
+                gen = self._worker_gen
+                worker = threading.Thread(
+                    target=self._worker_main, args=(gen,),
+                    name=f"srml-serve-{self.name}-g{gen}", daemon=True,
+                )
+                self._worker = worker
+                self._state = DRAINING if self._drain_begun else READY
+        if stale:
+            logger.warning(
+                "%s: recovery #%d superseded during re-warm; standing down",
+                self.ns, attempt,
+            )
+            return
+        worker.start()
+        dt = profiling.now() - t0
+        profiling.incr_counter(f"{self.ns}.restarts")
+        profiling.record_duration(f"serve.{self.name}.recovery", dt)
+        logger.warning(
+            "%s: recovered from %s via supervised restart #%d in %.1f ms "
+            "(buckets re-warmed from the retained AOT cache)",
+            self.ns, reason, attempt, dt * 1e3,
+        )
+
+    def _rewarm(self) -> None:
+        """One synthetic batch per bucket through the FULL dispatch path on
+        the recovery thread.  The AOT executable cache survives the worker,
+        so this performs ZERO new compiles (gated) — it exists to verify
+        the model can still dispatch, so a restart into a broken model
+        burns its budget HERE, not on live traffic.  Wrapped in _warm_scope
+        so any compile that somehow happens is never attributed to a
+        concurrently-dispatching server's steady state.  busy_since is set
+        for its duration so a model that HANGS in the re-warm is visible to
+        the same wedge detector as a hung dispatch: _check_wedged flips the
+        server out of RECOVERING (whose submit error says "retry here")
+        into UNHEALTHY ("fail over"), escalating until the restart budget
+        is gone instead of advertising a recovery that never lands."""
+        with self._health_lock:
+            self._busy_since = profiling.now()
+        try:
+            with _warm_scope(), self._x64_scope(), profiling.span(
+                f"serve.{self.name}.rewarm", buckets=len(self.buckets)
+            ):
+                for b in self.buckets:
+                    synth = np.zeros(
+                        (b, self._entry.n_cols), dtype=self._entry.dtype
+                    )
+                    self._entry.call(synth)
+        finally:
+            with self._health_lock:
+                self._busy_since = None
 
     def _dispatch(self, batch) -> None:
+        # srml-shield: the serving injection site (tag = server name, so a
+        # plan targets ONE server deterministically).  kill here raises
+        # InjectedWorkerDeath — a BaseException that escapes the per-batch
+        # Exception guard and lands in _worker_main as a worker death.
+        faults.site("serving.dispatch", tag=self.name)
         n_rows = sum(r.n_rows for r in batch)
         b = bucket_rows(n_rows, self._batcher.max_batch)
         padded = np.zeros((b, self._entry.n_cols), dtype=self._entry.dtype)
@@ -433,6 +755,10 @@ class ModelServer:
             )
 
     def shutdown(self, drain: bool = True, timeout_s: float = 60.0) -> None:
+        with self._health_lock:
+            # any in-flight recovery observes this and stands down instead
+            # of resurrecting a worker on a server being torn down
+            self._shutdown_begun = True
         try:
             if drain:
                 try:
@@ -510,6 +836,7 @@ class ModelServer:
                 round(profiling.now() - busy, 3) if busy is not None else 0.0
             ),
             "steady_compiles": self._steady_compiles,
+            "restarts": self._restarts,
         }
 
     def stats(self) -> Dict[str, Any]:
@@ -534,5 +861,6 @@ class ModelServer:
             "dispatch": disp,
             "batch_occupancy": occ,
             "steady_compiles": self._steady_compiles,
+            "restarts": self._restarts,
             **({"info": self._entry.info} if self._entry.info else {}),
         }

@@ -15,7 +15,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
-from .engine import STATE_CODES, WARMING, ModelServer
+from .engine import SEVERITY, STATE_CODES, WARMING, ModelServer
 
 
 class ModelRegistry:
@@ -126,12 +126,22 @@ class ModelRegistry:
                 }
             else:
                 models[name] = server.health()
+        # worst-state rollup over the SEVERITY order (not the stable gauge
+        # codes): one wedged worker turns the whole plane's headline red,
+        # and a RECOVERING server outranks a draining one
         worst = max(
             (m["state"] for m in models.values()),
-            key=lambda s: STATE_CODES[s],
+            key=SEVERITY.index,
             default=WARMING,  # an empty registry is not unhealthy, just idle
         )
-        return {"state": worst, "models": models}
+        return {
+            "state": worst,
+            # srml-shield rollup: total supervised restarts across the
+            # plane — a restart-storm signal no single server's counter
+            # shows (docs/robustness.md)
+            "restarts": sum(m.get("restarts", 0) for m in models.values()),
+            "models": models,
+        }
 
     def _health_gauges(self) -> Dict[str, float]:
         """Gauge-provider view of health() for export_metrics()/Prometheus:

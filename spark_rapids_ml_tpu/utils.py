@@ -11,10 +11,22 @@
 from __future__ import annotations
 
 import logging
+import os
 import sys
 from typing import Any, Iterator, List, Optional, Union
 
 import numpy as np
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with a default on unset/empty/garbage — the ONE
+    parse-env-with-fallback helper (watch, serving, and the control plane
+    each grew a private copy before this; a future tweak to the parsing
+    must land once)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def get_logger(cls: Union[type, str], level: int = logging.INFO) -> logging.Logger:

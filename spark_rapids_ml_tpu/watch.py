@@ -71,11 +71,7 @@ _DEFAULT_MAX_DUMPS = 32
 _DEFAULT_HEARTBEAT_S = 1.0
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+from .utils import env_float as _env_float  # noqa: E402 - knob parsing
 
 
 def stall_threshold_s() -> float:
@@ -360,6 +356,22 @@ _install_lock = threading.Lock()
 def recorder() -> Optional[FlightRecorder]:
     """The installed process-wide recorder (None when SRML_WATCH=0)."""
     return _recorder
+
+
+def failing_span() -> Optional[str]:
+    """The calling thread's innermost FAILING span (the first span that
+    closed with an error in flight), falling back to its innermost OPEN
+    span, or None without a recorder.  This is what the srml-shield abort
+    marker names: when TpuContext.__exit__ broadcasts an abort, surviving
+    ranks' RemoteRankError quotes this span — "rank 1 failed in
+    exchange.ring" — instead of a bare exception type."""
+    err = getattr(_wtls, "err_span", None)
+    if err is not None:
+        return err
+    rec = _recorder
+    if rec is None:
+        return None
+    return rec.innermost()
 
 
 def install() -> Optional[FlightRecorder]:

@@ -1,0 +1,69 @@
+# One rank of the srml-shield chaos matrix: a real OS process doing
+# control-plane gather rounds over a FileControlPlane while SRML_FAULTS
+# (inherited from the driver test's environment) kills / aborts one of the
+# cohort mid-round.  Exit codes are the protocol:
+#
+#    0  clean run (all rounds completed, teardown clean)
+#    7  survivor: raised RemoteRankError naming a dead/aborted peer
+#    9  victim of action=raise: published its abort marker and exited
+#   17  victim of action=die (faults.DIE_EXIT_CODE): os._exit, no teardown
+#
+# Survivors print one machine-readable line:
+#   SHIELD rank=<me> culprit=<rank> dt=<seconds-to-detect> span=<span> etype=<t>
+# where dt measures entry-into-the-failing-gather -> RemoteRankError — the
+# abort-latency the ISSUE bounds at < 10 s (vs the 300 s round timeout).
+#
+# Invoked as: python chaos_worker.py <rank> <nranks> <jobdir> [rounds]
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from spark_rapids_ml_tpu.parallel.context import RemoteRankError  # noqa: E402
+from spark_rapids_ml_tpu.parallel.faults import FaultInjected  # noqa: E402
+from spark_rapids_ml_tpu.parallel.runner import FileControlPlane  # noqa: E402
+
+
+def main() -> None:
+    rank, nranks, root = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    rounds = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    cp = FileControlPlane(
+        os.path.join(root, "cp"), rank, nranks, timeout=120, poll=0.02
+    )
+    t0 = time.monotonic()
+    try:
+        for r in range(rounds):
+            t0 = time.monotonic()
+            got = cp.allGather(f"rank{rank}:round{r}")
+            assert len(got) == nranks, got
+    except RemoteRankError as exc:
+        dt = time.monotonic() - t0
+        print(
+            f"SHIELD rank={rank} culprit={exc.rank} dt={dt:.3f} "
+            f"span={exc.span} etype={exc.etype}",
+            flush=True,
+        )
+        cp.close()
+        sys.exit(7)
+    except FaultInjected as exc:
+        # the orderly victim: publish the abort marker the way
+        # TpuContext.__exit__ does on the exception path, then leave
+        import json
+
+        cp.abort(json.dumps({
+            "rank": rank,
+            "etype": type(exc).__name__,
+            "message": str(exc),
+            "span": "chaos.gather",
+        }))
+        cp.close()
+        sys.exit(9)
+    print(f"SHIELD rank={rank} clean", flush=True)
+    cp.close()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -82,6 +82,23 @@ def n_devices():
     return jax.device_count()
 
 
+@pytest.fixture
+def armed_faults(monkeypatch):
+    """Arm an SRML_FAULTS plan for ONE test: `armed_faults(spec)` sets the
+    env var and reloads the faults module's plan (arrival counters reset
+    with it); teardown disarms and reloads so the suite's unarmed-path
+    invariant (faults.plan() is None) holds for every other test."""
+    from spark_rapids_ml_tpu.parallel import faults
+
+    def arm(spec: str):
+        monkeypatch.setenv(faults.FAULTS_ENV, spec)
+        return faults.reload()
+
+    yield arm
+    monkeypatch.delenv(faults.FAULTS_ENV, raising=False)
+    faults.reload()
+
+
 @pytest.fixture(scope="session")
 def model_zoo():
     """Lazily-fitted tiny models over one shared dataset, keyed by arm name

@@ -36,6 +36,7 @@ def main() -> None:
         d = np.zeros((0, job["k"]), np.float32)
         i = np.zeros((0, job["k"]), np.int64)
     np.savez(os.path.join(root, f"knn_out_{rank}.npz"), d=d, i=i)
+    cp.close()  # srml-shield teardown: no orphan presence files
 
 
 if __name__ == "__main__":

@@ -1,0 +1,441 @@
+# srml-shield gates (docs/robustness.md), in ISSUE order:
+#   - FaultPlan grammar: strict parsing, rank/call/tag selection, actions
+#   - unarmed path: SRML_FAULTS unset => site() is ONE module-global None
+#     check — no env read, no plan lookup, no measurable per-call cost
+#     (structural, same style as test_watch's overhead gate)
+#   - abort-marker protocol: a rank publishing abort-r<k> makes every
+#     peer's in-flight gather raise RemoteRankError naming the origin
+#     rank, exception type, and failing span within ~one poll interval
+#   - dead-peer detection: the CHAOS MATRIX — real OS processes, one
+#     killed mid-collective by the fault plan, survivors raise
+#     RemoteRankError naming the dead rank in < 10 s (vs the 300 s round
+#     timeout), teardown clean, no orphan alive/heartbeat files
+#   - TpuContext abort-vs-clean __exit__ (the NCCL abort/destroy contract)
+#   - control-plane I/O retries with exponential backoff + jitter
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import profiling
+from spark_rapids_ml_tpu.parallel import faults
+from spark_rapids_ml_tpu.parallel.context import RemoteRankError, TpuContext
+from spark_rapids_ml_tpu.parallel.runner import FileControlPlane
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- grammar ------------------------------------------------------------------
+
+
+def test_plan_grammar_single_spec():
+    plan = faults.parse_plan("cp.gather:rank=1:call=2:action=die")
+    assert plan is not None and len(plan.specs) == 1
+    s = plan.specs[0]
+    assert (s.site, s.rank, s.call, s.action) == ("cp.gather", 1, 2, "die")
+
+
+def test_plan_grammar_multi_spec_and_defaults():
+    plan = faults.parse_plan(
+        "cp.barrier:rank=0:delay=2.5;"
+        "serving.dispatch:tag=km:action=kill;"
+        "exchange.ring_pass:action=corrupt"
+    )
+    assert [s.site for s in plan.specs] == [
+        "cp.barrier", "serving.dispatch", "exchange.ring_pass",
+    ]
+    barrier, dispatch, ring = plan.specs
+    assert barrier.action == "delay" and barrier.delay_s == 2.5  # shorthand
+    assert dispatch.tag == "km" and dispatch.rank is None
+    assert ring.call is None  # every arrival
+
+
+def test_plan_grammar_is_strict():
+    # a typo'd plan must fail LOUDLY: a chaos gate that silently disarms
+    # passes vacuously forever
+    with pytest.raises(ValueError, match="unknown action"):
+        faults.parse_plan("cp.gather:action=explode")
+    with pytest.raises(ValueError, match="no action"):
+        faults.parse_plan("cp.gather:rank=1")
+    with pytest.raises(ValueError, match="unknown field"):
+        faults.parse_plan("cp.gather:frequency=2:action=die")
+    with pytest.raises(ValueError, match="delay="):
+        faults.parse_plan("cp.gather:action=delay")
+    assert faults.parse_plan(None) is None
+    assert faults.parse_plan("  ") is None
+
+
+# -- unarmed zero-overhead path -----------------------------------------------
+
+
+def test_unarmed_site_is_a_single_none_check(monkeypatch):
+    """Tier-1 runs with SRML_FAULTS unset: plan() must be None, site() must
+    never re-read the env or touch a plan, and the per-call cost over an
+    empty function must be negligible (structural bound, test_watch
+    style — the unarmed path is the one EVERY production collective round
+    pays)."""
+    assert faults.plan() is None  # the suite-wide invariant
+    loads = []
+    monkeypatch.setattr(faults, "_load", lambda: loads.append(1))
+    for _ in range(64):
+        assert faults.site("cp.gather", rank=0, payload=b"x") == b"x"
+    assert not loads  # no env re-read, no plan construction
+
+    N = 20000
+
+    def bench(fn):
+        t0 = profiling.now()
+        for _ in range(N):
+            fn("cp.gather")
+        return (profiling.now() - t0) / N
+
+    def empty(_name):
+        return None
+
+    site_cost = min(bench(faults.site) for _ in range(3))
+    base = min(bench(empty) for _ in range(3))
+    added = max(site_cost - base, 0.0)
+    # 10k site arrivals (far more than any fit performs) must add < 5 ms
+    assert added * 10_000 < 0.005, (
+        f"unarmed faults.site adds {added * 1e9:.0f} ns/call — the "
+        "disabled path must be a bare None check"
+    )
+
+
+def test_armed_plan_selects_by_rank(armed_faults):
+    armed_faults("cp.gather:rank=1:action=raise")
+    assert faults.site("cp.gather", rank=0, payload=b"a") == b"a"
+    assert faults.site("cp.gather", rank=2, payload=b"a") == b"a"
+    with pytest.raises(faults.FaultInjected, match="cp.gather"):
+        faults.site("cp.gather", rank=1)
+
+
+def test_armed_plan_counts_arrivals_per_site_and_tag(armed_faults):
+    """call=N fires on the Nth arrival of that (site, tag) counter — in
+    the real topology each rank is its own process, so the counter IS the
+    per-rank arrival count; tags give in-process callers (serving: one per
+    server name) independent counters."""
+    armed_faults("serving.dispatch:tag=srv_a:call=2:action=raise")
+    faults.site("serving.dispatch", tag="srv_b")  # other tag: own counter
+    faults.site("serving.dispatch", tag="srv_a")  # call 1: no fire
+    with pytest.raises(faults.FaultInjected, match="serving.dispatch"):
+        faults.site("serving.dispatch", tag="srv_a")  # call 2: fires
+    faults.site("serving.dispatch", tag="srv_a")  # call 3: done firing
+    assert faults.plan().counts()[("serving.dispatch", "srv_a")] == 3
+
+
+def test_action_delay_and_corrupt(armed_faults):
+    armed_faults("cp.barrier:delay=0.05;exchange.ring_pass:action=corrupt")
+    t0 = time.monotonic()
+    faults.site("cp.barrier", rank=0)
+    assert time.monotonic() - t0 >= 0.045
+    payload = b"SRX1" + b"\x00" * 32
+    corrupted = faults.site("exchange.ring_pass", rank=0, payload=payload)
+    assert corrupted != payload and len(corrupted) == len(payload)
+    assert corrupted[:4] != b"SRX1"  # the magic is dead: decoders fail loudly
+    # corrupt with nothing to corrupt degrades to the orderly failure
+    with pytest.raises(faults.FaultInjected):
+        faults.site("exchange.ring_pass", rank=0)
+
+
+def test_action_kill_is_a_base_exception(armed_faults):
+    armed_faults("serving.dispatch:action=kill")
+    with pytest.raises(faults.InjectedWorkerDeath):
+        faults.site("serving.dispatch", tag="x")
+    assert not issubclass(faults.InjectedWorkerDeath, Exception)  # escapes
+    #   per-batch `except Exception` relays by design
+
+
+# -- abort-marker protocol (threads over one FileControlPlane root) -----------
+
+
+def _plane(root, rank, nranks, timeout=30.0, poll=0.02):
+    return FileControlPlane(str(root), rank, nranks, timeout=timeout, poll=poll)
+
+
+def test_abort_marker_interrupts_gather_within_poll_interval(tmp_path):
+    """Rank 1 publishes an abort marker while ranks 0/2 wait in a gather:
+    both must raise RemoteRankError naming rank 1, its exception type, and
+    its failing span — in ~one poll interval, nowhere near the round
+    timeout."""
+    results = {}
+
+    def survivor(rank):
+        cp = _plane(tmp_path, rank, 3, timeout=30.0)
+        t0 = time.monotonic()
+        try:
+            cp.allGather(f"hello-{rank}")
+        except RemoteRankError as exc:
+            results[rank] = (exc, time.monotonic() - t0)
+
+    threads = [
+        threading.Thread(target=survivor, args=(r,), name=f"shield-r{r}")
+        for r in (0, 2)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)  # both are now waiting on rank 1's round file
+    aborter = _plane(tmp_path, 1, 3)
+    aborter.abort(json.dumps({
+        "rank": 1,
+        "etype": "ValueError",
+        "message": "induced failure",
+        "span": "exchange.ring",
+    }))
+    for t in threads:
+        t.join(timeout=10.0)
+    assert set(results) == {0, 2}, "survivors never raised"
+    for rank, (exc, dt) in results.items():
+        assert exc.rank == 1 and exc.etype == "ValueError"
+        assert exc.span == "exchange.ring"
+        assert "rank 1" in str(exc) and "exchange.ring" in str(exc)
+        assert dt < 5.0, f"rank {rank} took {dt:.1f}s — not a fast abort"
+
+
+def test_corrupted_ring_frame_fails_loudly_at_the_receiver(
+    tmp_path, armed_faults
+):
+    """exchange.ring_pass corruption: the receiver's SRX1 codec must raise
+    on the flipped magic, never decode garbage into candidate lists."""
+    from spark_rapids_ml_tpu.parallel.exchange import (
+        pack_arrays, ring_pass_bytes, unpack_arrays,
+    )
+
+    armed_faults("exchange.ring_pass:rank=0:action=corrupt")
+    payloads = {
+        r: pack_arrays([np.full((4,), r, np.float32)]) for r in range(2)
+    }
+    results, errors = {}, {}
+
+    def hop(rank):
+        cp = _plane(tmp_path, rank, 2, timeout=30.0)
+        try:
+            got = ring_pass_bytes(cp, rank, 2, payloads[rank])
+            results[rank] = unpack_arrays(got)
+        except ValueError as exc:
+            errors[rank] = exc
+
+    threads = [
+        threading.Thread(target=hop, args=(r,), name=f"ring-r{r}")
+        for r in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    # rank 1 receives rank 0's corrupted frame -> loud SRX1 failure;
+    # rank 0 receives rank 1's intact frame
+    assert 1 in errors and "SRX1" in str(errors[1])
+    np.testing.assert_array_equal(
+        results[0][0], np.full((4,), 1, np.float32)
+    )
+
+
+# -- TpuContext abort-vs-clean ------------------------------------------------
+
+
+class _RecordingPlane:
+    """Gather-capable fake with the abort surface, for __exit__ testing
+    without a jax.distributed bootstrap."""
+
+    def __init__(self):
+        self.aborts = []
+
+    def allGather(self, message):
+        return [message]
+
+    def barrier(self):
+        return None
+
+    def abort(self, payload):
+        self.aborts.append(json.loads(payload))
+
+
+def test_context_exit_broadcasts_abort_on_exception_only():
+    cp = _RecordingPlane()
+    ctx = TpuContext(rank=1, nranks=2, control_plane=cp)
+    # clean path: destroy-like, NO abort marker
+    ctx.__exit__(None, None, None)
+    assert cp.aborts == []
+    # exception path: abort-like — the marker carries the encoded exception
+    err = ValueError("solver diverged")
+    ctx.__exit__(ValueError, err, None)
+    assert len(cp.aborts) == 1
+    marker = cp.aborts[0]
+    assert marker["rank"] == 1 and marker["etype"] == "ValueError"
+    assert "solver diverged" in marker["message"]
+
+
+def test_context_exit_never_rebroadcasts_a_relayed_abort():
+    """A RemoteRankError unwinding through __exit__ is a RELAYED abort:
+    re-publishing it would cascade markers around the ring and misname the
+    culprit on every survivor."""
+    cp = _RecordingPlane()
+    ctx = TpuContext(rank=0, nranks=2, control_plane=cp)
+    err = RemoteRankError(rank=1, message="died", span="runner.fit")
+    ctx.__exit__(RemoteRankError, err, None)
+    assert cp.aborts == []
+
+
+def test_context_exit_single_controller_is_noop():
+    cp = _RecordingPlane()
+    ctx = TpuContext(rank=0, nranks=1, control_plane=cp)
+    ctx.__exit__(RuntimeError, RuntimeError("x"), None)
+    assert cp.aborts == []  # no peers to warn
+
+
+# -- retry-with-backoff -------------------------------------------------------
+
+
+def test_cp_io_retries_with_backoff(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRML_CP_RETRIES", "3")
+    monkeypatch.setenv("SRML_CP_BACKOFF_S", "0.01")
+    cp = _plane(tmp_path, 0, 1)
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient NFS burp")
+        return "ok"
+
+    before = profiling.counter("cp.io_retries")
+    assert cp._retry_io(flaky, "flaky") == "ok"
+    assert len(attempts) == 3
+    assert profiling.counter("cp.io_retries") - before == 2
+
+    def always():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        cp._retry_io(always, "always")
+
+
+def test_round_timeout_is_bounded_and_names_the_knob(tmp_path):
+    cp = _plane(tmp_path, 0, 2, timeout=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="SRML_CP_ROUND_TIMEOUT_S"):
+        cp.allGather("alone")
+    assert time.monotonic() - t0 < 5.0  # per-ROUND budget, not session-wide
+
+
+def test_close_removes_presence_files(tmp_path):
+    cp = _plane(tmp_path, 0, 2)
+    cp.publish_health('{"rank": 0}')
+    assert os.path.exists(cp._alive_path(0))
+    cp.close()
+    leftovers = [
+        f for f in os.listdir(tmp_path)
+        if f.startswith(("alive_", "health_"))
+    ]
+    assert leftovers == []
+
+
+# -- the chaos matrix: real OS processes --------------------------------------
+
+
+def _spawn_chaos(root, nranks, env_extra, rounds=4):
+    env = dict(os.environ)
+    env.pop("SRML_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.update(env_extra)
+    return [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "chaos_worker.py"),
+             str(r), str(nranks), str(root), str(rounds)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(nranks)
+    ]
+
+
+def _communicate_all(procs, timeout=240):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out += "\n<TIMEOUT: killed by driver>"
+        outs.append(out)
+    return outs
+
+
+def _shield_line(out):
+    for line in out.splitlines():
+        if line.startswith("SHIELD "):
+            return dict(
+                kv.split("=", 1) for kv in line.split()[1:] if "=" in kv
+            )
+    return None
+
+
+def test_chaos_clean_run_leaves_no_control_plane_orphans(tmp_path):
+    """3 real OS processes, no faults: every rank completes every round and
+    teardown leaves no alive/heartbeat file behind."""
+    procs = _spawn_chaos(tmp_path, nranks=3, env_extra={})
+    outs = _communicate_all(procs)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+    leftovers = [
+        f for f in os.listdir(tmp_path / "cp")
+        if f.startswith(("alive_", "health_", "abort-"))
+    ]
+    assert leftovers == []
+
+
+def test_chaos_killed_rank_names_culprit_in_seconds(tmp_path):
+    """THE acceptance gate: rank 1 of 3 dies (os._exit — the SIGKILL shape:
+    no marker, no teardown) on its 3rd gather.  Both survivors must raise
+    RemoteRankError NAMING rank 1 in < 10 s (the unshielded behavior was a
+    300 s TimeoutError naming nobody), and their teardown must reap every
+    alive/heartbeat file including the dead rank's."""
+    procs = _spawn_chaos(
+        tmp_path, nranks=3,
+        env_extra={"SRML_FAULTS": "cp.gather:rank=1:call=3:action=die"},
+    )
+    outs = _communicate_all(procs)
+    from spark_rapids_ml_tpu.parallel.faults import DIE_EXIT_CODE
+
+    assert procs[1].returncode == DIE_EXIT_CODE, outs[1]
+    for r in (0, 2):
+        assert procs[r].returncode == 7, f"rank {r}:\n{outs[r]}"
+        info = _shield_line(outs[r])
+        assert info is not None, outs[r]
+        assert info["culprit"] == "1"
+        assert float(info["dt"]) < 10.0, (
+            f"rank {r} took {info['dt']}s to notice the dead rank"
+        )
+    leftovers = [
+        f for f in os.listdir(tmp_path / "cp")
+        if f.startswith(("alive_", "health_"))
+    ]
+    assert leftovers == [], "survivor teardown left orphan presence files"
+
+
+def test_chaos_orderly_abort_carries_span_and_etype(tmp_path):
+    """action=raise on rank 2 of 3: the victim publishes its abort marker
+    (the TpuContext exception-path contract) and the survivors'
+    RemoteRankError names the origin rank, its exception type, AND the
+    failing span from the marker."""
+    procs = _spawn_chaos(
+        tmp_path, nranks=3,
+        env_extra={"SRML_FAULTS": "cp.gather:rank=2:call=2:action=raise"},
+    )
+    outs = _communicate_all(procs)
+    assert procs[2].returncode == 9, outs[2]  # orderly victim
+    for r in (0, 1):
+        assert procs[r].returncode == 7, f"rank {r}:\n{outs[r]}"
+        info = _shield_line(outs[r])
+        assert info["culprit"] == "2"
+        assert info["etype"] == "FaultInjected"
+        assert info["span"] == "chaos.gather"
+        assert float(info["dt"]) < 10.0

@@ -629,9 +629,14 @@ def test_r8_fires_on_remote_copy_outside_exchange():
 
 
 def test_r8_remote_copy_allowed_in_exchange():
+    # rules=["R8"]: the fixture's bare copy.wait() is R9 material at this
+    # path (the real exchange.py pragmas it with the DMA-has-no-timeout
+    # reason); this test is about R8 confinement only
     assert (
         _lint(
-            R8_REMOTE_OUTSIDE, path="spark_rapids_ml_tpu/parallel/exchange.py"
+            R8_REMOTE_OUTSIDE,
+            path="spark_rapids_ml_tpu/parallel/exchange.py",
+            rules=["R8"],
         )
         == []
     )
@@ -664,6 +669,85 @@ def test_r8_pragma_escape():
             return dma
     """
     assert _lint(src, path="spark_rapids_ml_tpu/ops/x.py") == []
+
+
+# -- R9: unbounded waits + silent teardown swallows ---------------------------
+
+R9_BAD_WAITS = """
+    def collect(fut, lock, worker):
+        out = fut.result()
+        lock.acquire()
+        worker.join()
+        return out
+"""
+
+R9_BAD_SWALLOW = """
+    def teardown(ctx):
+        try:
+            ctx.shutdown()
+        except Exception:
+            pass
+"""
+
+R9_GOOD = """
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    def collect(fut, lock, worker, parts, cond, remaining):
+        out = fut.result(timeout=30.0)
+        lock.acquire(timeout=1.0)
+        worker.join(5.0)
+        cond.wait(remaining)      # a deadline variable bounds it
+        joined = "".join(parts)   # str.join always takes its iterable
+        return out, joined
+
+    def teardown(ctx):
+        try:
+            ctx.shutdown()
+        except Exception as exc:
+            log.warning("shutdown failed: %s", exc)  # logged, not swallowed
+        try:
+            ctx.unlink()
+        except OSError:
+            pass  # narrow handler: deliberate, in scope of the except type
+"""
+
+
+def test_r9_fires_on_unbounded_waits_in_parallel_and_serving():
+    for path in (
+        "spark_rapids_ml_tpu/parallel/runner.py",
+        "spark_rapids_ml_tpu/serving/engine.py",
+    ):
+        findings = _lint(R9_BAD_WAITS, path=path)
+        assert _rules_of(findings) == ["R9"]
+        assert len(findings) == 3  # result, acquire, join
+        assert "timeout" in findings[0].message
+
+
+def test_r9_fires_on_silent_broad_swallow():
+    findings = _lint(R9_BAD_SWALLOW, path="spark_rapids_ml_tpu/parallel/context.py")
+    assert _rules_of(findings) == ["R9"]
+    assert "logged event" in findings[0].message
+
+
+def test_r9_silent_on_bounded_waits_logged_handlers_and_narrow_types():
+    assert _lint(R9_GOOD, path="spark_rapids_ml_tpu/serving/batcher.py") == []
+
+
+def test_r9_scoped_to_parallel_and_serving():
+    # solver/engine modules block only on the device runtime — out of scope
+    assert _lint(R9_BAD_WAITS, path="spark_rapids_ml_tpu/ops/knn.py") == []
+    assert _lint(R9_BAD_SWALLOW, path="spark_rapids_ml_tpu/watch.py") == []
+    assert _lint(R9_BAD_WAITS, path="benchmark/bench_serving.py") == []
+
+
+def test_r9_pragma_escape():
+    src = """
+        def hop(copy):
+            copy.wait()  # graftlint: disable=R9 (DMA completion has no timeout)
+    """
+    assert _lint(src, path="spark_rapids_ml_tpu/parallel/exchange.py") == []
 
 
 # -- the gate: the real tree is clean -----------------------------------------

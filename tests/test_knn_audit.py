@@ -1,0 +1,49 @@
+# Hardware kNN audit promoted into the slow suite (ISSUE 10 satellite,
+# VERDICT next #8): the float64 ground-truth check that caught the round-5
+# excess-precision regression now runs on every TPU hardware CI pass
+# (ci/test.sh SRML_CI_FULL) instead of only when someone remembers to run
+# benchmark/audit_knn.py by hand.  Capability-probed: on CPU backends the
+# audit targets Mosaic/XLA *hardware* lowering differences the virtual mesh
+# cannot exhibit, so it skips cleanly.
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _tpu_backend() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend init failure = no TPU
+        return False
+
+
+def test_hardware_knn_audit_against_f64_ground_truth():
+    """Both adaptive-kNN verification routes (pool-resident self-verify and
+    the SRML_KNN_AUDIT_COUNT bitwise count pair) must agree with float64
+    brute force to > 0.999 top-k set agreement on real hardware.  Shape is
+    a scaled-down version of the CLI default (the CLI remains the
+    full-size manual audit)."""
+    if not _tpu_backend():
+        pytest.skip(
+            "hardware kNN audit requires a TPU backend (Mosaic/XLA "
+            "hardware lowering is what it audits); CPU mesh skips cleanly"
+        )
+    from benchmark.audit_knn import run_audit
+
+    res = run_audit(n_items=50_000, d=512, k=64, qn=2048, sample_stride=256)
+    assert res["ok"], (
+        "adaptive kNN verification disagrees with f64 ground truth on "
+        f"hardware: {res}"
+    )
+    # the audit count pair is the bitwise route: mismatches mean the two
+    # verification strategies disagree with EACH OTHER, which is a bug
+    # even when both happen to clear the agreement bar
+    assert res["audit_count_mismatches"] == 0, res

@@ -236,6 +236,196 @@ def test_dispatch_error_fails_the_batch_not_the_server():
         srv.shutdown()
 
 
+# -- srml-shield: self-healing serving (docs/robustness.md) -------------------
+
+
+def _wait_state(srv, want, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if srv.state() == want:
+            return True
+        time.sleep(0.02)
+    return srv.state() == want
+
+
+def test_injected_worker_death_fails_requests_retryable_and_recovers(
+    armed_faults,
+):
+    """Worker death mid-stream (SRML_FAULTS kill at serving.dispatch): every
+    affected request resolves with the typed RETRYABLE ServerRecovering —
+    never a hang — and the supervisor restarts the worker back to READY."""
+    from spark_rapids_ml_tpu.serving import READY, ServerRecovering
+
+    armed_faults("serving.dispatch:tag=shield_die:call=1:action=kill")
+    srv = ModelServer(
+        "shield_die", _EchoModel(), max_batch=4, max_wait_ms=5
+    )
+    try:
+        futs = [srv.submit(np.ones(4, np.float32)) for _ in range(3)]
+        for f in futs:
+            with pytest.raises(ServerRecovering) as exc_info:
+                f.result(timeout=30)  # resolves with the typed error, fast
+            assert exc_info.value.retryable is True
+        assert _wait_state(srv, READY), srv.state()
+        assert profiling.counter("serving.shield_die.worker_deaths") == 1
+        assert profiling.counter("serving.shield_die.restarts") == 1
+        # the recovery window is a recorded duration series
+        rec = profiling.percentiles("serve.shield_die.recovery")
+        assert rec and rec["count"] >= 1
+        # post-recovery the same request succeeds (the retryable contract)
+        out = srv.predict(np.ones(4, np.float32))
+        assert out["echo"][0] == pytest.approx(4.0)
+        assert srv.health()["restarts"] == 1
+    finally:
+        srv.shutdown(drain=False)
+
+
+def test_worker_death_recovery_adds_zero_new_compiles(model_zoo, armed_faults):
+    """The acceptance gate: recovery re-warms buckets from the RETAINED AOT
+    executable cache, so a real model's death->restart->serve cycle
+    performs ZERO new executable compilations and steady state stays
+    clean."""
+    from spark_rapids_ml_tpu.serving import READY, ServerRecovering
+
+    model, X = model_zoo("kmeans")
+    srv = ModelServer("shield_km", model, max_batch=32, max_wait_ms=2)
+    try:
+        srv.predict(X[:3])  # healthy traffic first
+        # arming RESETS arrival counters (reload), so the next dispatch of
+        # this server is call #1 of the new plan
+        armed_faults("serving.dispatch:tag=shield_km:call=1:action=kill")
+        before = profiling.counters("precompile.")
+        with pytest.raises(ServerRecovering):
+            srv.predict(X[:3])  # this dispatch dies; future gets typed error
+        assert _wait_state(srv, READY), srv.state()
+        out = srv.predict(X[:3])  # post-recovery traffic
+        assert out["prediction"].shape == (3,)
+        delta = profiling.counter_deltas(before, "precompile.")
+        assert delta.get("precompile.compile", 0) == 0, delta
+        assert delta.get("precompile.fallback", 0) == 0, delta
+        srv.drain()
+        srv.assert_steady_state()
+        assert profiling.counter("serving.shield_km.steady_compiles") == 0
+    finally:
+        srv.shutdown(drain=False)
+
+
+def test_wedge_then_recover_via_acting_watchdog(armed_faults, monkeypatch):
+    """The PR 8 watchdog wired to ACT: a dispatch wedged past
+    SRML_WATCH_STALL_S flips UNHEALTHY, and the supervisor SUPERSEDES the
+    stuck worker (its in-flight request gets the typed retryable error)
+    and restarts back to READY — the wedged thread's eventual return is a
+    harmless no-op exit."""
+    from spark_rapids_ml_tpu.serving import READY, ServerRecovering
+
+    monkeypatch.setenv("SRML_WATCH_STALL_S", "0.3")
+    armed_faults("serving.dispatch:tag=shield_wedge:call=1:delay=2.5")
+    srv = ModelServer(
+        "shield_wedge", _EchoModel(), max_batch=4, max_wait_ms=2
+    )
+    try:
+        fut = srv.submit(np.ones(4, np.float32))  # worker wedges 2.5 s
+        # wedge detection is lazy: polling state() is what notices, and
+        # the restart counter is the proof the watchdog ACTED
+        deadline = time.monotonic() + 15.0
+        while (
+            profiling.counter("serving.shield_wedge.restarts") < 1
+            and time.monotonic() < deadline
+        ):
+            srv.state()
+            time.sleep(0.05)
+        assert profiling.counter("serving.shield_wedge.restarts") == 1
+        assert _wait_state(srv, READY, timeout_s=15.0), srv.state()
+        with pytest.raises(ServerRecovering):
+            fut.result(timeout=30)
+        assert profiling.counter("serving.shield_wedge.unhealthy") >= 1
+        assert profiling.counter("serving.shield_wedge.restarts") == 1
+        out = srv.predict(np.ones((2, 4), np.float32))
+        assert out["echo"].shape == (2,)
+        # give the superseded worker time to wake and exit cleanly; the
+        # server must still be READY afterwards (no state clobber)
+        time.sleep(3.0)
+        assert srv.state() == READY
+    finally:
+        monkeypatch.setenv("SRML_WATCH_STALL_S", "0")
+        srv.shutdown(drain=False)
+
+
+def test_drain_during_recovery_resolves(armed_faults):
+    """Queued requests shed by a recovery resolve immediately, so a drain
+    racing the restart returns instead of timing out (quiescence counts
+    EVERY admitted request, shed or served)."""
+    from spark_rapids_ml_tpu.serving import ServerRecovering
+
+    armed_faults("serving.dispatch:tag=shield_drain:call=1:action=kill")
+    srv = ModelServer(
+        "shield_drain", _EchoModel(delay_s=0.02), max_batch=2, max_wait_ms=1
+    )
+    try:
+        futs = [srv.submit(np.ones(4, np.float32)) for _ in range(4)]
+        srv.drain(timeout_s=20.0)  # must NOT raise TimeoutError
+        for f in futs:
+            assert f.done()
+            with pytest.raises((ServerRecovering, RuntimeError)):
+                f.result(timeout=0)
+    finally:
+        srv.shutdown(drain=False)
+
+
+def test_restart_budget_exhaustion_goes_unhealthy(armed_faults, monkeypatch):
+    """Bounded restarts: a server that dies on EVERY dispatch burns its
+    budget and lands UNHEALTHY for good — submits then shed with
+    ServerUnhealthy (fail over), never an infinite restart storm."""
+    from spark_rapids_ml_tpu.serving import (
+        UNHEALTHY,
+        ServerRecovering,
+        ServerUnhealthy,
+    )
+
+    monkeypatch.setenv("SRML_SERVE_MAX_RESTARTS", "1")
+    armed_faults("serving.dispatch:tag=shield_budget:action=kill")
+    srv = ModelServer(
+        "shield_budget", _EchoModel(), max_batch=4, max_wait_ms=2
+    )
+    try:
+        from spark_rapids_ml_tpu.serving import READY
+
+        with pytest.raises(ServerRecovering):
+            srv.predict(np.ones(4, np.float32))  # death #1: restart
+        assert _wait_state(srv, READY), srv.state()
+        with pytest.raises(ServerRecovering):
+            srv.predict(np.ones(4, np.float32))  # death #2: budget spent
+        assert _wait_state(srv, UNHEALTHY), srv.state()
+        with pytest.raises((ServerUnhealthy, ServerRecovering)):
+            srv.submit(np.ones(4, np.float32))
+        assert profiling.counter("serving.shield_budget.restarts") == 1
+        assert profiling.counter("serving.shield_budget.worker_deaths") == 2
+    finally:
+        srv.shutdown(drain=False)
+
+
+def test_registry_rolls_up_recovering_severity_and_restarts(model_zoo):
+    """RECOVERING outranks DRAINING in the registry's worst-state rollup,
+    and registry.health() carries the plane-wide restart total."""
+    from spark_rapids_ml_tpu.serving import (
+        DRAINING,
+        ModelRegistry,
+        RECOVERING,
+        SEVERITY,
+        UNHEALTHY,
+    )
+
+    assert SEVERITY.index(RECOVERING) > SEVERITY.index(DRAINING)
+    assert SEVERITY.index(UNHEALTHY) > SEVERITY.index(RECOVERING)
+    model, X = model_zoo("kmeans")
+    with ModelRegistry(max_batch=16, max_wait_ms=1) as reg:
+        reg.register("shield_roll", model)
+        h = reg.health()
+        assert h["state"] == "READY"
+        assert h["restarts"] == 0
+        assert h["models"]["shield_roll"]["restarts"] == 0
+
+
 # -- real models: equivalence + zero-new-compiles steady state ----------------
 
 

@@ -439,6 +439,11 @@ def test_server_lifecycle_states_and_slo_health(model_zoo, monkeypatch):
 def test_wedged_server_flips_unhealthy_and_sheds_then_recovers(
     model_zoo, monkeypatch
 ):
+    """The PASSIVE wedge contract (pre-shield behavior, still the policy
+    when the restart budget is zero): UNHEALTHY + shed while wedged, lazy
+    recovery when the blocked dispatch finally returns.
+    SRML_SERVE_MAX_RESTARTS=0 pins it; the ACTING watchdog (supersede +
+    supervised restart) is gated in test_serving.py."""
     from spark_rapids_ml_tpu.serving import (
         READY,
         UNHEALTHY,
@@ -446,6 +451,7 @@ def test_wedged_server_flips_unhealthy_and_sheds_then_recovers(
         ServerUnhealthy,
     )
 
+    monkeypatch.setenv("SRML_SERVE_MAX_RESTARTS", "0")
     model, X = model_zoo("kmeans")
     srv = ModelServer("w_wedge", model, max_batch=16, max_wait_ms=1)
     try:

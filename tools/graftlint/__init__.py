@@ -37,6 +37,14 @@
 #                    matching .wait() in the same kernel body — an
 #                    unwaited remote copy races the output block's flush
 #                    and can wedge the device in FAILED_PRECONDITION.
+#   R9 unbounded-wait  .result()/.wait()/.acquire()/.join() with no
+#                    timeout, and `except Exception:` bodies with no call
+#                    and no raise (silent teardown swallows), in
+#                    spark_rapids_ml_tpu/{parallel,serving}/ — the modules
+#                    that wait on other processes/threads, where a dead
+#                    peer turns an unbounded wait into the srml-shield
+#                    motivating failure mode ("hang for 5 minutes, then
+#                    die without naming the culprit").
 #
 # Suppression: `# graftlint: disable=R1 (reason)` on the finding line or the
 # line directly above.  Granted pragmas are audited in NOTES.md.
@@ -74,6 +82,7 @@ RULE_NAMES = {
     "R6": "raw-clock",
     "R7": "unnamed-thread",
     "R8": "remote-dma",
+    "R9": "unbounded-wait",
 }
 
 # Findings sanctioned by construction, not by pragma.  Entries are

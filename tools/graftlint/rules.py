@@ -23,7 +23,7 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
+RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9")
 
 FindingTuple = Tuple[str, int, str, str]  # (rule, line, message, func-qualname)
 
@@ -807,6 +807,85 @@ def _r8_check_function(
             )
 
 
+# -- R9: unbounded waits + silent teardown swallows ---------------------------
+# The distributed lifecycle's characteristic failure is the HANG: a dead
+# peer turns every timeout-less `.result()` / `.wait()` / `.acquire()` /
+# `.join()` into a forever-block that no watchdog can attribute ("hang for
+# 5 minutes, then die without naming the culprit" — the srml-shield
+# motivation).  Scoped to spark_rapids_ml_tpu/{parallel,serving}/ — the
+# modules that wait on OTHER processes and threads; solver/engine code
+# blocks only on the device runtime, whose waits jax owns.
+#
+# Two shapes:
+#   (a) obj.result()/wait()/acquire()/join() with NO arguments at all —
+#       any argument (positional deadline or timeout=) bounds the wait and
+#       passes, which also keeps "".join(parts) (always has its iterable)
+#       and Condition.wait(remaining) out of scope.  Deliberately
+#       under-approximate: a timeout variable that is None at runtime is
+#       invisible to the AST.
+#   (b) `except Exception:` / `except BaseException:` / bare `except:`
+#       whose body performs NO call and NO raise — a teardown error
+#       swallowed without even a logged event (the TpuContext.__exit__
+#       shape this PR fixed).  Any call in the handler body (logger,
+#       counter, cleanup) counts as handling.
+
+_R9_WAITERS = {"result", "wait", "acquire", "join"}
+_R9_BROAD_TYPES = {"Exception", "BaseException", "builtins.Exception",
+                   "builtins.BaseException"}
+
+
+def _r9_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return (
+        "spark_rapids_ml_tpu/parallel/" in norm
+        or "spark_rapids_ml_tpu/serving/" in norm
+    )
+
+
+def _r9_check_call(
+    call: ast.Call, index: ModuleIndex, qualname: str
+) -> Iterator[FindingTuple]:
+    if not isinstance(call.func, ast.Attribute):
+        return
+    attr = call.func.attr
+    if attr not in _R9_WAITERS:
+        return
+    if call.args or call.keywords:
+        return  # any deadline/timeout argument bounds the wait
+    yield (
+        "R9",
+        call.lineno,
+        f".{attr}() without a timeout: a dead peer or wedged worker turns "
+        "this into a forever-block no watchdog can attribute — pass a "
+        "timeout (and surface the expiry as a typed error) "
+        "(docs/graftlint.md#r9)",
+        qualname,
+    )
+
+
+def _r9_check_except(
+    handler: ast.ExceptHandler, index: ModuleIndex, qualname: str
+) -> Iterator[FindingTuple]:
+    t = handler.type
+    if t is not None:
+        name = index.dotted(t)
+        if name not in _R9_BROAD_TYPES:
+            return  # narrow handler (or a tuple of specific types): fine
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, (ast.Call, ast.Raise)):
+            return  # logged / counted / re-raised: handled
+    caught = index.dotted(t) if t is not None else "everything (bare except)"
+    yield (
+        "R9",
+        handler.lineno,
+        f"`except {caught}` swallows the error without a logged event: a "
+        "teardown failure that vanishes here is the next silent hang's "
+        "root cause — log it (or count it) before suppressing "
+        "(docs/graftlint.md#r9)",
+        qualname,
+    )
+
+
 # -- driver -------------------------------------------------------------------
 
 def lint_tree(
@@ -885,6 +964,14 @@ def lint_tree(
                 findings.extend(_r7_check_call(node, index, qual))
             if "R8" in selected and _r8_applies(index.path):
                 findings.extend(_r8_check_call(node, index, qual, index.path))
+            if "R9" in selected and _r9_applies(index.path):
+                findings.extend(_r9_check_call(node, index, qual))
+        if (
+            isinstance(node, ast.ExceptHandler)
+            and "R9" in selected
+            and _r9_applies(index.path)
+        ):
+            findings.extend(_r9_check_except(node, index, qual))
         if isinstance(node, ast.For) and "R4" in selected:
             findings.extend(_r4_check_for(node, qual, index))
         if "R5" in selected and _r5_applies(index.path):
