@@ -327,6 +327,87 @@ python -m tools.graftlint spark_rapids_ml_tpu/parallel \
     spark_rapids_ml_tpu/core.py spark_rapids_ml_tpu/ops/knn.py \
     spark_rapids_ml_tpu/compat.py
 
+# 3k. srml-router gates (also inside the full suite; re-asserted by name
+#     so marker drift can never silently drop them — docs/serving.md
+#     §srml-router):
+#     - replica CHAOS: with 2 replicas under a request stream, killing one
+#       (SRML_FAULTS serving.dispatch, tag = replica name) produces ZERO
+#       client-visible errors — the routed future re-routes the typed
+#       retryable failure to the survivor — and the killed replica
+#       re-admits warm (zero new compiles, retained AOT cache)
+#     - zero-downtime SWAP: rolling router.swap() under load with zero
+#       errors and zero new compiles at cut-over; registry swap()
+#       persistence semantics (save -> load -> swap -> serve bit-equal,
+#       swap-during-drain, incompatible-signature rejection)
+#     - depth-2 continuous batching: the serve.<n>.inflight_depth series
+#       reaches 2 (assembly overlapped device execution) and the
+#       zero-new-compiles steady gate holds per replica
+#     - admission/shedding: batch class sheds first at the configured
+#       fill ceilings while interactive traffic is still admitted
+#     - the srml_router / srml_health exposition round-trip incl.
+#       per-replica restart counts
+#     plus graftlint (incl. R7 named-threads, R9 unbounded-wait) over the
+#     serving layer, and a bench_serving router smoke asserting the
+#     max-sustained-QPS-at-p99-SLO headline per depth, the PAIRED goodput
+#     confirm with depth-2 >= depth-1 at the COMMON SUSTAINED offered
+#     load (min of the two search maxima) and equal SLO, and a zero-error
+#     swap blip.  The paired rate is min, not max: at the stronger arm's
+#     maximum the first thing to fail on a 2-core host is the CLIENT
+#     pacing thread (late-arrival bursts into an ~8-request queue), which
+#     scores scheduler contention, not the pipeline.  The structural
+#     depth-2 > depth-1 admission-capacity dominance is gated
+#     deterministically by test_router's goodput test (device leg = GIL-
+#     releasing sleep); the smoke gates live-XLA parity at the common
+#     load with zero sheds/errors plus the zero-new-compiles steady
+#     state.  Trials are best-of-3 and interleaved across the depth arms
+#     so one machine-weather phase cannot land entirely on one arm.
+#     The depth comparison runs at ONE replica: inflight depth is
+#     per-replica pipeline machinery, and 2 replicas x depth-2 is 6
+#     serving threads — on a 2-core CI box that oversubscription measures
+#     context-switching, not the pipeline.  The multi-replica behaviours
+#     (chaos re-route, rolling swap) keep their 2-replica gates.
+# the explicit full-file run IS the by-name gate (nothing marker-filtered)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_router.py -q
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_persistence_matrix.py -q -k "swap"
+python -m tools.graftlint spark_rapids_ml_tpu/serving \
+    spark_rapids_ml_tpu/parallel/mesh.py spark_rapids_ml_tpu/watch.py \
+    spark_rapids_ml_tpu/profiling.py benchmark/bench_serving.py
+ROUTER_SMOKE=$(mktemp -d)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.bench_serving --models kmeans \
+    --headline --headline_trials 3 --duration 1 --slo_ms 500 \
+    --replicas 1 \
+    --fit_rows 8192 --num_cols 512 --max_batch 4096 --rows_per_request 512 \
+    --report_path "$ROUTER_SMOKE/router.jsonl"
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.bench_serving --models kmeans \
+    --swap_blip --duration 1 --slo_ms 500 \
+    --replicas 2 \
+    --fit_rows 8192 --num_cols 512 --max_batch 4096 --rows_per_request 512 \
+    --swap_rate 30 --report_path "$ROUTER_SMOKE/router.jsonl"
+python - "$ROUTER_SMOKE/router.jsonl" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1])]
+head = {r["inflight_depth"]: r for r in recs
+        if r.get("metric") == "max_sustained_qps_at_p99_slo"}
+assert set(head) == {1, 2}, sorted(head)
+for r in head.values():
+    assert r["max_sustained_qps"] > 0, r
+# the continuous-batching acceptance bar, measured PAIRED (equal offered
+# load, equal SLO, seconds apart): depth-2 delivers >= depth-1
+paired = [r for r in recs if r.get("metric") == "paired_goodput_at_slo"]
+assert paired, recs
+gp = paired[0]["goodput_rps"]
+assert gp["2"] >= gp["1"] > 0, paired[0]
+swap = [r for r in recs if r.get("metric") == "swap_blip"]
+assert swap and swap[0]["errors"] == 0, swap          # zero-downtime
+assert swap[0]["replica_swaps"] == 2, swap            # every slot rolled
+assert swap[0]["completed"] == swap[0]["requests"], swap
+EOF
+rm -rf "$ROUTER_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

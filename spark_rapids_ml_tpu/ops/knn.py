@@ -1578,20 +1578,13 @@ def distributed_kneighbors(
             # rank lives in THIS process, so jax.process_count() == 1 while
             # nranks > 1): carve DISJOINT per-rank submeshes.  This is the
             # faithful topology — a real rank owns its own chips — and it is
-            # load-bearing on the virtual CPU mesh: XLA:CPU's cross_module
-            # rendezvous deadlocks when two multi-device programs from
-            # different threads interleave their per-device enqueue order on
-            # SHARED devices (reproduced: 4 threads x shard_map psum on one
-            # 8-device mesh wedge in seconds; disjoint submeshes run clean).
-            devs = jax.devices()
-            per = len(devs) // nranks
-            if per >= 1:
-                local = devs[rank * per : (rank + 1) * per]
-            else:
-                # more ranks than devices: one device per rank (single-
-                # device programs have no cross-program rendezvous)
-                local = [devs[rank % len(devs)]]
-            mesh = Mesh(np.array(local), (DATA_AXIS,))
+            # load-bearing on the virtual CPU mesh (reproduced: 4 threads x
+            # shard_map psum on one 8-device mesh wedge in seconds; disjoint
+            # submeshes run clean).  slice_meshes is the ONE carving rule,
+            # shared with the serving router's replica slices.
+            from ..parallel.mesh import slice_meshes
+
+            mesh = slice_meshes(nranks)[rank]
         else:
             mesh = get_mesh(None)
     q_feats = [np.asarray(f, dtype=dtype) for f, _ in query_parts]

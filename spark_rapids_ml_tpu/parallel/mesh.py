@@ -76,6 +76,33 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def slice_meshes(n_slices: int, devices=None) -> list:
+    """Carve `n_slices` DISJOINT 1-D data meshes over the device list — the
+    unit of replica parallelism for the serving router (serving/router.py)
+    and the thread-mocked multicontroller ranks (ops/knn).
+
+    Disjointness is load-bearing, not cosmetic: XLA:CPU's cross_module
+    rendezvous deadlocks when two multi-device programs launched from
+    different threads interleave their per-device enqueue order on SHARED
+    devices, and on TPU hardware a shared slice would serialize the
+    replicas on the same chips anyway.  With fewer devices than slices the
+    surplus slices each get ONE device, round-robin — single-device
+    programs have no cross-program rendezvous, so oversubscription degrades
+    to compute contention instead of deadlock."""
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    devs = list(devices) if devices is not None else jax.devices()
+    per = len(devs) // n_slices
+    out = []
+    for i in range(n_slices):
+        if per >= 1:
+            local = devs[i * per : (i + 1) * per]
+        else:
+            local = [devs[i % len(devs)]]
+        out.append(Mesh(np.array(local), (DATA_AXIS,)))
+    return out
+
+
 def ring_permutation(n_dev: int, shift: int = 1):
     """The (source, destination) pairs of a +shift rotation along the
     1-D data mesh — the ONE definition of the mesh's ring order, used by

@@ -762,6 +762,34 @@ class TelemetrySnapshot:
             phases=phases, counters=ctr, durations=dur, memory=mem, meta=meta
         )
 
+    def delta(self, since: "TelemetrySnapshot") -> "TelemetrySnapshot":
+        """What moved between `since` and this snapshot: counter
+        differences (zero-change keys dropped) and count/sum duration
+        deltas.  min/max cannot be un-merged, so the window keeps the
+        current extremes (documented in docs/observability.md).  The ONE
+        delta rule behind every scrape-loop surface
+        (ModelRegistry.telemetry(since=), Router.telemetry(since=))."""
+        ctr = {
+            k: v - since.counters.get(k, 0)
+            for k, v in self.counters.items()
+            if v != since.counters.get(k, 0)
+        }
+        dur: Dict[str, Dict[str, float]] = {}
+        for k, d in self.durations.items():
+            prev = since.durations.get(k)
+            if prev is None:
+                dur[k] = dict(d)
+                continue
+            dc = d["count"] - prev["count"]
+            if dc > 0:
+                dur[k] = {
+                    "count": dc,
+                    "sum_s": d["sum_s"] - prev["sum_s"],
+                    "min_s": d["min_s"],
+                    "max_s": d["max_s"],
+                }
+        return TelemetrySnapshot(counters=ctr, durations=dur)
+
     def phase_seconds(self, prefix: str = "") -> Dict[str, float]:
         """{phase name: total seconds} — the phase_times() view of a merged
         snapshot (what the driver prints for a live-Spark fit)."""
@@ -931,16 +959,24 @@ def render_prometheus(metrics: Optional[Dict[str, Any]] = None) -> str:
             f"{d['mean'] * d['count']}"
         )
         lines.append(f'srml_duration_seconds_count{{name="{n}"}} {d["count"]}')
-    # gauges (srml-watch health plane) split into the three families
-    # dashboards alert on: memory watermarks, serving health, and the rest
+    # gauges (srml-watch health plane) split into the families dashboards
+    # alert on: memory watermarks, serving health (per server/replica),
+    # router capacity (srml-router), and the rest
     gauges = m.get("gauges", {})
     if gauges:
-        fams = {"srml_memory_bytes": [], "srml_health": [], "srml_gauge": []}
+        fams = {
+            "srml_memory_bytes": [],
+            "srml_health": [],
+            "srml_router": [],
+            "srml_gauge": [],
+        }
         for k, v in sorted(gauges.items()):
             if k.startswith("mem."):
                 fams["srml_memory_bytes"].append((k, v))
             elif k.startswith("health."):
                 fams["srml_health"].append((k, v))
+            elif k.startswith("router."):
+                fams["srml_router"].append((k, v))
             else:
                 fams["srml_gauge"].append((k, v))
         for fam, entries in fams.items():

@@ -15,9 +15,19 @@
 #   engine.py    ModelServer: dedicated dispatch worker, bucket-warmed
 #                executables (steady state = zero new compiles, asserted),
 #                latency percentiles through profiling
-#   registry.py  named servers over in-memory or core.load'ed models
+#   registry.py  named servers over in-memory or core.load'ed models, plus
+#                zero-downtime hot swap (swap(name, new_model))
+#   scheduler.py admission/priority classes + least-outstanding dispatch
+#                policy (pure functions over replica state)
+#   router.py    srml-router: N replicas per model over disjoint mesh
+#                slices, health-aware routing, load shedding, rolling swap
 #
-from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
+from .batcher import (
+    MicroBatcher,
+    RequestTimeout,
+    ServerDraining,
+    ServerOverloaded,
+)
 from .engine import (
     DEGRADED,
     DRAINING,
@@ -33,18 +43,31 @@ from .engine import (
 )
 from .entry import ServingEntry, bucket_rows, entry_for, kernel_entry, serve_buckets
 from .registry import ModelRegistry, default_registry
+from .router import Router
+from .scheduler import (
+    DEFAULT_CLASS,
+    PRIORITY_CLASSES,
+    NoReplicaAvailable,
+    RequestShed,
+)
 
 __all__ = [
+    "DEFAULT_CLASS",
     "DEGRADED",
     "DRAINING",
     "MicroBatcher",
     "ModelRegistry",
     "ModelServer",
+    "NoReplicaAvailable",
+    "PRIORITY_CLASSES",
     "READY",
     "RECOVERING",
+    "RequestShed",
     "RequestTimeout",
+    "Router",
     "SEVERITY",
     "STATE_CODES",
+    "ServerDraining",
     "ServerOverloaded",
     "ServerRecovering",
     "ServerUnhealthy",

@@ -59,6 +59,23 @@ _DEFAULT_MAX_WAIT_MS = 5.0
 _DEFAULT_QUEUE_DEPTH = 4096  # rows
 
 
+# take(cancelled=...) sentinel: a superseded consumer left without
+# consuming (distinct from None = stopped-and-drained)
+CANCELLED = object()
+
+
+class ServerDraining(RuntimeError):
+    """Raised by submit() once drain()/shutdown() has begun: this server
+    generation is completing its admitted work and admitting nothing new.
+    Typed (and a RuntimeError subclass, so pre-router callers that matched
+    RuntimeError still do) because the router's swap path NEEDS to tell
+    "this replica is leaving rotation" from a real failure: a submit that
+    races the rolling-swap cut-over onto the outgoing generation must fail
+    over to the incoming one, not surface to the client."""
+
+    retryable = True
+
+
 class ServerOverloaded(RuntimeError):
     """Raised by submit() when the bounded request queue is full — the
     fast-rejection half of admission control (callers shed or retry with
@@ -177,7 +194,10 @@ class MicroBatcher:
         req = _Request(feats, timeout_s)
         with self._lock:
             if self._stopped or self._draining:
-                raise RuntimeError(f"server {self.ns!r} is shut down")
+                raise ServerDraining(
+                    f"server {self.ns!r} is draining/shut down; "
+                    "resubmit to its successor"
+                )
             if self._queued_rows + req.n_rows > self.queue_depth:
                 profiling.incr_counter(f"{self.ns}.rejected")
                 raise ServerOverloaded(
@@ -201,11 +221,31 @@ class MicroBatcher:
         return req.future
 
     # -- consumer side ------------------------------------------------------
-    def take(self) -> Optional[Tuple[List[_Request], str]]:
+    def take(
+        self, cancelled=None, hold=None
+    ) -> Optional[Tuple[List[_Request], str]]:
         """Block until a batch is ready under the coalescing policy; returns
         (requests, flush_reason) with at least one live request, or None
         when the batcher is stopped and drained.  Expired requests are
-        failed here and never returned."""
+        failed here and never returned.
+
+        `cancelled` (optional zero-arg predicate) is the SUPERSEDED-
+        CONSUMER exit: a depth>1 assembly thread parks INSIDE take(), so
+        when a recovery hands the batcher to a new worker generation the
+        stale consumer must leave WITHOUT consuming a request the new
+        generation owns.  When the predicate turns true, take() returns
+        the CANCELLED sentinel at the next wait re-check, having popped
+        nothing.
+
+        `hold` (optional zero-arg predicate) is ITERATION-LEVEL continuous
+        batching: while it returns True (the depth>1 staging slot is still
+        occupied, i.e. the device has not consumed the previously staged
+        batch), a deadline-expired partial batch stays OPEN to late
+        arrivals instead of flushing — closing it early cannot make it
+        dispatch sooner (a staged batch is already ahead of it) but would
+        freeze its occupancy below max_batch.  Full/drain/stop flushes
+        ignore `hold`; the consumer wakes promptly via kick() when the
+        slot frees."""
         with self._lock:
             while True:
                 while not self._queue and not self._stopped:
@@ -213,7 +253,11 @@ class MicroBatcher:
                     # once a second costs nothing and means a lost notify —
                     # or a recovery path that swapped consumers — can never
                     # park this worker forever
+                    if cancelled is not None and cancelled():
+                        return CANCELLED
                     self._nonempty.wait(timeout=1.0)
+                if cancelled is not None and cancelled():
+                    return CANCELLED  # queued work belongs to the successor
                 if not self._queue:
                     return None  # stopped and drained
                 # coalesce-until-deadline, anchored at the OLDEST request:
@@ -226,9 +270,20 @@ class MicroBatcher:
                         break
                     remaining = deadline - profiling.now()
                     if remaining <= 0:
-                        reason = "deadline"
-                        break
-                    self._nonempty.wait(remaining)
+                        if hold is None or not hold():
+                            reason = "deadline"
+                            break
+                        # past the deadline but held: the staging slot is
+                        # occupied, so keep coalescing — kick() (or the next
+                        # submit) wakes this wait the moment that changes
+                        profiling.incr_counter(f"{self.ns}.held_open")
+                        remaining = 1.0
+                    # bounded like the outer wait, so a consumer superseded
+                    # mid-coalesce notices within a second even when no
+                    # producer ever notifies again
+                    self._nonempty.wait(min(remaining, 1.0))
+                    if cancelled is not None and cancelled():
+                        return CANCELLED
                     if not self._queue:
                         break  # everything expired/cancelled under us
                 if not self._queue:
@@ -263,6 +318,14 @@ class MicroBatcher:
                 if len(batch) > 1:
                     profiling.incr_counter(f"{self.ns}.coalesced_batches")
                 return batch, reason
+
+    def kick(self) -> None:
+        """Wake a take() parked under `hold`: the depth>1 dispatcher calls
+        this right after popping the staged batch, so a deadline-expired
+        held batch flushes within one lock handoff of the slot freeing
+        instead of one bounded-wait interval later."""
+        with self._lock:
+            self._nonempty.notify_all()
 
     # -- lifecycle ----------------------------------------------------------
     def queued_rows(self) -> int:

@@ -30,9 +30,10 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -84,6 +85,19 @@ SEVERITY = (WARMING, READY, DEGRADED, DRAINING, RECOVERING, UNHEALTHY)
 SLO_MS_ENV = "SRML_SERVE_SLO_MS"
 SLO_BURN_ENV = "SRML_SERVE_SLO_BURN"
 _DEFAULT_SLO_BURN = 0.1
+
+# -- continuous batching (srml-router) ----------------------------------------
+# SRML_SERVE_INFLIGHT_DEPTH > 1 splits the request path into a two-stage
+# pipeline per server: an ASSEMBLY thread pops coalesced batches and does
+# the host-side work (deadline bookkeeping, zero-pad to the pow2 bucket)
+# while the DISPATCH worker — still the only thread that touches jax for
+# this server — runs the previous batch on device.  Depth bounds the
+# assembled-but-undispatched backlog (depth-1 slots), exactly PR 2's
+# double-buffering applied to serving: admit and assemble batch k+1 while
+# batch k executes.  Depth 1 (the default) is the original single-thread
+# path, bit-for-bit.
+INFLIGHT_DEPTH_ENV = "SRML_SERVE_INFLIGHT_DEPTH"
+_DEFAULT_INFLIGHT_DEPTH = 1
 
 # -- srml-shield recovery policy (docs/robustness.md) -------------------------
 # A worker death (exception escaping the dispatch loop) or a watchdog-
@@ -203,11 +217,22 @@ class ModelServer:
         max_wait_ms: Optional[float] = None,
         queue_depth: Optional[int] = None,
         default_timeout_ms: Optional[float] = None,
+        inflight_depth: Optional[int] = None,
         warm: bool = True,
     ):
         self.name = str(name)
         self.model = model
         self.ns = f"serving.{self.name}"
+        from ..utils import env_float
+
+        self.inflight_depth = max(
+            1,
+            int(
+                inflight_depth
+                if inflight_depth is not None
+                else env_float(INFLIGHT_DEPTH_ENV, _DEFAULT_INFLIGHT_DEPTH)
+            ),
+        )
         self._entry: ServingEntry = entry_for(model, mesh)
         self._batcher = MicroBatcher(
             n_cols=self._entry.n_cols,
@@ -245,6 +270,13 @@ class ModelServer:
         self._inflight: Optional[list] = None
         self._shutdown_begun = False
         self._recovery_epoch = 0  # guards stale recoveries (see _recover)
+        # depth>1 continuous batching: the CURRENT generation's bounded
+        # assembled-batch queue and assembly thread (None at depth 1).
+        # Rebuilt per worker generation — a recovery must never leave a new
+        # dispatcher popping a dead generation's pipe.
+        self._pipe: Optional["queue.Queue"] = None
+        self._asm: Optional[threading.Thread] = None
+        self._burn_cache = (float("-inf"), 0.0)  # (stamped-at, burn)
         # one srml-scope trace session spans the server's lifetime (warmup
         # through shutdown) when SRML_TRACE_DIR is set: every queue/dispatch
         # span — recorded on the worker thread — lands in one Perfetto file.
@@ -266,18 +298,47 @@ class ModelServer:
             self._trace_stack.close()
             raise
 
+    def _make_worker_locked(self) -> Tuple[int, list]:
+        """Build the next worker generation's thread set (dispatch worker,
+        plus the assembly thread and a FRESH pipe at inflight_depth > 1)
+        under the already-held health lock; returns (gen, threads to
+        start).  The ONE construction rule shared by _start_worker and the
+        recovery path, so a recovered server always gets the same pipeline
+        shape it was built with."""
+        self._worker_gen += 1
+        gen = self._worker_gen
+        pipe = None
+        if self.inflight_depth > 1:
+            pipe = queue.Queue(maxsize=self.inflight_depth - 1)
+            self._pipe = pipe
+        # BOTH pipeline threads are pinned to THEIR generation's pipe via
+        # thread args — a late-scheduled stale-generation thread reading
+        # self._pipe would pop the successor's work (double dispatch: two
+        # jax threads for one server, the rendezvous hazard this module
+        # exists to avoid)
+        worker = threading.Thread(
+            target=self._worker_main, args=(gen, pipe),
+            name=f"srml-serve-{self.name}-g{gen}", daemon=True,
+        )
+        self._worker = worker
+        threads = [worker]
+        if pipe is not None:
+            asm = threading.Thread(
+                target=self._assembler_main, args=(gen, pipe),
+                name=f"srml-serve-{self.name}-asm-g{gen}", daemon=True,
+            )
+            self._asm = asm
+            threads.append(asm)
+        return gen, threads
+
     def _start_worker(self) -> int:
-        """Start a (new-generation) dispatch worker thread; returns its
+        """Start a (new-generation) dispatch worker thread (and, at
+        inflight_depth > 1, its assembly-stage sibling); returns the
         generation.  Called at construction and by the recovery path."""
         with self._health_lock:
-            self._worker_gen += 1
-            gen = self._worker_gen
-            worker = threading.Thread(
-                target=self._worker_main, args=(gen,),
-                name=f"srml-serve-{self.name}-g{gen}", daemon=True,
-            )
-            self._worker = worker
-        worker.start()
+            gen, threads = self._make_worker_locked()
+        for t in threads:
+            t.start()
         return gen
 
     def __del__(self):  # pragma: no cover - GC timing
@@ -404,17 +465,20 @@ class ModelServer:
         return fut.result(timeout=wait_s)
 
     # -- dispatch worker + srml-shield supervisor ----------------------------
-    def _worker_main(self, gen: int) -> None:
+    def _worker_main(self, gen: int, pipe: Optional["queue.Queue"]) -> None:
         """Worker thread top frame: a BaseException escaping the dispatch
         loop is a WORKER DEATH (not a per-batch model error — those are
         relayed to futures inside _dispatch) and triggers the supervised
         restart."""
         try:
-            self._run(gen)
+            self._run(gen, pipe)
         except BaseException as exc:  # noqa: BLE001 - the supervisor catches
             self._on_worker_death(exc, gen)
 
-    def _run(self, gen: int) -> None:
+    def _run(self, gen: int, pipe: Optional["queue.Queue"]) -> None:
+        if pipe is not None:
+            self._run_pipelined(gen, pipe)
+            return
         while True:
             # the queue span covers the worker's wait for a coalesced batch:
             # in a trace, long serve.<n>.queue spans between short dispatch
@@ -425,68 +489,198 @@ class ModelServer:
             if item is None:
                 return
             batch, _reason = item
-            with self._health_lock:
-                self._busy_since = profiling.now()
-                self._inflight = batch
-            dying = True  # a BaseException escaping _dispatch = worker death
-            try:
-                self._dispatch(batch)
-                dying = False
-            except Exception as exc:  # noqa: BLE001 - worker must survive
-                dying = False
-                # _dispatch relays model errors to the batch's futures; this
-                # guard is for bookkeeping bugs (e.g. a racing future state)
-                # — one batch may be lost, the server must not wedge.
-                # BaseExceptions (InjectedWorkerDeath, interpreter teardown)
-                # deliberately ESCAPE to _worker_main: they are deaths, not
-                # batch errors.
-                logger.exception("%s: dispatch bookkeeping failed", self.ns)
-                profiling.incr_counter(f"{self.ns}.errors")
-                rec = watch.recorder()
-                if rec is not None:
-                    rec.record_exception(exc, f"serve-{self.name}")
-                for r in batch:
-                    resolve_future(
-                        r.future,
-                        exc=RuntimeError(f"{self.ns}: dispatch failed"),
-                    )
-            finally:
-                with self._health_lock:
-                    superseded = self._worker_gen != gen
-                    recovered = False
-                    if not superseded and not dying:
-                        # on the DEATH path _inflight must survive this
-                        # finally: _on_worker_death fails those futures
-                        # with the typed retryable error
-                        self._busy_since = None
-                        self._inflight = None
-                        recovered = self._state == UNHEALTHY
-                        if recovered:
-                            # the wedged dispatch came back after all (no
-                            # restart budget was left, so no supersede):
-                            # recover — UNHEALTHY describes the worker, not
-                            # history (but a drain that began meanwhile
-                            # stays a drain)
-                            self._state = (
-                                DRAINING if self._drain_begun else READY
-                            )
-                if recovered:
-                    profiling.incr_counter(f"{self.ns}.recovered")
-                    logger.warning(
-                        "%s: wedged dispatch returned; %s",
-                        self.ns, self._state,
-                    )
-            if self._worker_gen != gen:
-                # a wedge recovery superseded this worker while its dispatch
-                # was blocked: a new generation owns the batcher now — exit
-                # instead of double-consuming (the blocked batch's futures
-                # were already failed with ServerRecovering; resolve_future
-                # made this worker's late scatter a harmless no-op)
-                logger.warning(
-                    "%s: superseded worker generation %d exiting after its "
-                    "blocked dispatch returned", self.ns, gen,
-                )
+            profiling.record_duration(
+                f"serve.{self.name}.inflight_depth", 1.0
+            )
+            if not self._process(gen, batch, None):
                 return
+
+    # -- depth>1 continuous batching (srml-router) ----------------------------
+    def _assembler_main(self, gen: int, pipe: "queue.Queue") -> None:
+        """Assembly stage of the depth>1 pipeline: pop coalesced batches
+        and do the HOST-side work (pad to the pow2 bucket) while the
+        dispatch worker has the previous batch on device.  This thread
+        never touches jax — the one-jax-thread-per-server rule that keeps
+        XLA:CPU's cross-program rendezvous out of the request path holds
+        at every depth.  On supersede/stop it fails its in-hand batch and
+        drains its own pipe (it is the only producer, so after this drain
+        the pipe stays empty forever — no future is ever stranded)."""
+        from .batcher import CANCELLED
+
+        try:
+            while True:
+                with profiling.span(f"serve.{self.name}.queue"):
+                    # hold=pipe.full is the iteration-level part of the
+                    # pipeline: while a staged batch already waits for the
+                    # device, the NEXT batch stays open to late arrivals
+                    # (closing it early could not dispatch it sooner, only
+                    # freeze its occupancy below the bucket) — the
+                    # dispatcher kick()s the moment the slot frees
+                    item = self._batcher.take(
+                        cancelled=lambda: self._worker_gen != gen,
+                        hold=pipe.full,
+                    )
+                if item is CANCELLED:
+                    break  # superseded: queued work belongs to the successor
+                if item is None:
+                    # stopped and drained: wake the dispatcher for exit.
+                    # The sentinel trails every real item (single producer),
+                    # so the dispatcher resolves everything first.
+                    self._pipe_put(pipe, None, gen)
+                    return
+                batch, _reason = item
+                assembled = self._assemble(batch)
+                if not self._pipe_put(pipe, (batch, assembled), gen):
+                    break  # superseded while the pipe was full
+                # pipeline depth achieved by THIS admission: batches staged
+                # in the pipe plus the one on device — the
+                # serve.<n>.inflight_depth series (percentiles > 1 mean
+                # assembly genuinely overlapped device execution)
+                busy = 1 if self._busy_since is not None else 0
+                profiling.record_duration(
+                    f"serve.{self.name}.inflight_depth",
+                    float(pipe.qsize() + busy),
+                )
+        except BaseException as exc:  # noqa: BLE001 - assembly must not hang clients
+            # host-side assembly death (bookkeeping bug or injected): fail
+            # queued work the way a worker death does, through the same
+            # supervisor — a silently dead assembler would strand every
+            # queued request behind a live-looking server
+            self._on_worker_death(exc, gen)
+            return
+        self._drain_pipe(pipe)
+
+    def _pipe_put(self, pipe: "queue.Queue", item, gen: int) -> bool:
+        """Bounded-wait put that notices supersede: a pipe stuck full
+        because its dispatcher died must not park the assembler forever
+        (graftlint R9 discipline, same as the batcher's 1 s re-check)."""
+        while True:
+            try:
+                pipe.put(item, timeout=1.0)
+                return True
+            except queue.Full:
+                if self._worker_gen != gen:
+                    if item is not None:
+                        for r in item[0]:
+                            resolve_future(
+                                r.future,
+                                exc=ServerRecovering(
+                                    f"{self.ns}: worker superseded with the "
+                                    "pipeline full; retry"
+                                ),
+                            )
+                    return False
+
+    def _drain_pipe(self, pipe: Optional["queue.Queue"]) -> int:
+        """Fail every assembled-but-undispatched batch in `pipe` with the
+        typed retryable error; returns the number of requests failed."""
+        n = 0
+        while pipe is not None:
+            try:
+                item = pipe.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            for r in item[0]:
+                if resolve_future(
+                    r.future,
+                    exc=ServerRecovering(
+                        f"{self.ns}: pipeline flushed during recovery; retry"
+                    ),
+                ):
+                    n += 1
+        return n
+
+    def _run_pipelined(self, gen: int, pipe: "queue.Queue") -> None:
+        """Dispatch stage of the depth>1 pipeline: pop ASSEMBLED batches
+        and run the device leg.  The pop wait is bounded so a superseded
+        generation exits within one re-check interval even if its
+        assembler died without a sentinel."""
+        while True:
+            try:
+                with profiling.span(f"serve.{self.name}.pipe"):
+                    item = pipe.get(timeout=1.0)
+            except queue.Empty:
+                if self._worker_gen != gen:
+                    return
+                continue
+            if item is None:
+                return
+            # the staging slot just freed: wake an assembler holding a
+            # deadline-expired batch open so it closes and stages now
+            self._batcher.kick()
+            batch, assembled = item
+            if not self._process(gen, batch, assembled):
+                return
+
+    def _process(self, gen: int, batch, assembled) -> bool:
+        """Shared per-batch guard around _dispatch (both depths): health
+        bookkeeping, error relay, supersede detection.  Returns False when
+        this worker generation was superseded and must exit."""
+        with self._health_lock:
+            self._busy_since = profiling.now()
+            self._inflight = batch
+        dying = True  # a BaseException escaping _dispatch = worker death
+        try:
+            self._dispatch(batch, assembled)
+            dying = False
+        except Exception as exc:  # noqa: BLE001 - worker must survive
+            dying = False
+            # _dispatch relays model errors to the batch's futures; this
+            # guard is for bookkeeping bugs (e.g. a racing future state)
+            # — one batch may be lost, the server must not wedge.
+            # BaseExceptions (InjectedWorkerDeath, interpreter teardown)
+            # deliberately ESCAPE to _worker_main: they are deaths, not
+            # batch errors.
+            logger.exception("%s: dispatch bookkeeping failed", self.ns)
+            profiling.incr_counter(f"{self.ns}.errors")
+            rec = watch.recorder()
+            if rec is not None:
+                rec.record_exception(exc, f"serve-{self.name}")
+            for r in batch:
+                resolve_future(
+                    r.future,
+                    exc=RuntimeError(f"{self.ns}: dispatch failed"),
+                )
+        finally:
+            with self._health_lock:
+                superseded = self._worker_gen != gen
+                recovered = False
+                if not superseded and not dying:
+                    # on the DEATH path _inflight must survive this
+                    # finally: _on_worker_death fails those futures
+                    # with the typed retryable error
+                    self._busy_since = None
+                    self._inflight = None
+                    recovered = self._state == UNHEALTHY
+                    if recovered:
+                        # the wedged dispatch came back after all (no
+                        # restart budget was left, so no supersede):
+                        # recover — UNHEALTHY describes the worker, not
+                        # history (but a drain that began meanwhile
+                        # stays a drain)
+                        self._state = (
+                            DRAINING if self._drain_begun else READY
+                        )
+            if recovered:
+                profiling.incr_counter(f"{self.ns}.recovered")
+                logger.warning(
+                    "%s: wedged dispatch returned; %s",
+                    self.ns, self._state,
+                )
+        if self._worker_gen != gen:
+            # a wedge recovery superseded this worker while its dispatch
+            # was blocked: a new generation owns the batcher now — exit
+            # instead of double-consuming (the blocked batch's futures
+            # were already failed with ServerRecovering; resolve_future
+            # made this worker's late scatter a harmless no-op)
+            logger.warning(
+                "%s: superseded worker generation %d exiting after its "
+                "blocked dispatch returned", self.ns, gen,
+            )
+            return False
+        return True
 
     # -- the supervisor: bounded restart with backoff -------------------------
     def _on_worker_death(self, exc: BaseException, gen: int) -> None:
@@ -580,6 +774,10 @@ class ModelServer:
                 f"{self.ns}: recovering from {reason}; retry shortly"
             )
         )
+        # depth>1: assembled-but-undispatched batches in the dead
+        # generation's pipe are admitted requests too — shed them the same
+        # way (the old assembler's own exit-drain backstops any later put)
+        shed += self._drain_pipe(self._pipe)
         if shed:
             profiling.incr_counter(f"{self.ns}.shed_recovery", shed)
         if aborting:
@@ -620,13 +818,7 @@ class ModelServer:
                 or self._state == UNHEALTHY
             )
             if not stale:
-                self._worker_gen += 1
-                gen = self._worker_gen
-                worker = threading.Thread(
-                    target=self._worker_main, args=(gen,),
-                    name=f"srml-serve-{self.name}-g{gen}", daemon=True,
-                )
-                self._worker = worker
+                _gen, threads = self._make_worker_locked()
                 self._state = DRAINING if self._drain_begun else READY
         if stale:
             logger.warning(
@@ -634,7 +826,8 @@ class ModelServer:
                 self.ns, attempt,
             )
             return
-        worker.start()
+        for t in threads:
+            t.start()
         dt = profiling.now() - t0
         profiling.incr_counter(f"{self.ns}.restarts")
         profiling.record_duration(f"serve.{self.name}.recovery", dt)
@@ -672,20 +865,36 @@ class ModelServer:
             with self._health_lock:
                 self._busy_since = None
 
-    def _dispatch(self, batch) -> None:
+    def _assemble(self, batch) -> Tuple[np.ndarray, int, int]:
+        """Host-side batch assembly: zero-pad the coalesced requests to
+        their pow2 row bucket.  Runs on the dispatch worker at depth 1 and
+        on the assembly thread at depth > 1 — the work the pipeline
+        overlaps with device execution."""
+        n_rows = sum(r.n_rows for r in batch)
+        b = bucket_rows(n_rows, self._batcher.max_batch)
+        # empty + tail-only zero fill, NOT np.zeros + overwrite: the bucket
+        # is written exactly once either way, but zeros() pre-fills the
+        # whole buffer, doubling assembly memory traffic for a full bucket
+        # — host bandwidth the depth>1 assembler shares with the device leg
+        padded = np.empty((b, self._entry.n_cols), dtype=self._entry.dtype)
+        off = 0
+        for r in batch:
+            padded[off : off + r.n_rows] = r.features
+            off += r.n_rows
+        if b > n_rows:
+            padded[n_rows:] = 0
+        profiling.incr_counter(f"{self.ns}.pad_rows", b - n_rows)
+        return padded, n_rows, b
+
+    def _dispatch(self, batch, assembled=None) -> None:
         # srml-shield: the serving injection site (tag = server name, so a
         # plan targets ONE server deterministically).  kill here raises
         # InjectedWorkerDeath — a BaseException that escapes the per-batch
         # Exception guard and lands in _worker_main as a worker death.
         faults.site("serving.dispatch", tag=self.name)
-        n_rows = sum(r.n_rows for r in batch)
-        b = bucket_rows(n_rows, self._batcher.max_batch)
-        padded = np.zeros((b, self._entry.n_cols), dtype=self._entry.dtype)
-        off = 0
-        for r in batch:
-            padded[off : off + r.n_rows] = r.features
-            off += r.n_rows
-        profiling.incr_counter(f"{self.ns}.pad_rows", b - n_rows)
+        padded, n_rows, b = (
+            assembled if assembled is not None else self._assemble(batch)
+        )
         # compile accounting brackets THIS dispatch: the watermark counters
         # are process-wide, so a baseline taken at warmup end would blame
         # this server for another server's later load-time compiles (any
@@ -768,6 +977,9 @@ class ModelServer:
             else:
                 self._batcher.stop()
             self._worker.join(timeout=timeout_s)
+            asm = self._asm
+            if asm is not None:
+                asm.join(timeout=timeout_s)
         finally:
             # close the lifetime trace session (writes the Perfetto file
             # when SRML_TRACE_DIR is set; no-op otherwise)
@@ -794,6 +1006,58 @@ class ModelServer:
         """Current lifecycle state (wedge detection applied lazily)."""
         self._check_wedged()
         return self._state
+
+    # -- router-facing surface (serving/scheduler.py reads these) ------------
+    def outstanding(self) -> int:
+        """Admitted requests without an outcome yet — the least-outstanding
+        dispatch signal."""
+        return self._batcher.outstanding()
+
+    def queued_rows(self) -> int:
+        return self._batcher.queued_rows()
+
+    def queue_depth(self) -> int:
+        return self._batcher.queue_depth
+
+    # burn-verdict cache TTL: effective_state() sits on the ROUTER'S
+    # dispatch hot path (scheduler.pick calls it per candidate per submit),
+    # and the naive burn computation copies + scans the whole latency ring
+    # (up to the 64k sample cap) under the global durations lock — per
+    # request, that is throughput collapse exactly at the QPS where routing
+    # matters.  Rotation decisions don't need sub-quarter-second burn
+    # freshness, so one scan per TTL per replica amortizes it away.
+    _BURN_CACHE_S = 0.25
+
+    def _slo_burn(self) -> float:
+        """Burn fraction over the latency window vs SRML_SERVE_SLO_MS
+        (0.0 with no SLO configured or no samples), cached _BURN_CACHE_S."""
+        slo_ms = _slo_ms()
+        if slo_ms <= 0:
+            return 0.0
+        now = profiling.now()
+        t, cached = self._burn_cache  # tuple read: GIL-atomic
+        if now - t < self._BURN_CACHE_S:
+            return cached
+        samples = profiling.durations(f"serve.{self.name}.latency").get(
+            f"serve.{self.name}.latency", []
+        )
+        burn = 0.0
+        if samples:
+            met = sum(1 for s in samples if s * 1000.0 <= slo_ms)
+            burn = 1.0 - met / len(samples)
+        self._burn_cache = (now, burn)
+        return burn
+
+    def effective_state(self) -> str:
+        """Lifecycle state with the SLO-burn DEGRADED overlay applied —
+        the router's rotation signal.  state() alone never reports
+        DEGRADED: burn is a derived, windowed verdict that health()
+        computes; the router needs the same verdict without the rest of
+        the health document."""
+        state = self.state()
+        if state == READY and self._slo_burn() > _slo_burn_budget():
+            return DEGRADED
+        return state
 
     def health(self) -> Dict[str, Any]:
         """SLO-scored health: lifecycle state, p99 vs SRML_SERVE_SLO_MS,
@@ -854,6 +1118,7 @@ class ModelServer:
             "max_batch": self._batcher.max_batch,
             "max_wait_ms": self._batcher.max_wait_s * 1000.0,
             "queue_depth": self._batcher.queue_depth,
+            "inflight_depth": self.inflight_depth,
             "queued_rows": self._batcher.queued_rows(),
             "queued_requests": self._batcher.queued_requests(),
             "counters": profiling.counters(self.ns + "."),

@@ -135,6 +135,43 @@ def kernel_entry(
     )
 
 
+def entry_signature(entry: "ServingEntry") -> tuple:
+    """The client-visible serving contract of an entry: feature width,
+    dtype, and output columns.  Two models with equal signatures are
+    hot-swappable — every in-flight and future request that was valid
+    against one is valid against the other."""
+    return (
+        int(entry.n_cols),
+        str(np.dtype(entry.dtype)),
+        tuple(sorted(entry.out_cols)),
+    )
+
+
+def check_swap_compatible(
+    old: "ServingEntry", new: "ServingEntry", name: str
+) -> None:
+    """Raise ValueError naming every signature mismatch — the registry/
+    router swap() guard.  A width or dtype change would make already-
+    admitted requests dispatch garbage; an output-column change would break
+    every client parsing the result dict.  Incompatible model upgrades are
+    a REGISTER-under-a-new-name event, not a swap."""
+    mismatches = []
+    if int(old.n_cols) != int(new.n_cols):
+        mismatches.append(f"n_cols {old.n_cols} -> {new.n_cols}")
+    if np.dtype(old.dtype) != np.dtype(new.dtype):
+        mismatches.append(f"dtype {np.dtype(old.dtype)} -> {np.dtype(new.dtype)}")
+    if sorted(old.out_cols) != sorted(new.out_cols):
+        mismatches.append(
+            f"out_cols {sorted(old.out_cols)} -> {sorted(new.out_cols)}"
+        )
+    if mismatches:
+        raise ValueError(
+            f"swap({name!r}): incoming model is not serving-compatible "
+            f"({'; '.join(mismatches)}); register it under a new name "
+            "instead"
+        )
+
+
 def entry_for(model: Any, mesh: Any = None) -> ServingEntry:
     """The model's serving entry via its `_serving_entry` hook, with a
     uniform error for models that have no online-inference path."""

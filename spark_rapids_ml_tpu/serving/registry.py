@@ -82,6 +82,65 @@ class ModelRegistry:
             )
         return self.register(name, obj, **overrides)
 
+    def swap(
+        self,
+        name: str,
+        new_model: Any,
+        *,
+        drain_timeout_s: float = 60.0,
+        **overrides: Any,
+    ) -> ModelServer:
+        """Zero-downtime hot swap: warm a NEW server for `new_model` (its
+        buckets compile — or, for a same-shape model class, re-warm from
+        the retained AOT executable cache, zero new compiles), verify the
+        serving signature matches the old generation, atomically cut the
+        name over, then drain the old generation so its in-flight requests
+        complete before teardown.  Traffic admitted after the cut-over
+        lands on the new model; traffic admitted before it completes on
+        the old one — no request is dropped, no submit window is closed.
+
+        Raises KeyError for unknown/still-warming names and ValueError
+        (from entry.check_swap_compatible) for a model whose feature
+        width, dtype, or output columns differ — an incompatible upgrade
+        is a register-under-a-new-name event, not a swap."""
+        from .. import profiling
+        from .entry import check_swap_compatible
+
+        with self._lock:
+            old = self._servers.get(name)
+        if old is None:
+            raise KeyError(f"no served model named {name!r} to swap")
+        t0 = profiling.now()
+        with profiling.span(f"serve.{name}.swap"):
+            # warm BEFORE cut-over: the compile bill (zero for same-shape
+            # classes — the retained AOT cache survives the old server) is
+            # paid while the old generation still serves all traffic
+            incoming = ModelServer(
+                name, new_model, **{**self._defaults, **overrides}
+            )
+            try:
+                check_swap_compatible(old._entry, incoming._entry, name)
+                with self._lock:
+                    if self._servers.get(name) is not old:
+                        raise KeyError(
+                            f"serving entry {name!r} changed during swap "
+                            "(concurrent unregister/swap); aborting"
+                        )
+                    self._servers[name] = incoming  # the atomic cut-over
+            except BaseException:
+                incoming.shutdown(drain=False)
+                raise
+            # old generation: in-flight + already-queued requests drain to
+            # completion, then clean teardown.  A drain timeout still tears
+            # the old server down — the name already points at the new one.
+            try:
+                old.drain(timeout_s=drain_timeout_s)
+            finally:
+                old.shutdown(drain=False)
+        profiling.incr_counter(f"serving.{name}.swaps")
+        profiling.record_duration(f"serve.{name}.swap", profiling.now() - t0)
+        return incoming
+
     def get(self, name: str) -> ModelServer:
         with self._lock:
             server = self._servers.get(name)
@@ -145,17 +204,12 @@ class ModelRegistry:
 
     def _health_gauges(self) -> Dict[str, float]:
         """Gauge-provider view of health() for export_metrics()/Prometheus:
-        health.<model>.{state_code,attainment,burn,p99_ms,queued_rows}."""
-        out: Dict[str, float] = {}
-        for name, h in self.health()["models"].items():
-            out[f"health.{name}.state_code"] = float(h["state_code"])
-            if "attainment" in h:
-                out[f"health.{name}.attainment"] = float(h["attainment"])
-                out[f"health.{name}.burn"] = float(h["burn"])
-                out[f"health.{name}.queued_rows"] = float(h["queued_rows"])
-                if h.get("p99_ms") is not None:
-                    out[f"health.{name}.p99_ms"] = float(h["p99_ms"])
-        return out
+        health.<model>.{state_code,attainment,burn,p99_ms,queued_rows,
+        restarts} — flattened by the shared srml-watch rule, so registry
+        servers and router replicas render identically."""
+        from .. import watch
+
+        return watch.health_gauges(self.health()["models"])
 
     def telemetry(self, since: Optional[Any] = None) -> Any:
         """TelemetrySnapshot of the whole serving plane: every
@@ -172,30 +226,7 @@ class ModelRegistry:
             counters=profiling.counters("serving."),
             durations=profiling.duration_digests("serve."),
         )
-        if since is None:
-            return snap
-        ctr = {
-            k: v - since.counters.get(k, 0)
-            for k, v in snap.counters.items()
-            if v != since.counters.get(k, 0)
-        }
-        dur = {}
-        for k, d in snap.durations.items():
-            prev = since.durations.get(k)
-            if prev is None:
-                dur[k] = dict(d)
-                continue
-            dc = d["count"] - prev["count"]
-            if dc > 0:
-                # min/max cannot be un-merged; the window keeps the current
-                # extremes (documented in docs/observability.md)
-                dur[k] = {
-                    "count": dc,
-                    "sum_s": d["sum_s"] - prev["sum_s"],
-                    "min_s": d["min_s"],
-                    "max_s": d["max_s"],
-                }
-        return profiling.TelemetrySnapshot(counters=ctr, durations=dur)
+        return snap if since is None else snap.delta(since)
 
     def shutdown(self, drain: bool = True) -> None:
         from .. import profiling

@@ -439,6 +439,32 @@ def _watch_gauges() -> Dict[str, float]:
     return out
 
 
+# -- serving health-plane gauge flattening ------------------------------------
+# The ONE rule turning per-server/per-replica health dicts
+# (serving/engine.ModelServer.health shape) into gauge keys for the
+# srml_health Prometheus family.  ModelRegistry and the srml-router both
+# ride it, so a dashboard keyed on health.<name>.* reads a flat registry
+# and a replicated router identically — replicas just carry their
+# "<model>-r<i>" names, and per-replica restart counts flow as
+# health.<name>.restarts (the restart-storm signal per REPLICA, which the
+# plane-wide rollup total hides).
+def health_gauges(
+    models: Dict[str, Dict[str, Any]], prefix: str = "health"
+) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for name, h in models.items():
+        out[f"{prefix}.{name}.state_code"] = float(h["state_code"])
+        if "attainment" in h:
+            out[f"{prefix}.{name}.attainment"] = float(h["attainment"])
+            out[f"{prefix}.{name}.burn"] = float(h["burn"])
+            out[f"{prefix}.{name}.queued_rows"] = float(h["queued_rows"])
+            if h.get("p99_ms") is not None:
+                out[f"{prefix}.{name}.p99_ms"] = float(h["p99_ms"])
+        if "restarts" in h:
+            out[f"{prefix}.{name}.restarts"] = float(h["restarts"])
+    return out
+
+
 # -- flight dump --------------------------------------------------------------
 
 _dump_lock = threading.Lock()

@@ -80,6 +80,120 @@ def test_ann_save_load_kneighbors_equivalence(model_zoo, tmp_path):
         assert np.array_equal(a, b), f"ann: column {col!r} changed across save/load"
 
 
+# -- hot-swap persistence semantics (srml-router, docs/serving.md §router) ---
+# swap() is the deployment story for persisted models: fit -> save on the
+# training cluster, load -> swap on the serving one.  The gate is the same
+# bit-identical bar as the save/load matrix: a swapped-in loaded model must
+# serve EXACTLY what the in-memory model served.
+
+SWAP_ARMS = ["kmeans", "pca", "linreg", "logreg", "rf_clf", "rf_reg"]
+
+
+@pytest.mark.parametrize("arm", SWAP_ARMS)
+def test_save_load_swap_serving_equivalence(arm, model_zoo, tmp_path):
+    from spark_rapids_ml_tpu.serving import ModelRegistry
+
+    model, X = model_zoo(arm)
+    path = str(tmp_path / arm)
+    model.save(path)
+    loaded = core_load(path)
+    reg = ModelRegistry(max_batch=16, max_wait_ms=2)
+    try:
+        reg.register(arm, model)
+        before = reg.get(arm).predict(X[:5])
+        incoming = reg.swap(arm, loaded)
+        assert reg.get(arm) is incoming  # the name now serves the new gen
+        after = reg.get(arm).predict(X[:5])
+        assert sorted(before) == sorted(after)
+        for col in before:
+            assert np.array_equal(
+                np.asarray(before[col]), np.asarray(after[col])
+            ), f"{arm}: served column {col!r} changed across swap"
+    finally:
+        reg.shutdown(drain=False)
+
+
+def test_swap_same_shape_is_zero_new_compiles(model_zoo, tmp_path):
+    """The cut-over compile gate, registry side: a same-shape successor
+    (save -> load of the SAME class/geometry) warms entirely from the
+    retained AOT executable cache — zero new compiles while the old
+    generation still serves, zero at cut-over."""
+    from spark_rapids_ml_tpu import profiling
+    from spark_rapids_ml_tpu.serving import ModelRegistry
+
+    model, X = model_zoo("kmeans")
+    path = str(tmp_path / "swap_km")
+    model.save(path)
+    loaded = core_load(path)
+    reg = ModelRegistry(max_batch=16, max_wait_ms=2)
+    try:
+        reg.register("swap_km", model)
+        reg.get("swap_km").predict(X[:4])
+        before = profiling.counters("precompile.")
+        reg.swap("swap_km", loaded)
+        delta = profiling.counter_deltas(before, "precompile.")
+        assert delta.get("precompile.compile", 0) == 0, delta
+        assert delta.get("precompile.fallback", 0) == 0, delta
+        assert profiling.counter("serving.swap_km.swaps") == 1
+        out = reg.get("swap_km").predict(X[:4])
+        assert out["prediction"].shape == (4,)
+        reg.get("swap_km").drain()
+        reg.get("swap_km").assert_steady_state()
+    finally:
+        reg.shutdown(drain=False)
+
+
+def test_swap_drains_inflight_requests_on_old_generation(model_zoo):
+    """swap-during-drain: requests admitted BEFORE the cut-over complete on
+    the old generation (drained, not dropped) while the name already
+    points at the successor — no request is lost across the swap."""
+    from spark_rapids_ml_tpu.serving import ModelRegistry
+
+    model, X = model_zoo("kmeans")
+    reg = ModelRegistry(max_batch=16, max_wait_ms=25)
+    try:
+        reg.register("swap_drain", model)
+        old = reg.get("swap_drain")
+        old.predict(X[:2])
+        # a burst still coalescing in the OLD generation's batcher when the
+        # swap begins (25 ms window >> the swap's cut-over instant)
+        futs = [old.submit(X[i : i + 1]) for i in range(6)]
+        incoming = reg.swap("swap_drain", model)
+        assert reg.get("swap_drain") is incoming
+        for f in futs:  # drained on the old generation, every one resolved
+            assert f.result(timeout=30)["prediction"].shape == (1,)
+        assert incoming.predict(X[:3])["prediction"].shape == (3,)
+    finally:
+        reg.shutdown(drain=False)
+
+
+def test_swap_incompatible_model_fails_clean(model_zoo):
+    """swap-to-incompatible: a model whose serving signature differs
+    (here: feature width) raises BEFORE any cut-over, and the old server
+    keeps serving untouched.  Unknown names raise KeyError."""
+    from spark_rapids_ml_tpu import KMeans
+    from spark_rapids_ml_tpu.serving import ModelRegistry
+
+    model, X = model_zoo("kmeans")
+    narrow = (
+        KMeans(k=2, maxIter=2, seed=1)
+        .setFeaturesCol("features")
+        .fit(DataFrame.from_numpy(X[:, :3], feature_layout="array"))
+    )
+    reg = ModelRegistry(max_batch=16, max_wait_ms=2)
+    try:
+        reg.register("swap_bad", model)
+        old = reg.get("swap_bad")
+        with pytest.raises(ValueError, match="n_cols 5 -> 3"):
+            reg.swap("swap_bad", narrow)
+        assert reg.get("swap_bad") is old  # untouched, still serving
+        assert old.predict(X[:2])["prediction"].shape == (2,)
+        with pytest.raises(KeyError, match="no served model"):
+            reg.swap("no_such_model", model)
+    finally:
+        reg.shutdown(drain=False)
+
+
 def test_loaded_model_attributes_round_trip(model_zoo, tmp_path):
     # spot-check the attribute payload itself (npz + json split): arrays
     # stay arrays, scalars stay scalars
