@@ -49,6 +49,9 @@ REF_GPU_SECONDS = {
     "rf_clf": 59.0,
     "rf_reg": 52.0,
     "umap": 82.0,     # no published UMAP bar; kmeans-scale floor like knn
+    # no published tuning bar; scored against the linreg bar as a floor on
+    # trained row-visits/sec (rows x candidates x (folds-1) per sweep)
+    "tuning": 32.0,
     # BASELINE.json's "LogisticRegression multinomial on 1Bx100 sparse" has
     # no published time; scored against the dense logreg bar as a floor
     # (different shape: 100 sparse cols vs 3000 dense — see docs)
@@ -60,7 +63,7 @@ REF_GPU_SECONDS = {
 # that is the whole point of the normalized metric)
 CYCLE_ARMS = [
     "kmeans", "pca", "linreg", "logreg", "logreg_sparse",
-    "knn", "ann", "rf_reg", "rf_clf", "umap",
+    "knn", "ann", "rf_reg", "rf_clf", "umap", "tuning",
 ]
 CYCLE_OVERRIDES = {
     # 1M x 100 sparse (the BASELINE.json shape family, 4x smaller)
@@ -515,6 +518,50 @@ def build_arm(algo: str, overrides):
             return float(model.getNumTrees)
 
         return fit, f"{algo}_fit_throughput_d{cols}", rows
+
+    if algo == "tuning":
+        # srml-sweep: an m-candidate x k-fold CrossValidator through the
+        # batched one-dispatch engine (docs/tuning_engine.md).  Host-facade
+        # frame on purpose: the sweep's scoring pass reads host partitions
+        # (from_device frames are fit-input-only), and the repeat runs ride
+        # the device-input cache so the staging is untimed after warm-up —
+        # what the clock holds is the sweep itself (masked-fold stats,
+        # lane solves, fold scoring, winner refit).  Throughput counts
+        # TRAINED ROW-VISITS: rows x candidates x (folds-1)/folds x folds.
+        from spark_rapids_ml_tpu import LinearRegression
+        from spark_rapids_ml_tpu.dataframe import DataFrame
+        from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+        from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+        rows = int(_ov("SRML_BENCH_ROWS", 100_000 if on_accel else 20_000))
+        cols = int(_ov("SRML_BENCH_COLS", 512 if on_accel else 128))
+        m = int(_ov("SRML_BENCH_GRID", 8))
+        k_folds = int(_ov("SRML_BENCH_FOLDS", 3))
+        X_host = rng.standard_normal((rows, cols), dtype=np.float32)
+        coef = rng.standard_normal(cols, dtype=np.float32)
+        y = (X_host @ coef + 0.1 * rng.standard_normal(rows)).astype(
+            np.float32
+        )
+        df = DataFrame.from_numpy(X_host, y=y, num_partitions=4)
+        grid = ParamGridBuilder().addGrid(
+            LinearRegression.regParam, np.geomspace(1e-3, 1.0, m).tolist()
+        ).build()
+
+        def fit():
+            cv = CrossValidator(
+                estimator=LinearRegression(standardization=False),
+                estimatorParamMaps=grid,
+                evaluator=RegressionEvaluator(),
+                numFolds=k_folds,
+                seed=7,
+            )
+            return float(cv.fit(df).avgMetrics[0])
+
+        return (
+            fit,
+            f"tuning_sweep_throughput_n{rows}_d{cols}_m{m}_k{k_folds}",
+            rows * m * (k_folds - 1),
+        )
 
     if algo == "umap":
         from spark_rapids_ml_tpu import UMAP

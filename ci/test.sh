@@ -408,6 +408,52 @@ assert swap[0]["completed"] == swap[0]["requests"], swap
 EOF
 rm -rf "$ROUTER_SMOKE"
 
+# 3l. srml-sweep batched-tuning gates (also inside the full suite;
+#     re-asserted by name so marker drift can never silently drop them —
+#     docs/tuning_engine.md).  Runs on the 8-device CPU mesh, forced
+#     explicitly:
+#     - EXACT batched-vs-sequential equality: avgMetrics/stdMetrics/
+#       best_index and sub-model coefficients on 1/2/8-device meshes
+#       (linreg bitwise; logreg exact metrics + trajectory-tolerance
+#       coefficients), incl. the m=1 grid, the k>rows-per-fold edge, and
+#       the cluster-side sequential CV vs the local batched sweep
+#     - ONE staged dataset per sweep (ingest.staged transfer counter) and
+#       ZERO new compiles on a repeat same-shape sweep with different grid
+#       values (the candidate-bucket AOT key: lanes are traced, not baked)
+#     - kill switch + fallbacks: SRML_SWEEP_BATCH=0, non-lane-batchable
+#       grid params, and sparse CSR input all keep the legacy fold loop
+#     plus a graftlint-clean re-check of the touched modules by name, and
+#     a bench_tuning smoke at the default CI shape asserting the batched
+#     route beats the sequential one in candidates/sec on BOTH solver
+#     families and repeats with zero new kernel compilations.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_tuning.py -q -k "batched_sweep or cv_copy"
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_spark_cv.py -q -k "batched"
+python -m tools.graftlint spark_rapids_ml_tpu/ops/sweep.py \
+    spark_rapids_ml_tpu/ops/glm.py spark_rapids_ml_tpu/ops/lbfgs.py \
+    spark_rapids_ml_tpu/ops/logistic.py spark_rapids_ml_tpu/tuning.py \
+    spark_rapids_ml_tpu/models/linear_regression.py \
+    spark_rapids_ml_tpu/models/logistic_regression.py \
+    spark_rapids_ml_tpu/dataframe.py benchmark/bench_tuning.py
+TUNE_SMOKE=$(mktemp -d)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.bench_tuning --algos linreg,logreg \
+    --rows 20000 --cols 64 --num_folds 3 --grid_size 8 --num_runs 1 \
+    --report_path "$TUNE_SMOKE/tuning.jsonl"
+python - "$TUNE_SMOKE/tuning.jsonl" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1])]
+assert {r["algo"] for r in recs} == {"linreg", "logreg"}, recs
+for r in recs:
+    assert r["batched_cps"] > r["sequential_cps"], r   # the perf acceptance bar
+    assert r["repeat_new_compiles"] == 0, r            # candidate-bucket AOT key
+    assert r["phase_times"].get("tuning.sweep.solve", 0) > 0, r
+    # cumulative across the arm's warm-up + timed batched sweeps
+    assert r["counters"].get("tuning.candidates", 0) >= r["grid_size"], r
+EOF
+rm -rf "$TUNE_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

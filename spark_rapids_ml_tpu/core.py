@@ -365,6 +365,7 @@ class _TpuCaller(_TpuParams):
         cached = _FIT_INPUT_CACHE.get("slot")
         if cached is not None and cached[0] == cache_key:
             Xs, n_rows, n_cols, _host_refs = cached[1]
+            profiling.incr_counter("ingest.cache_hit")
         elif any(hasattr(f, "tocsr") for f in nonempty):
             # sparse ingest: CSR partitions -> one padded ELL pair, row-
             # sharded like a dense block (ops/sparse.py).  No densification
@@ -376,6 +377,9 @@ class _TpuCaller(_TpuParams):
             _FIT_INPUT_CACHE.pop("slot", None)
             csr = sp.vstack(nonempty).tocsr() if len(nonempty) > 1 else nonempty[0]
             n_rows, n_cols = csr.shape
+            # ingest.staged counts DATASET uploads: the batched sweep's
+            # "one staged dataset per sweep" contract is gated on it
+            profiling.incr_counter("ingest.staged")
             with profiling.phase("srml.device_put"):
                 Xs = ell_device_from_scipy(csr, dtype=dtype, mesh=mesh)
             if cacheable:
@@ -391,6 +395,7 @@ class _TpuCaller(_TpuParams):
 
             X = _concat_and_free(list(nonempty), order="C")
             n_rows, n_cols = X.shape
+            profiling.incr_counter("ingest.staged")
             with profiling.phase("srml.device_put"):
                 Xs, _ = shard_rows(X, mesh)
             if cacheable:
@@ -704,31 +709,81 @@ class _TpuEstimator(_TpuCaller):
             assert len(results) == 1
         models = []
         for i, attrs in enumerate(results if isinstance(results, list) else [results]):
-            telem = attrs.pop(TELEMETRY_ATTR, None)
-            model = self._create_model(attrs)
-            if telem is not None:
-                from . import profiling
-
-                model._fit_telemetry = profiling.TelemetrySnapshot.from_dict(
-                    telem
-                )
-            self._copyValues(model)
-            model._tpu_params.update(self._tpu_params)
-            model._num_workers = self._num_workers
-            model._float32_inputs = self._float32_inputs
-            if paramMaps is not None and i < len(paramMaps):
-                for p, v in paramMaps[i].items():
-                    if model.hasParam(p.name):
-                        # _set_params keeps the Spark param and the solver
-                        # param dict in sync (raw set() would desync them)
-                        model._set_params(**{p.name: v})
-            models.append(model)
+            pm = paramMaps[i] if paramMaps is not None and i < len(paramMaps) else None
+            models.append(self._materialize_model(attrs, pm))
         return models
+
+    def _materialize_model(
+        self, attrs: Dict[str, Any], paramMap: Optional[Dict[Param, Any]] = None
+    ) -> "_TpuModel":
+        """Model-attribute dict -> model, with the ONE materialization
+        bookkeeping every fit route shares (_fit_internal's loop and the
+        batched sweep's tuning._materialize_sweep_models): telemetry popped
+        off the wire dict onto model._fit_telemetry, copied estimator
+        values, synced solver params, and the param map's own grid values
+        set through _set_params — so a sweep sub-model is indistinguishable
+        from its sequential twin by construction, not by hand-synced
+        copies."""
+        telem = attrs.pop(TELEMETRY_ATTR, None)
+        model = self._create_model(attrs)
+        if telem is not None:
+            from . import profiling
+
+            model._fit_telemetry = profiling.TelemetrySnapshot.from_dict(telem)
+        self._copyValues(model)
+        model._tpu_params.update(self._tpu_params)
+        model._num_workers = self._num_workers
+        model._float32_inputs = self._float32_inputs
+        if paramMap is not None:
+            for p, v in paramMap.items():
+                if model.hasParam(p.name):
+                    # _set_params keeps the Spark param and the solver
+                    # param dict in sync (raw set() would desync them)
+                    model._set_params(**{p.name: v})
+        return model
 
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         return False
 
     def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        return False
+
+    # -- batched hyperparameter sweep (srml-sweep) -------------------------
+    def _supportsBatchedSweep(
+        self, df: DataFrame, paramMaps: List[Dict[Param, Any]], evaluator: Any
+    ) -> bool:
+        """Whether a CrossValidator sweep over `paramMaps` can run as the
+        one-dispatch batched engine (docs/tuning_engine.md): every grid
+        param must map onto a lane-batchable solver knob and the evaluator
+        must ride the single-pass transform-evaluate.  Estimators with
+        vmappable solvers (the GLMs) override this; the default keeps the
+        classic per-fold loop."""
+        return False
+
+    def _fitBatchedSweep(
+        self,
+        df: DataFrame,
+        paramMaps: List[Dict[Param, Any]],
+        n_folds: int,
+        seed: int,
+    ) -> List[List[Dict[str, Any]]]:
+        """Fit every (fold, candidate) pair over ONE staged dataset (folds
+        as weight masks, candidates as kernel lanes); returns n_folds lists
+        of per-candidate model-attribute dicts.  Only called when
+        _supportsBatchedSweep returned True."""
+        raise NotImplementedError
+
+    def _sweep_sparse_input(self, df: DataFrame) -> bool:
+        """True when any partition carries a sparse CSR feature block —
+        the batched sweep keeps those on the legacy loop (masked-fold ELL
+        statistics are a documented non-goal, docs/tuning_engine.md)."""
+        input_col, _ = self._get_input_columns()
+        if input_col is None:
+            return False
+        for part in df.partitions:
+            block = _partition_feature_block(part, input_col)
+            if block is not None and hasattr(block, "tocsr"):
+                return True
         return False
 
     # -- abstract ----------------------------------------------------------

@@ -294,16 +294,14 @@ class DataFrame:
 
     def randomSplit(self, weights: List[float], seed: int = 0) -> List["DataFrame"]:
         pdf = self.toPandas()
-        rng = np.random.default_rng(seed)
-        perm = rng.permutation(len(pdf))
-        total = float(sum(weights))
-        bounds = np.cumsum([w / total for w in weights])[:-1]
-        cut = (bounds * len(pdf)).astype(int)
-        idx_groups = np.split(perm, cut)
+        split_id = random_split_ids(len(pdf), weights, seed)
         nparts = max(1, len(self._partitions))
         return [
-            DataFrame.from_pandas(pdf.iloc[np.sort(g)].reset_index(drop=True), nparts)
-            for g in idx_groups
+            DataFrame.from_pandas(
+                pdf.iloc[np.flatnonzero(split_id == i)].reset_index(drop=True),
+                nparts,
+            )
+            for i in range(len(weights))
         ]
 
     # -- execution ---------------------------------------------------------
@@ -356,6 +354,31 @@ class DataFrame:
 
     def __repr__(self) -> str:
         return f"DataFrame[{', '.join(self.columns)}] ({self.num_partitions} partitions)"
+
+
+def random_split_ids(
+    n: int, weights: Union[int, List[float]], seed: int = 0
+) -> np.ndarray:
+    """Per-row split assignment of ``randomSplit(weights, seed)``: row r of
+    the concatenated frame lands in split ``random_split_ids(...)[r]``.
+
+    This is the ONE definition of the seeded-permutation split, shared by
+    DataFrame.randomSplit (which materializes the split frames) and the
+    batched sweep engine (ops/sweep), which folds with weight MASKS over one
+    staged dataset — sharing the assignment here is what guarantees the two
+    routes can never disagree on fold membership.  ``weights`` may be an
+    int k, shorthand for k equal folds (the CrossValidator case)."""
+    if isinstance(weights, int):
+        weights = [1.0] * weights
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    total = float(sum(weights))
+    bounds = np.cumsum([w / total for w in weights])[:-1]
+    cut = (bounds * n).astype(int)
+    split_id = np.empty(n, dtype=np.int32)
+    for i, g in enumerate(np.split(perm, cut)):
+        split_id[g] = i
+    return split_id
 
 
 def _split_pandas(pdf: pd.DataFrame, n: int) -> List[pd.DataFrame]:

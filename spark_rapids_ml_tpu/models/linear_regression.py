@@ -48,6 +48,9 @@ from ..ops.glm import (
     multi_linear_predict_kernel,
     solve_elasticnet_cd,
     solve_linear,
+    sweep_linreg_fold_stats,
+    sweep_solve_elasticnet_cd,
+    sweep_solve_linear,
 )
 from ..utils import get_logger
 
@@ -105,6 +108,24 @@ class _RegressionModelEvaluationMixIn:
             ):
                 metrics[i] = m if metrics[i] is None else metrics[i].merge(m)
         return [m.evaluate(evaluator) for m in metrics]  # type: ignore[union-attr]
+
+
+def _host_intercept(
+    coef64: np.ndarray, x_mean, y_mean, fit_intercept: bool
+) -> float:
+    """intercept = y_mean - x_mean . coef, derived on HOST in float64 from
+    the replicated means.  Kept out of the solver kernels deliberately: the
+    same 6-element f32 dot compiles with different fusion (fma) context in
+    the solo-fit and lane-batched sweep programs and drifts a ulp, which is
+    exactly the drift the sweep's batched == sequential exact-equality gate
+    exists to forbid.  The host form is identical on both routes by
+    construction (and slightly more precise)."""
+    if not fit_intercept:
+        return 0.0
+    return float(
+        np.asarray(y_mean, dtype=np.float64)
+        - np.asarray(x_mean, dtype=np.float64) @ coef64
+    )
 
 
 class LinearRegressionClass(_TpuParams):
@@ -252,13 +273,13 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
             if alpha == 0.0 or l1_ratio == 0.0:
                 # OLS ("eig") or Ridge with Spark-parity alpha*n scaling —
                 # scaling handled inside solve_linear (reg = alpha * wsum)
-                coef, intercept = solve_linear(
+                coef, _ = solve_linear(
                     stats, alpha, fit_intercept=fit_intercept, normalize=normalize
                 )
             else:
                 # n_iter joins the batched fetch below — int() here would
                 # pay its own device round-trip
-                coef, intercept, n_iter = solve_elasticnet_cd(
+                coef, _, n_iter = solve_elasticnet_cd(
                     stats,
                     alpha,
                     l1_ratio,
@@ -269,14 +290,15 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                 )
             # one batched device fetch (separate np.asarray/float coercions
             # each cost a host round-trip through the tunneled device)
-            coef_h, intercept_h, n_iter_h = jax.device_get(
-                (coef, intercept, n_iter)
+            coef_h, xm_h, ym_h, n_iter_h = jax.device_get(
+                (coef, stats.x_mean, stats.y_mean, n_iter)
             )
             if n_iter_h is not None:
                 logger.info("CD sweeps: %d", int(n_iter_h))
+            coef64 = np.asarray(coef_h, dtype=np.float64)
             return {
-                "coef_": np.asarray(coef_h, dtype=np.float64),
-                "intercept_": float(intercept_h),
+                "coef_": coef64,
+                "intercept_": _host_intercept(coef64, xm_h, ym_h, fit_intercept),
                 "n_cols": inputs.n_cols,
                 "dtype": str(inputs.dtype),
             }
@@ -309,6 +331,175 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
 
     def _create_model(self, result: Dict[str, Any]) -> "LinearRegressionModel":
         return LinearRegressionModel(**result)
+
+    # -- batched hyperparameter sweep (srml-sweep) -------------------------
+    def _supportsBatchedSweep(self, df, paramMaps, evaluator) -> bool:
+        if not paramMaps or not self._supportsTransformEvaluate(evaluator):
+            return False
+        try:
+            overrides = [self._paramMap_to_tpu_overrides(pm) for pm in paramMaps]
+        except ValueError:
+            # unsupported value: let the legacy loop raise its own error
+            return False
+        if any(set(ov) - {"alpha", "l1_ratio"} for ov in overrides):
+            return False  # only the regularizer axes batch as lanes
+        return not self._sweep_sparse_input(df)
+
+    def _fitBatchedSweep(self, df, paramMaps, n_folds, seed):
+        """All n_folds x len(paramMaps) linreg fits as a fused masked-fold
+        stats pass + one stacked-lane solve dispatch per solver family over
+        the ONE staged dataset (ops/glm.py sweep kernels; exact-equality
+        contract in docs/tuning_engine.md)."""
+        from .. import profiling
+        from ..core import _maybe_x64
+        from ..ops import sweep as sweep_ops
+        from ..sanitize import sanitize_scope
+
+        input_col, input_cols = self._get_input_columns()
+        params = dict(self._tpu_params)
+        cand = []
+        for pm in paramMaps:
+            p = dict(params)
+            p.update(self._paramMap_to_tpu_overrides(pm))
+            cand.append((float(p["alpha"]), float(p["l1_ratio"])))
+        fit_intercept = bool(params["fit_intercept"])
+        normalize = bool(params["normalize"])
+        statics = {"fit_intercept": fit_intercept, "normalize": normalize}
+        # same solver choice per candidate as _single_fit: OLS/Ridge closed
+        # form when the L1 term vanishes, covariance-update CD otherwise
+        closed = [i for i, (a, l1r) in enumerate(cand) if a == 0.0 or l1r == 0.0]
+        cd = [i for i in range(len(cand)) if i not in closed]
+        with _maybe_x64(self._use_dtype(df, input_col, input_cols)):
+            with profiling.phase("srml.ingest"):
+                inputs = self._build_fit_inputs(df)
+            assert inputs.y is not None
+            mesh = inputs.mesh
+            fid = sweep_ops.stage_fold_ids(
+                inputs.n_rows, inputs.X.shape[0], n_folds, seed, mesh
+            )
+            # warm the solve kernels at sweep entry: their lowerings are
+            # known from shapes alone (stacked stats are mesh-replicated),
+            # so they compile on the pool WHILE the stats pass runs
+            compute_dt = np.dtype(inputs.dtype)
+            if compute_dt in (np.dtype(np.float32), np.dtype(np.float64)):
+                d = inputs.n_cols
+                aval = lambda shape: sweep_ops.replicated_aval(  # noqa: E731
+                    shape, compute_dt, mesh
+                )
+                from ..ops.glm import LinregStats
+
+                stats_avals = LinregStats(
+                    wsum=aval((n_folds,)),
+                    x_mean=aval((n_folds, d)),
+                    y_mean=aval((n_folds,)),
+                    G=aval((n_folds, d, d)),
+                    c=aval((n_folds, d)),
+                    y2=aval((n_folds,)),
+                )
+                entries = []
+                if closed:
+                    mb = sweep_ops.candidate_bucket(len(closed))
+                    entries.append(
+                        (
+                            "sweep.linreg.solve",
+                            sweep_solve_linear,
+                            (stats_avals, aval((mb,))),
+                            dict(statics),
+                        )
+                    )
+                if cd:
+                    mb = sweep_ops.candidate_bucket(len(cd))
+                    entries.append(
+                        (
+                            "sweep.linreg.cd",
+                            sweep_solve_elasticnet_cd,
+                            (stats_avals, aval((mb,)), aval((mb,)), aval(())),
+                            dict(statics, max_iter=int(params["max_iter"])),
+                        )
+                    )
+                sweep_ops.warm(entries, mesh=mesh)
+            with sanitize_scope():
+                with profiling.span(
+                    "tuning.sweep.stats", folds=n_folds, rows=inputs.n_rows
+                ):
+                    stats = sweep_ops.dispatch(
+                        "sweep.linreg.stats",
+                        sweep_linreg_fold_stats,
+                        inputs.X,
+                        inputs.y,
+                        inputs.weight,
+                        fid,
+                        mesh=mesh,
+                        k=n_folds,
+                    )
+                results: List[List[Dict[str, Any]]] = [
+                    [None] * len(cand) for _ in range(n_folds)  # type: ignore[list-item]
+                ]
+                xm_h, ym_h = jax.device_get((stats.x_mean, stats.y_mean))
+
+                def _collect(idxs, coef_h, n_iter_h=None):
+                    for j, i in enumerate(idxs):
+                        for f in range(n_folds):
+                            coef64 = np.asarray(coef_h[f, j], dtype=np.float64)
+                            results[f][i] = {
+                                "coef_": coef64,
+                                # same host float64 derivation as _single_fit
+                                # (see _host_intercept): bit-equal across the
+                                # batched and sequential routes
+                                "intercept_": _host_intercept(
+                                    coef64, xm_h[f], ym_h[f], fit_intercept
+                                ),
+                                "n_cols": inputs.n_cols,
+                                "dtype": str(inputs.dtype),
+                            }
+                    if n_iter_h is not None:
+                        get_logger(type(self)).info(
+                            "sweep CD sweeps (fold x candidate): %s",
+                            np.asarray(n_iter_h)[:, : len(idxs)].tolist(),
+                        )
+
+                with profiling.span(
+                    "tuning.sweep.solve", candidates=len(cand), folds=n_folds
+                ):
+                    if closed:
+                        bucket = sweep_ops.candidate_bucket(len(closed))
+                        alphas = jax.numpy.asarray(
+                            sweep_ops.pad_lanes([cand[i][0] for i in closed], bucket)
+                        )
+                        coef, _ = sweep_ops.dispatch(
+                            "sweep.linreg.solve",
+                            sweep_solve_linear,
+                            stats,
+                            alphas,
+                            mesh=mesh,
+                            **statics,
+                        )
+                        _collect(closed, jax.device_get(coef))
+                    if cd:
+                        bucket = sweep_ops.candidate_bucket(len(cd))
+                        alphas = jax.numpy.asarray(
+                            sweep_ops.pad_lanes([cand[i][0] for i in cd], bucket)
+                        )
+                        l1s = jax.numpy.asarray(
+                            sweep_ops.pad_lanes([cand[i][1] for i in cd], bucket)
+                        )
+                        tol = jax.numpy.asarray(
+                            np.float64(float(params["tol"]))
+                        )
+                        coef, _, n_iter = sweep_ops.dispatch(
+                            "sweep.linreg.cd",
+                            sweep_solve_elasticnet_cd,
+                            stats,
+                            alphas,
+                            l1s,
+                            tol,
+                            mesh=mesh,
+                            max_iter=int(params["max_iter"]),
+                            **statics,
+                        )
+                        coef_h, n_iter_h = jax.device_get((coef, n_iter))
+                        _collect(cd, coef_h, n_iter_h)
+        return results
 
 
 class LinearRegressionModel(

@@ -51,6 +51,7 @@ from ..ops.logistic import (
     logistic_fit_kernel,
     scores_to_labels,
     scores_to_probs,
+    sweep_logistic_fit_kernel,
 )
 from ..utils import get_logger
 
@@ -346,6 +347,151 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
 
     def _create_model(self, result: Dict[str, Any]) -> "LogisticRegressionModel":
         return LogisticRegressionModel(**result)
+
+    # -- batched hyperparameter sweep (srml-sweep) -------------------------
+    def _supportsBatchedSweep(self, df, paramMaps, evaluator) -> bool:
+        if not paramMaps or not self._supportsTransformEvaluate(evaluator):
+            return False
+        try:
+            overrides = [self._paramMap_to_tpu_overrides(pm) for pm in paramMaps]
+        except ValueError:
+            return False
+        if any(set(ov) - {"C", "l1_ratio"} for ov in overrides):
+            return False  # only the regularizer axes batch as lanes
+        return not self._sweep_sparse_input(df)
+
+    def _fitBatchedSweep(self, df, paramMaps, n_folds, seed):
+        """All n_folds x len(paramMaps) logreg fits as ONE lane-batched
+        L-BFGS/OWL-QN run per penalty family over the ONE staged dataset —
+        folds as fold-id weight masks, candidates as traced reg/l1 lanes
+        with per-lane convergence masks (ops/logistic.py,
+        ops/lbfgs.minimize_lbfgs_batched)."""
+        from .. import profiling
+        from ..core import discover_label_classes
+        from ..ops import sweep as sweep_ops
+        from ..ops.labels import encode_labels_kernel
+        from ..sanitize import sanitize_scope
+
+        params = dict(self._tpu_params)
+        cand = []
+        for pm in paramMaps:
+            p = dict(params)
+            p.update(self._paramMap_to_tpu_overrides(pm))
+            C = float(p["C"])
+            l1_ratio = float(p.get("l1_ratio") or 0.0)
+            reg = 1.0 / C if C > 0 else 0.0
+            cand.append((reg, l1_ratio, reg > 0 and l1_ratio > 0))
+        fit_intercept = bool(params["fit_intercept"])
+        max_iter = int(params["max_iter"])
+        with profiling.phase("srml.ingest"):
+            inputs = self._build_fit_inputs(df)
+        assert inputs.y is not None
+        classes = discover_label_classes(inputs)
+        if len(classes) < 2:
+            raise RuntimeError(
+                "LogisticRegression requires at least two distinct labels"
+            )
+        num_classes = len(classes)
+        kcls = 1 if num_classes == 2 else num_classes
+        mesh = inputs.mesh
+        fid = sweep_ops.stage_fold_ids(
+            inputs.n_rows, inputs.X.shape[0], n_folds, seed, mesh
+        )
+        results: List[List[Dict[str, Any]]] = [
+            [None] * len(cand) for _ in range(n_folds)  # type: ignore[list-item]
+        ]
+        logger = get_logger(type(self))
+        with sanitize_scope():
+            y_enc = encode_labels_kernel(
+                inputs.y, jnp.asarray(classes.astype(inputs.y.dtype))
+            )
+            # one lane-batched run per penalty family (OWL-QN is a
+            # structurally different optimizer, so it cannot share lanes
+            # with the smooth-penalty group) — mirrors _single_fit's
+            # per-candidate use_owlqn choice
+            tol = jnp.asarray(np.float64(float(params["tol"])))
+            families = []
+            for owlqn in (False, True):
+                idxs = [i for i, c in enumerate(cand) if c[2] == owlqn]
+                if not idxs:
+                    continue
+                bucket = sweep_ops.candidate_bucket(len(idxs))
+                regs = jnp.asarray(
+                    sweep_ops.pad_lanes([cand[i][0] for i in idxs], bucket)
+                )
+                l1s = jnp.asarray(
+                    sweep_ops.pad_lanes([cand[i][1] for i in idxs], bucket)
+                )
+                families.append((owlqn, idxs, regs, l1s))
+            # warm BOTH penalty families' sweep kernels at entry (concrete
+            # args — the staged arrays themselves — so the derived keys and
+            # captured shardings are exactly the dispatch's): with a mixed
+            # grid the OWL-QN executable compiles on the pool WHILE the
+            # smooth family's sweep runs instead of serializing behind it
+            sweep_ops.warm(
+                [
+                    (
+                        "sweep.logreg.fit",
+                        sweep_logistic_fit_kernel,
+                        (inputs.X, y_enc, inputs.weight, fid, regs, l1s, tol),
+                        dict(
+                            k_folds=n_folds,
+                            kcls=kcls,
+                            fit_intercept=fit_intercept,
+                            max_iter=max_iter,
+                            use_owlqn=owlqn,
+                        ),
+                    )
+                    for owlqn, _idxs, regs, l1s in families
+                ],
+                mesh=mesh,
+            )
+            for owlqn, idxs, regs, l1s in families:
+                with profiling.span(
+                    "tuning.sweep.solve",
+                    candidates=len(idxs),
+                    folds=n_folds,
+                    owlqn=owlqn,
+                ):
+                    W, b, n_iter, conv = sweep_ops.dispatch(
+                        "sweep.logreg.fit",
+                        sweep_logistic_fit_kernel,
+                        inputs.X,
+                        y_enc,
+                        inputs.weight,
+                        fid,
+                        regs,
+                        l1s,
+                        tol,
+                        mesh=mesh,
+                        k_folds=n_folds,
+                        kcls=kcls,
+                        fit_intercept=fit_intercept,
+                        max_iter=max_iter,
+                        use_owlqn=owlqn,
+                    )
+                    # graftlint: disable=R1 (one batched fetch per penalty FAMILY — at most two iterations, each a distinct compiled sweep whose results ship home together)
+                    W_h, b_h, n_iter_h, conv_h = jax.device_get(
+                        (W, b, n_iter, conv)
+                    )
+                logger.info(
+                    "sweep L-BFGS iters (fold x candidate): %s converged: %s",
+                    n_iter_h[:, : len(idxs)].tolist(),
+                    conv_h[:, : len(idxs)].tolist(),
+                )
+                for j, i in enumerate(idxs):
+                    for f in range(n_folds):
+                        results[f][i] = {
+                            "coef_": np.asarray(W_h[f, j], dtype=np.float64),
+                            "intercept_": np.asarray(
+                                b_h[f, j], dtype=np.float64
+                            ),
+                            "classes_": np.asarray(classes, dtype=np.float64),
+                            "n_cols": inputs.n_cols,
+                            "dtype": str(inputs.dtype),
+                            "num_iters": int(n_iter_h[f, j]),
+                        }
+        return results
 
 
 class LogisticRegressionModel(

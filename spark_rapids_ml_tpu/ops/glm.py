@@ -190,6 +190,148 @@ def solve_elasticnet_cd(
     return b, intercept, n_iter
 
 
+# -- batched hyperparameter sweep (srml-sweep; docs/tuning_engine.md) --------
+# The sufficient-statistics design already makes extra param maps free
+# WITHIN a fold; these kernels extend that across folds and candidates so a
+# CrossValidator sweep of m (alpha, l1_ratio) candidates x k folds is a
+# handful of compiled dispatches over ONE staged dataset: the fold axis is
+# expressed as weight masks from a per-row fold id (zero re-staging), and
+# the candidate/fold solves run as stacked lanes inside one program.
+#
+# Lane driving is lax.map, NOT vmap, on purpose: lax.map inlines the exact
+# per-solve HLO of solve_linear / solve_elasticnet_cd per lane, so each
+# lane is bit-identical to the sequential path's solve on the same stats
+# (gated in tests/test_tuning.py), while a vmapped jnp.linalg.solve factors
+# the lanes through a batched LU whose low bits drift from the single-lane
+# factorization.  The lanes are (D, D) systems — tiny next to the data
+# scan — so serializing them inside the program costs nothing measurable.
+
+
+@partial(jax.jit, static_argnames=("k", "mesh", "chunk"))
+def sweep_linreg_fold_stats(
+    X: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    fold_id: jax.Array,
+    k: int = 2,
+    mesh=None,
+    chunk: int = 32768,
+) -> LinregStats:
+    """Per-fold TRAIN sufficient statistics from fold-id masks, leading
+    (k,) axis on every LinregStats field — one program over the one staged
+    dataset instead of k re-staged subset passes.
+
+    fold_id is int32, row-aligned with X (padded rows carry -1, and their
+    zero weight masks them out of every fold's train stats anyway).  Fold
+    f's train weights are ``w * (fold_id != f)``."""
+    if mesh is None:
+        per_fold = []
+        for f in range(k):
+            wf = w * (fold_id != f).astype(w.dtype)
+            wsum = wf.sum()
+            Xw = X * wf[:, None]
+            per_fold.append(
+                (
+                    wsum,
+                    Xw.sum(axis=0),
+                    exact_matmul(Xw.T, X),
+                    (y * wf).sum(),
+                    exact_matmul(Xw.T, y),
+                    (y * y * wf).sum(),
+                )
+            )
+    else:
+        from ..compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS
+        from .linalg import _local_moments
+
+        def per_device(X_loc, y_loc, w_loc, fid_loc):
+            outs = []
+            for f in range(k):
+                wf = w_loc * (fid_loc != f).astype(w_loc.dtype)
+                outs.append(_local_moments(X_loc, wf, chunk, y_loc=y_loc))
+            stacked = tuple(
+                jnp.stack([o[i] for o in outs]) for i in range(6)
+            )
+            return tuple(jax.lax.psum(s, DATA_AXIS) for s in stacked)
+
+        wsum, xwsum, G, ywsum, c, y2 = shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS),) * 4,
+            out_specs=(P(),) * 6,
+            check_vma=False,
+        )(X, y, w, fold_id)
+        return LinregStats(
+            wsum, xwsum / wsum[:, None], ywsum / wsum, G, c, y2
+        )
+    wsum, xwsum, G, ywsum, c, y2 = (
+        jnp.stack([pf[i] for pf in per_fold]) for i in range(6)
+    )
+    return LinregStats(wsum, xwsum / wsum[:, None], ywsum / wsum, G, c, y2)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "normalize", "mesh"))
+def sweep_solve_linear(
+    stats: LinregStats,
+    alphas: jax.Array,
+    fit_intercept: bool = True,
+    normalize: bool = False,
+    mesh=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """All (fold, candidate) closed-form OLS/Ridge solves in one dispatch:
+    stats carry a leading (k,) fold axis, alphas are the (m,) candidate
+    lanes; returns (coef (k, m, D), intercept (k, m)).  `mesh` only keys
+    the AOT executable cache (the solves run replicated)."""
+
+    def per_fold(st):
+        return jax.lax.map(
+            lambda a: solve_linear(
+                st, a, fit_intercept=fit_intercept, normalize=normalize
+            ),
+            alphas,
+        )
+
+    return jax.lax.map(per_fold, stats)
+
+
+@partial(
+    jax.jit, static_argnames=("fit_intercept", "normalize", "max_iter", "mesh")
+)
+def sweep_solve_elasticnet_cd(
+    stats: LinregStats,
+    alphas: jax.Array,
+    l1_ratios: jax.Array,
+    tol: jax.Array,
+    fit_intercept: bool = True,
+    normalize: bool = False,
+    max_iter: int = 1000,
+    mesh=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """All (fold, candidate) coordinate-descent solves in one dispatch;
+    each lane runs its OWN while_loop to its own convergence (lax.map), so
+    a lane's sweep count is exactly the sequential path's.  Returns
+    (coef (k, m, D), intercept (k, m), n_sweeps (k, m))."""
+
+    def per_fold(st):
+        return jax.lax.map(
+            lambda al: solve_elasticnet_cd(
+                st,
+                al[0],
+                al[1],
+                fit_intercept=fit_intercept,
+                normalize=normalize,
+                max_iter=max_iter,
+                tol=tol,
+            ),
+            (alphas, l1_ratios),
+        )
+
+    return jax.lax.map(per_fold, stats)
+
+
 @jax.jit
 def linear_predict_kernel(X: jax.Array, coef: jax.Array, intercept: jax.Array) -> jax.Array:
     from .sparse import EllMatrix, ell_matvec

@@ -68,6 +68,158 @@ def _two_loop(g, S, Y, rho, count, history):
     return q
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "value_and_grad", "max_iter", "history", "use_owlqn", "max_ls"
+    ),
+)
+def minimize_lbfgs_batched(
+    value_and_grad: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],
+    x0: jax.Array,
+    l1_weight: jax.Array,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    history: int = 10,
+    use_owlqn: bool = False,
+    max_ls: int = 20,
+) -> LbfgsResult:
+    """Lane-batched minimize_lbfgs for hyperparameter sweeps (srml-sweep).
+
+    x0/l1_weight are (L, P) — one lane per (fold, candidate) — and
+    value_and_grad maps (L, P) -> ((L,), (L, P)), evaluated for ALL lanes
+    each step so the data term is one fused contraction per iteration
+    instead of L separate fits.  The outer while_loop runs until every lane
+    converges; lanes that finished (their own convergence test, their own
+    iteration budget) take masked no-op updates — state, memory buffers and
+    iteration counters freeze exactly where the lane's solo run would have
+    stopped.  The line search is the same masked construction: each lane
+    halves its own step until its own Armijo test passes, frozen lanes ride
+    along untouched.  Per-lane semantics mirror minimize_lbfgs; per-lane
+    NUMBERS can differ from a solo run in the last bits because the fused
+    contraction reduces across a different geometry (docs/tuning_engine.md
+    documents the equality contract this leaves)."""
+    L, P = x0.shape
+    dtype = x0.dtype
+    l1w = l1_weight.astype(dtype)
+
+    def full_objective(x):
+        f, g = value_and_grad(x)
+        if use_owlqn:
+            f = f + (l1w * jnp.abs(x)).sum(axis=-1)
+        return f, g
+
+    f0, g0 = full_objective(x0)
+    state = (
+        x0,
+        f0,
+        g0,
+        jnp.zeros((L, history, P), dtype),  # S
+        jnp.zeros((L, history, P), dtype),  # Y
+        jnp.zeros((L, history), dtype),     # rho
+        jnp.zeros((L,), jnp.int32),         # memory count
+        jnp.zeros((L,), jnp.int32),         # per-lane iteration
+        jnp.zeros((L,), bool),              # converged
+    )
+    two_loop_lanes = jax.vmap(_two_loop, in_axes=(0, 0, 0, 0, 0, None))
+
+    def cond(state):
+        _, _, _, _, _, _, _, it, converged = state
+        return jnp.any((it < max_iter) & (~converged))
+
+    def body(state):
+        x, f, g, S, Y, rho, count, it, converged = state
+        active = (it < max_iter) & (~converged)
+        pg = _pseudo_gradient(x, g, l1w) if use_owlqn else g
+        d = -two_loop_lanes(pg, S, Y, rho, count, history)
+        if use_owlqn:
+            d = jnp.where(d * -pg > 0, d, 0.0)
+        xi = jnp.sign(x)
+        xi = jnp.where(x == 0, jnp.sign(-pg), xi) if use_owlqn else xi
+        deriv = (pg * d).sum(axis=-1)
+        bad_dir = deriv >= 0
+        d = jnp.where(bad_dir[:, None], -pg, d)
+        deriv = jnp.where(bad_dir, -(pg * pg).sum(axis=-1), deriv)
+        t0 = jnp.where(
+            count == 0,
+            1.0 / jnp.maximum(jnp.linalg.norm(pg, axis=-1), 1.0),
+            1.0,
+        ).astype(dtype)
+
+        def ls_body(ls_state):
+            t, xn, fn, gn, n_ls, ok = ls_state
+            live = active & (~ok) & (n_ls < max_ls)
+            x_try = x + t[:, None] * d
+            if use_owlqn:
+                x_try = jnp.where(jnp.sign(x_try) == xi, x_try, 0.0)
+            f_try, g_try = full_objective(x_try)
+            ok_try = f_try <= f + 1e-4 * t * deriv
+            lv = live[:, None]
+            return (
+                jnp.where(live, t * 0.5, t),
+                jnp.where(lv, x_try, xn),
+                jnp.where(live, f_try, fn),
+                jnp.where(lv, g_try, gn),
+                jnp.where(live, n_ls + 1, n_ls),
+                jnp.where(live, ok_try, ok),
+            )
+
+        def ls_cond(ls_state):
+            _, _, _, _, n_ls, ok = ls_state
+            return jnp.any(active & (~ok) & (n_ls < max_ls))
+
+        _, x_new, f_new, g_new, _, ls_ok = jax.lax.while_loop(
+            ls_cond,
+            ls_body,
+            (t0, x, f, g, jnp.zeros((L,), jnp.int32), jnp.zeros((L,), bool)),
+        )
+        # per-lane: on line-search exhaustion keep the current iterate
+        keep = ls_ok[:, None]
+        x_new = jnp.where(keep, x_new, x)
+        f_new = jnp.where(ls_ok, f_new, f)
+        g_new = jnp.where(keep, g_new, g)
+
+        s = x_new - x
+        yv = g_new - g
+        sy = (s * yv).sum(axis=-1)
+        store = active & (sy > 1e-10)
+        slot = jnp.mod(count, history)
+        hit = (
+            jnp.arange(history)[None, :] == slot[:, None]
+        ) & store[:, None]  # (L, history) one-hot of each lane's slot
+        S = jnp.where(hit[:, :, None], s[:, None, :], S)
+        Y = jnp.where(hit[:, :, None], yv[:, None, :], Y)
+        rho = jnp.where(
+            hit, (1.0 / jnp.where(sy != 0, sy, 1.0))[:, None], rho
+        )
+        count = count + store.astype(jnp.int32)
+
+        pg_new = _pseudo_gradient(x_new, g_new, l1w) if use_owlqn else g_new
+        converged_new = (
+            (jnp.abs(f - f_new) <= tol * jnp.maximum(jnp.abs(f_new), 1.0))
+            | (jnp.max(jnp.abs(pg_new), axis=-1) <= tol)
+            | (~ls_ok)
+        )
+        # frozen lanes take no-op updates across the board
+        act = active[:, None]
+        return (
+            jnp.where(act, x_new, x),
+            jnp.where(active, f_new, f),
+            jnp.where(act, g_new, g),
+            S,
+            Y,
+            rho,
+            count,
+            it + active.astype(jnp.int32),
+            jnp.where(active, converged_new, converged),
+        )
+
+    x, f, g, S, Y, rho, count, it, converged = jax.lax.while_loop(
+        cond, body, state
+    )
+    return LbfgsResult(x=x, f=f, n_iter=it, converged=converged)
+
+
 @partial(jax.jit, static_argnames=("value_and_grad", "max_iter", "history", "use_owlqn", "max_ls"))
 def minimize_lbfgs(
     value_and_grad: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],

@@ -23,7 +23,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from .lbfgs import minimize_lbfgs
+from .lbfgs import minimize_lbfgs, minimize_lbfgs_batched
 from .linalg import exact_matmul
 
 
@@ -113,6 +113,116 @@ def logistic_fit_kernel(
     )
     W, b = _unpack(result.x, k, d, fit_intercept)
     return W, b, result.n_iter, result.converged
+
+
+# -- batched hyperparameter sweep (srml-sweep; docs/tuning_engine.md) --------
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "k_folds", "kcls", "fit_intercept", "max_iter", "use_owlqn", "mesh"
+    ),
+)
+def sweep_logistic_fit_kernel(
+    X: jax.Array,
+    y_enc: jax.Array,
+    w: jax.Array,
+    fold_id: jax.Array,
+    regs: jax.Array,
+    l1_ratios: jax.Array,
+    tol: jax.Array,
+    k_folds: int = 2,
+    kcls: int = 1,
+    fit_intercept: bool = True,
+    max_iter: int = 100,
+    use_owlqn: bool = False,
+    mesh=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fit a whole regularization sweep — m candidates x k folds — as ONE
+    jitted L-BFGS/OWL-QN run over the one staged dataset.
+
+    Folds are weight masks from the per-row fold id (fold f trains on
+    ``w * (fold_id != f)``; padded rows carry -1 and zero weight), so no
+    fold is ever re-staged; candidates ride a lane axis whose (m,)
+    reg/l1_ratio vectors are TRACED values — a different grid at the same
+    shapes reuses the compiled executable.  Each optimizer iteration
+    evaluates every lane's smooth objective through one fused contraction
+    (the (N, D) x (D, k*m*kcls) product XLA builds from the lane einsum);
+    per-lane convergence masks in minimize_lbfgs_batched freeze finished
+    lanes.  Returns (W (k, m, kcls, D), b (k, m, kcls), n_iter (k, m),
+    converged (k, m)).  `mesh` only keys the AOT executable cache — the
+    row-sharded reductions compile to psums via GSPMD exactly like the
+    single-fit kernel's."""
+    n, d = X.shape
+    mb = regs.shape[0]
+    lanes = k_folds * mb
+    n_params = kcls * d + (kcls if fit_intercept else 0)
+    dtype = X.dtype
+    fold_axis = jnp.arange(k_folds, dtype=fold_id.dtype)
+    w_folds = w[None, :] * (fold_id[None, :] != fold_axis[:, None]).astype(
+        dtype
+    )  # (k, N) train-mask weights
+    wsum_f = w_folds.sum(axis=1)
+    l2 = (regs * (1.0 - l1_ratios)).astype(dtype)
+    l1 = (regs * l1_ratios).astype(dtype)
+    reg_mask = jnp.concatenate(
+        [jnp.ones(kcls * d, dtype), jnp.zeros(n_params - kcls * d, dtype)]
+    )
+    y01 = y_enc.astype(dtype)
+    yidx = y_enc.astype(jnp.int32)
+
+    def value_and_grad(theta):  # (lanes, P) -> ((lanes,), (lanes, P))
+        def smooth(t):
+            tf = t.reshape(k_folds, mb, n_params)
+            W = tf[..., : kcls * d].reshape(k_folds, mb, kcls, d)
+            z = jnp.einsum("nd,fmkd->fmnk", X, W)
+            if fit_intercept:
+                z = z + tf[..., kcls * d :][:, :, None, :]
+            if kcls == 1:
+                zz = z[..., 0]  # (k, m, N)
+                ll = jnp.logaddexp(0.0, zz) - y01[None, None, :] * zz
+            else:
+                logp = z - jax.scipy.special.logsumexp(
+                    z, axis=-1, keepdims=True
+                )
+                idx = jnp.broadcast_to(
+                    yidx[None, None, :, None], (k_folds, mb, n, 1)
+                )
+                ll = -jnp.take_along_axis(logp, idx, axis=-1)[..., 0]
+            data = (ll * w_folds[:, None, :]).sum(axis=-1) / wsum_f[:, None]
+            reg_term = 0.5 * l2[None, :] * ((tf * reg_mask) ** 2).sum(axis=-1)
+            per_lane = (data + reg_term).reshape(lanes)
+            # lanes are independent in theta, so the grad of the SUM is the
+            # stack of per-lane grads — one backward pass for the sweep
+            return per_lane.sum(), per_lane
+        (_, per_lane), g = jax.value_and_grad(smooth, has_aux=True)(theta)
+        return per_lane, g
+
+    l1w = jnp.broadcast_to(
+        l1[None, :, None] * reg_mask[None, None, :],
+        (k_folds, mb, n_params),
+    ).reshape(lanes, n_params)
+    result = minimize_lbfgs_batched(
+        value_and_grad,
+        jnp.zeros((lanes, n_params), dtype),
+        l1_weight=l1w,
+        max_iter=max_iter,
+        tol=tol,
+        history=10,
+        use_owlqn=use_owlqn,
+    )
+    W = result.x[:, : kcls * d].reshape(k_folds, mb, kcls, d)
+    if fit_intercept:
+        b = result.x[:, kcls * d :].reshape(k_folds, mb, kcls)
+    else:
+        b = jnp.zeros((k_folds, mb, kcls), dtype)
+    return (
+        W,
+        b,
+        result.n_iter.reshape(k_folds, mb),
+        result.converged.reshape(k_folds, mb),
+    )
 
 
 @jax.jit

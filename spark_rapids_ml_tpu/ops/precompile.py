@@ -413,10 +413,15 @@ def executable_cache_stats() -> dict:
 def kernel_cache_key(name: str, args, mesh, statics: dict):
     """The ONE key derivation shared by dispatch-time cached_kernel and the
     AOT warm paths (e.g. knn.warm_search_kernels) — a warmed executable must
-    be the exact entry the later dispatch looks up."""
+    be the exact entry the later dispatch looks up.  Args may be pytrees
+    (the sweep kernels pass stacked stats NamedTuples); leaves key on
+    shape/dtype, so the derivation is unchanged for plain array args."""
     return (
         name,
-        tuple((tuple(a.shape), str(a.dtype)) for a in args),
+        tuple(
+            (tuple(a.shape), str(a.dtype))
+            for a in jax.tree_util.tree_leaves(args)
+        ),
         mesh_fingerprint(mesh),
         tuple(sorted(statics.items())),
     )

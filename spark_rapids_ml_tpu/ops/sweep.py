@@ -1,0 +1,110 @@
+#
+# srml-sweep: batched hyperparameter-sweep orchestration.
+#
+# CrossValidator's hot path is m candidates x k folds of the same estimator
+# over the same data.  The reference fits them sequentially because cuML
+# solvers are opaque C++ calls (tuning.py:96-121); our solvers are pure jax,
+# so the whole sweep compiles into a handful of dispatches over ONE
+# device-resident dataset: folds become weight masks derived from a per-row
+# fold id (dataframe.random_split_ids — the same seeded assignment
+# randomSplit materializes), candidates become a padded lane axis whose
+# values are traced (a new grid at the same shapes is zero new compiles).
+#
+# This module owns the estimator-agnostic pieces: fold-id staging, the
+# pow2 candidate bucket that keys the AOT executable cache, lane padding,
+# and the warm hook that queues the sweep kernels on the precompile pool at
+# sweep entry.  The estimator-specific kernels live next to their solvers
+# (ops/glm.py, ops/logistic.py); the CrossValidator routing lives in
+# tuning.py.
+#
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from .. import profiling
+from ..parallel.mesh import data_sharding
+from .precompile import global_precompiler, kernel_cache_key
+
+
+def candidate_bucket(m: int) -> int:
+    """Power-of-two candidate-lane bucket (floor 1).  The bucket — not the
+    raw candidate count — rides the executable-cache key, so grids of 5, 6
+    and 8 candidates at one data shape share one compiled sweep kernel.
+    Gemm columns are independent per lane, so the padded lanes change no
+    real lane's result; they are sliced off after the fetch."""
+    b = 1
+    while b < m:
+        b *= 2
+    return b
+
+
+def pad_lanes(values: Sequence[float], bucket: int) -> np.ndarray:
+    """(m,) candidate values -> (bucket,) float64 lane vector, padding with
+    the first value (a duplicate lane converges like its original; its
+    output is discarded).  float64 here so an x64-scope (float64) fit sees
+    full-precision values; outside x64 jax canonicalizes to the same f32
+    values the sequential path's weakly-typed python floats trace to."""
+    out = np.full(bucket, values[0], dtype=np.float64)  # graftlint: disable=R5 (host-side lane vector; jnp.asarray canonicalizes to the compute dtype)
+    out[: len(values)] = np.asarray(values, dtype=np.float64)  # graftlint: disable=R5 (host-side lane vector)
+    return out
+
+
+def stage_fold_ids(
+    n_rows: int, n_pad: int, n_folds: int, seed: int, mesh
+) -> jax.Array:
+    """Row-sharded int32 fold ids for the staged dataset: row r belongs to
+    fold ``random_split_ids(n_rows, n_folds, seed)[r]`` — the ONE split
+    definition shared with DataFrame.randomSplit, so the masked folds and
+    the materialized scoring folds can never disagree.  Padded rows carry
+    -1 (no fold; their weight is already zero)."""
+    from ..dataframe import random_split_ids
+
+    fid = np.full(n_pad, -1, dtype=np.int32)
+    fid[:n_rows] = random_split_ids(n_rows, n_folds, seed)
+    return jax.device_put(fid, data_sharding(mesh))
+
+
+def dispatch(name: str, fn, *args, mesh=None, **statics):
+    """Run one sweep kernel through the process-wide AOT executable cache
+    (ops/precompile.cached_kernel semantics): keyed on (kernel name, arg
+    shape/dtypes — which already encode the candidate bucket and fold
+    count — mesh fingerprint, statics).  A repeat same-shape sweep moves
+    only precompile.aot_hit."""
+    from .precompile import cached_kernel
+
+    return cached_kernel(name, fn, *args, mesh=mesh, **statics)
+
+
+def warm(entries: List[Tuple[str, object, tuple, dict]], mesh=None) -> None:
+    """Queue sweep kernels on the precompile pool at sweep entry, so their
+    compiles overlap whatever runs before their dispatch (the solve kernels
+    compile WHILE the stats pass executes) instead of serializing behind
+    it.  Args may be concrete arrays or ShapeDtypeStructs carrying explicit
+    shardings — either way the derived key and captured shardings are
+    exactly what the later `dispatch` call looks up, which the repeat-sweep
+    zero-new-compiles gate (fallback counter frozen) holds honest.
+    entries: (name, fn, args, statics)."""
+    pc = global_precompiler()
+    for name, fn, args, statics in entries:
+        key = kernel_cache_key(name, args, mesh, statics)
+        call_statics = dict(statics)
+        if mesh is not None:
+            call_statics["mesh"] = mesh
+        pc.submit(key, fn, *args, **call_statics)
+        profiling.incr_counter("tuning.sweep.warm_submit")
+
+
+def replicated_aval(shape: Tuple[int, ...], dtype, mesh) -> jax.ShapeDtypeStruct:
+    """Aval for a mesh-replicated kernel argument (what shard_map P() outputs
+    and device_put(replicated_sharding) inputs are) — warm() entries built
+    from these compile the exact executable the concrete dispatch needs."""
+    from ..parallel.mesh import replicated_sharding
+
+    return jax.ShapeDtypeStruct(
+        shape, np.dtype(dtype), sharding=replicated_sharding(mesh)
+    )

@@ -10,6 +10,17 @@
 # classic per-model loop (the pyspark CrossValidator fallback,
 # tuning.py:96-99).  Folds run on a thread pool bounded by `parallelism`.
 #
+# Beyond the reference: estimators whose solvers batch over a candidate
+# lane axis (the GLMs — _supportsBatchedSweep) route the WHOLE sweep
+# through the srml-sweep engine instead of the fold loop: folds become
+# weight masks over one staged dataset (zero per-fold re-staging) and all
+# m x k fits run as a handful of compiled dispatches through the AOT
+# executable cache; scoring then rides the same fold frames and mergeable
+# metric buffers the sequential path uses, so the two routes are gated
+# equal (docs/tuning_engine.md).  SRML_SWEEP_BATCH=0 forces the legacy
+# loop; live pyspark datasets keep it too (their folds live on the
+# cluster).
+#
 
 from __future__ import annotations
 
@@ -30,6 +41,24 @@ from .core import _TpuEstimator, _TpuModel, load as _load_any
 from .dataframe import DataFrame, as_dataframe
 from .params import Param, Params, TypeConverters, _dummy
 from .utils import get_logger
+
+
+def _materialize_sweep_models(
+    est: _TpuEstimator,
+    fold_results: List[List[Dict[str, Any]]],
+    paramMaps: List[Dict[Param, Any]],
+) -> List[List[_TpuModel]]:
+    """Per-(fold, candidate) model-attribute dicts -> models, through the
+    SAME core._materialize_model bookkeeping _fit_internal applies on the
+    sequential path — so a batched sub-model is indistinguishable from its
+    sequential twin by construction."""
+    return [
+        [
+            est._materialize_model(dict(attrs), paramMaps[i])
+            for i, attrs in enumerate(results)
+        ]
+        for results in fold_results
+    ]
 
 
 class ParamGridBuilder:
@@ -194,7 +223,7 @@ class CrossValidator(_ValidatorParams):
                     train.unpersist()
                     valid.unpersist()
         df = as_dataframe(dataset)
-        return self._fit(df, self._kFold(df))
+        return self._fit(df)
 
     def _fit(
         self,
@@ -212,6 +241,13 @@ class CrossValidator(_ValidatorParams):
         n_folds = self.getNumFolds()
         collect_sub = self.getCollectSubModels()
         single_pass = isinstance(est, _TpuEstimator) and est._supportsTransformEvaluate(eva)
+        if (
+            datasets is None  # facade path: folds are ours to formulate
+            and single_pass
+            and os.environ.get("SRML_SWEEP_BATCH", "1") != "0"
+            and est._supportsBatchedSweep(dataset, epm, eva)
+        ):
+            return self._fit_batched(dataset, est, eva, epm)
         metrics_all: List[List[float]] = [[0.0] * num_models for _ in range(n_folds)]
         sub_models: Optional[List[List[_TpuModel]]] = (
             [[None] * num_models for _ in range(n_folds)] if collect_sub else None  # type: ignore[list-item]
@@ -261,7 +297,68 @@ class CrossValidator(_ValidatorParams):
         finally:
             pool.close()
             pool.join()
+        return self._finish(dataset, est, eva, epm, metrics_all, sub_models)
 
+    def _fit_batched(
+        self, df: DataFrame, est: _TpuEstimator, eva: Any, epm: List[Dict[Param, Any]]
+    ) -> "CrossValidatorModel":
+        """srml-sweep route: one staged dataset, masked folds, lane-batched
+        candidate solves — no per-fold thread pool, so the CPU-backend fold
+        lock never serializes this path.  Scoring reuses the sequential
+        path's fold frames and mergeable metric machinery per (fold,
+        candidate), which is what the equality gates lean on."""
+        from . import profiling, watch
+
+        n_folds = self.getNumFolds()
+        num_models = len(epm)
+        seed = self.getOrDefault("seed")
+        counters0 = profiling.counters()
+        profiling.reset_phase_times()
+        tag = f"sweep-{type(est).__name__}"
+        with watch.flight_scope(tag), profiling.trace_session(tag):
+            with profiling.span(
+                "tuning.sweep",
+                estimator=type(est).__name__,
+                candidates=num_models,
+                folds=n_folds,
+            ):
+                profiling.incr_counter("tuning.candidates", num_models)
+                profiling.incr_counter("tuning.folds", n_folds)
+                fold_results = est._fitBatchedSweep(df, epm, n_folds, seed)
+                fold_models = _materialize_sweep_models(est, fold_results, epm)
+                with profiling.span("tuning.sweep.score"):
+                    metrics_all = []
+                    for fold, (_train, valid) in enumerate(self._kFold(df)):
+                        combined = fold_models[fold][0]._combine(
+                            fold_models[fold]
+                        )
+                        metrics_all.append(
+                            combined._transformEvaluate(valid, eva)
+                        )
+        self._last_fit_phase_times = profiling.phase_times()
+        snap = profiling.TelemetrySnapshot.capture(counters0, rank=0)
+        for models in fold_models:
+            for m in models:
+                m._fit_telemetry = snap
+        self.logger.info(
+            "batched sweep: %d candidates x %d folds over one staged dataset",
+            num_models,
+            n_folds,
+        )
+        sub_models = fold_models if self.getCollectSubModels() else None
+        return self._finish(df, est, eva, epm, metrics_all, sub_models)
+
+    def _finish(
+        self,
+        dataset: Any,
+        est: _TpuEstimator,
+        eva: Any,
+        epm: List[Dict[Param, Any]],
+        metrics_all: List[List[float]],
+        sub_models: Optional[List[List[_TpuModel]]],
+    ) -> "CrossValidatorModel":
+        """Shared tail of both CV routes: average/std the per-fold metrics,
+        pick the winner, refit it on the full dataset."""
         avg = np.mean(np.asarray(metrics_all), axis=0)
         std = np.std(np.asarray(metrics_all), axis=0)
         best_index = int(np.argmax(avg) if eva.isLargerBetter() else np.argmin(avg))
@@ -282,7 +379,17 @@ class CrossValidator(_ValidatorParams):
         return cv_model
 
     def copy(self, extra: Optional[Dict] = None) -> "CrossValidator":
+        """Copy with pyspark CrossValidator.copy semantics: the estimator
+        and evaluator are themselves copied (so tuning a copy never mutates
+        the original's components) and the param-map list is duplicated —
+        the bookkeeping the previous pass-through override silently skipped
+        (it aliased all three onto the copy)."""
         that = super().copy(extra)
+        if self._estimator is not None:
+            that._estimator = self._estimator.copy()
+        if self._evaluator is not None and hasattr(self._evaluator, "copy"):
+            that._evaluator = self._evaluator.copy()
+        that._estimatorParamMaps = [dict(pm) for pm in self._estimatorParamMaps]
         return that
 
 

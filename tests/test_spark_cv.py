@@ -188,6 +188,11 @@ def fake_pyspark(monkeypatch):
     mod.BarrierTaskContext = _FakeBarrierTaskContext
     monkeypatch.setitem(sys.modules, "pyspark", mod)
     monkeypatch.delenv("SRML_SPARK_COLLECT", raising=False)
+    # the cluster-parity tests compare the executor-side CV against the
+    # driver-local SEQUENTIAL CV (identical folds, identical solver path);
+    # the batched sweep route has its own equality gates in test_tuning.py
+    # and a dedicated cluster-vs-batched test below
+    monkeypatch.setenv("SRML_SWEEP_BATCH", "0")
 
     from spark_rapids_ml_tpu.spark import adapter
 
@@ -307,6 +312,45 @@ def test_cv_random_forest_single_pass_cluster_side():
     want = _cv().fit(facade)
     np.testing.assert_allclose(got.avgMetrics, want.avgMetrics, rtol=1e-6)
     assert got.bestModel.getNumTrees == want.bestModel.getNumTrees
+
+
+def test_cv_linreg_cluster_equals_local_batched_sweep(monkeypatch):
+    """The cluster-side sequential CV and the driver-local BATCHED sweep
+    (srml-sweep) must agree EXACTLY on integer-valued data: folds come from
+    the one shared seeded-split definition, the masked-fold statistics sum
+    the same exact integers the restaged folds do, and the lane solves are
+    bit-identical to the sequential solves (docs/tuning_engine.md)."""
+    monkeypatch.setenv("SRML_SWEEP_BATCH", "1")
+    rng = np.random.default_rng(3)
+    X = rng.integers(-3, 4, size=(360, 5)).astype(np.float32)
+    c = rng.integers(-2, 3, size=5).astype(np.float32)
+    y = (X @ c + rng.integers(-2, 3, size=360)).astype(np.float32)
+    pdf = pd.DataFrame({"features": list(X), "label": y.astype(np.float64)})
+    sdf = _FakeSparkDataFrame(_split_pandas(pdf, 3))
+    facade = DataFrame.from_pandas(pdf, 3)
+
+    def _cv():
+        est = LinearRegression(standardization=False)
+        grid = (
+            ParamGridBuilder()
+            .addGrid(est.getParam("regParam"), [0.0, 0.1, 1.0])
+            .build()
+        )
+        return CrossValidator(
+            estimator=est,
+            estimatorParamMaps=grid,
+            evaluator=RegressionEvaluator(),
+            numFolds=3,
+            seed=17,
+        )
+
+    got = _cv().fit(sdf)      # executor path: sequential per-fold loop
+    want = _cv().fit(facade)  # local path: batched sweep engine
+    assert got.avgMetrics == want.avgMetrics
+    assert got.stdMetrics == want.stdMetrics
+    np.testing.assert_array_equal(
+        np.asarray(got.bestModel.coef_), np.asarray(want.bestModel.coef_)
+    )
 
 
 def test_cv_kmeans_cluster_side_with_clustering_evaluator():
