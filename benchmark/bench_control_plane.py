@@ -1,0 +1,199 @@
+#
+# Control-plane microbenchmark (srml-wire): gather-round latency and
+# abort-propagation latency, file plane vs TCP plane (docs/robustness.md
+# §wire plane, docs/benchmarking.md §control-plane-bench).
+#
+# Two metrics, each reported per plane through the standard artifact path
+# (benchmark.utils.append_report JSONL, the same records bench.py and
+# standings.py consume):
+#
+#   cp_gather_round       p50/p95/p99 wall per collective round (nranks
+#                         threads gathering a small binary payload — the
+#                         shape of PartitionDescriptor/telemetry rounds).
+#                         The file plane pays filesystem polls per round;
+#                         the wire plane pays RTTs.
+#   cp_abort_propagation  blocked-gather -> RemoteRankError latency when a
+#                         sibling rank publishes an abort marker.  THE
+#                         srml-wire headline: the file plane's floor is its
+#                         poll interval (~20-50 ms scan cadence); the
+#                         coordinator PUSH lands in ~one RTT (~1-3 ms on
+#                         localhost) — ci/test.sh step 3m asserts the push
+#                         beats one 50 ms poll interval outright.
+#
+# Threads stand in for ranks (the protocol cost is identical; process
+# spawn would only add noise to a microbenchmark), exactly like the
+# conformance suite.  No jax, no devices — this measures the control
+# plane, not the data plane.
+#
+# Usage (the step-3m smoke shape):
+#   python -m benchmark.bench_control_plane --planes file,tcp \
+#       --gather_rounds 100 --report_path /tmp/cp.jsonl
+#
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from spark_rapids_ml_tpu import profiling
+from spark_rapids_ml_tpu.parallel.context import RemoteRankError
+from spark_rapids_ml_tpu.parallel.netplane import (
+    CoordinatorServer,
+    TcpControlPlane,
+)
+from spark_rapids_ml_tpu.parallel.runner import FileControlPlane
+
+from .utils import append_report
+
+
+class _PlaneSet:
+    """nranks plane instances over one rendezvous (threads-as-ranks)."""
+
+    def __init__(self, kind: str, nranks: int, root: str, tag: str):
+        self.kind = kind
+        self.nranks = nranks
+        self._server = None
+        if kind == "file":
+            self.planes = [
+                FileControlPlane(f"{root}/cp-{tag}", r, nranks, timeout=60)
+                for r in range(nranks)
+            ]
+        elif kind == "tcp":
+            self._server = CoordinatorServer(
+                nranks, host="127.0.0.1", advertise_host="127.0.0.1"
+            )
+            addr = self._server.start()
+            self.planes = [
+                TcpControlPlane(addr, r, nranks, timeout=60)
+                for r in range(nranks)
+            ]
+        else:
+            raise ValueError(f"unknown plane kind {kind!r}")
+
+    def close(self) -> None:
+        for p in self.planes:
+            with contextlib.suppress(Exception):
+                p.close()
+        if self._server is not None:
+            self._server.stop(grace_s=0.5)
+
+
+def _run_ranks(fn, nranks: int) -> None:
+    threads = [
+        threading.Thread(target=fn, args=(r,), name=f"bench-cp-r{r}")
+        for r in range(nranks)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def bench_gather(kind: str, args, root: str) -> Dict[str, float]:
+    ps = _PlaneSet(kind, args.nranks, root, "gather")
+    payload = b"\x5a" * args.payload_bytes
+    lat_ms: List[float] = []
+    try:
+        def run(rank):
+            cp = ps.planes[rank]
+            for i in range(args.gather_rounds):
+                t0 = time.perf_counter()
+                got = cp.allGatherBytes(payload)
+                assert len(got) == args.nranks
+                if rank == 0:
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+        _run_ranks(run, args.nranks)
+    finally:
+        ps.close()
+    arr = np.asarray(lat_ms)
+    return {
+        "rounds": int(arr.size),
+        "p50_ms": float(np.percentile(arr, 50)),
+        "p95_ms": float(np.percentile(arr, 95)),
+        "p99_ms": float(np.percentile(arr, 99)),
+        "mean_ms": float(arr.mean()),
+    }
+
+
+def bench_abort(kind: str, args, root: str) -> Dict[str, float]:
+    dts_ms: List[float] = []
+    for trial in range(args.abort_trials):
+        ps = _PlaneSet(kind, args.nranks, root, f"abort{trial}")
+        t_abort = [0.0]
+        try:
+            def run(rank):
+                cp = ps.planes[rank]
+                if rank == 1:
+                    time.sleep(0.3)  # the survivors are blocked by now
+                    t_abort[0] = time.perf_counter()
+                    cp.abort(json.dumps({
+                        "rank": 1, "etype": "ValueError",
+                        "message": "bench", "span": "bench.abort",
+                    }))
+                    return
+                try:
+                    cp.allGather("blocked")
+                except RemoteRankError:
+                    dts_ms.append((time.perf_counter() - t_abort[0]) * 1e3)
+
+            _run_ranks(run, args.nranks)
+        finally:
+            ps.close()
+    arr = np.asarray(dts_ms)
+    return {
+        "trials": int(args.abort_trials),
+        "survivors": int(arr.size),
+        "mean_ms": float(arr.mean()),
+        "max_ms": float(arr.max()),
+    }
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="control-plane latency bench")
+    parser.add_argument("--planes", default="file,tcp")
+    parser.add_argument("--nranks", type=int, default=3)
+    parser.add_argument("--gather_rounds", type=int, default=200)
+    parser.add_argument("--payload_bytes", type=int, default=256)
+    parser.add_argument("--abort_trials", type=int, default=5)
+    parser.add_argument("--report_path", default="")
+    parser.add_argument(
+        "--root", default="", help="scratch dir (default: a fresh tempdir)"
+    )
+    args = parser.parse_args(argv)
+    import tempfile
+
+    root = args.root or tempfile.mkdtemp(prefix="srml_cp_bench_")
+    for kind in [p.strip() for p in args.planes.split(",") if p.strip()]:
+        c0 = profiling.counters("cp.net.")
+        gather = bench_gather(kind, args, root)
+        abort = bench_abort(kind, args, root)
+        wire = {
+            k: v - c0.get(k, 0)
+            for k, v in profiling.counters("cp.net.").items()
+        } if kind == "tcp" else {}
+        print(
+            f"[{kind}] gather p50={gather['p50_ms']:.2f} ms "
+            f"p99={gather['p99_ms']:.2f} ms | abort mean="
+            f"{abort['mean_ms']:.2f} ms max={abort['max_ms']:.2f} ms"
+        )
+        append_report(args.report_path, {
+            "metric": "cp_gather_round", "plane": kind,
+            "nranks": args.nranks, "payload_bytes": args.payload_bytes,
+            **gather,
+        })
+        append_report(args.report_path, {
+            "metric": "cp_abort_propagation", "plane": kind,
+            "nranks": args.nranks, **abort,
+            **({"wire_counters": wire} if wire else {}),
+        })
+
+
+if __name__ == "__main__":
+    main()

@@ -6,10 +6,11 @@ set -ex
 cd "$(dirname "$0")/.."
 
 # 1. lint / static checks: byte-compile everything (mypy/black optional in
-#    this image), then graftlint — the JAX/TPU invariant checker (R1-R7:
+#    this image), then graftlint — the JAX/TPU invariant checker (R1-R10:
 #    hidden host syncs, recompile risk, unbound collective axis names,
 #    nondeterministic RNG/set-order, float64 in solver kernels, raw clocks
-#    outside srml-scope, unnamed threads; see docs/graftlint.md).  Fails on ANY finding and
+#    outside srml-scope, unnamed threads, remote-DMA confinement, unbounded
+#    waits, raw-socket confinement; see docs/graftlint.md).  Fails on ANY finding and
 #    prints the per-rule count; use --baseline to land a new rule warn-only
 #    first.
 python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
@@ -39,6 +40,11 @@ if [ "${SRML_CI_FULL:-0}" = "1" ]; then
     python -m pytest tests/test_multicontroller.py -q --runslow \
         -k "three_plus or multirank"
     python -m pytest tests/test_knn_audit.py -q --runslow
+    # srml-wire slow gates by name: the FULL fit matrix rerun on the TCP
+    # plane must be BITWISE-equal to the file plane, and the 2-process
+    # kneighbors exchange must pass over sockets
+    python -m pytest tests/test_multicontroller.py -q --runslow \
+        -k "bitwise_equal_across_planes or (kneighbors_across and tcp)"
 fi
 
 # 3b. focused gates for the kNN query-engine contracts (cheap; both files
@@ -453,6 +459,47 @@ for r in recs:
     assert r["counters"].get("tuning.candidates", 0) >= r["grid_size"], r
 EOF
 rm -rf "$TUNE_SMOKE"
+
+# 3m. srml-wire gates (also inside the full suite; re-asserted by name so
+#     marker drift can never silently drop them — docs/robustness.md §wire):
+#     - control-plane CONFORMANCE: one contract module over the file, TCP,
+#       and local planes (rank-indexed gathers, binary round-trip, abort
+#       marker shape, typed ControlPlaneTimeout naming round + missing
+#       ranks, health surface, close idempotence)
+#     - the multi-host-style CHAOS MATRIX on real OS processes over real
+#       sockets: SIGKILL'd rank, partitioned rank (injected cp.net sticky
+#       drop), and killed coordinator each surface as a TYPED error naming
+#       the culprit within 2 heartbeat intervals (wall-clock asserted),
+#       with zero orphaned sockets/threads/files; a stale-epoch zombie
+#       rejoin is fenced (StaleEpochError), never readmitted
+#     plus graftlint (incl. the new R10 raw-socket confinement) over the
+#     touched modules by name, and a bench_control_plane smoke asserting
+#     the pushed abort beats one 50 ms file-plane poll interval.
+#     (SRML_CI_FULL additionally reruns the full multicontroller fit +
+#     kneighbors matrix on SRML_CP=tcp with the bitwise cross-plane gate —
+#     see the slow-suite block in step 3.)
+python -m pytest tests/test_control_plane_contract.py tests/test_netplane.py -q
+python -m tools.graftlint spark_rapids_ml_tpu/parallel \
+    spark_rapids_ml_tpu/watch.py tools/graftlint/rules.py \
+    benchmark/bench_control_plane.py
+WIRE_SMOKE=$(mktemp -d)
+python -m benchmark.bench_control_plane --planes file,tcp \
+    --gather_rounds 60 --abort_trials 3 \
+    --report_path "$WIRE_SMOKE/cp.jsonl"
+python - "$WIRE_SMOKE/cp.jsonl" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1])]
+abort = {r["plane"]: r for r in recs if r["metric"] == "cp_abort_propagation"}
+gather = {r["plane"]: r for r in recs if r["metric"] == "cp_gather_round"}
+assert set(abort) == {"file", "tcp"} and set(gather) == {"file", "tcp"}, recs
+# THE srml-wire bar: a coordinator-pushed abort must land inside one
+# file-plane poll interval (50 ms) — measured ~1-3 ms on localhost
+assert abort["tcp"]["max_ms"] < 50.0, abort["tcp"]
+assert abort["tcp"]["survivors"] == 2 * abort["tcp"]["trials"], abort["tcp"]
+assert gather["tcp"]["p50_ms"] > 0 and gather["file"]["p50_ms"] > 0
+assert abort["tcp"]["wire_counters"].get("cp.net.pushed_aborts", 0) > 0
+EOF
+rm -rf "$WIRE_SMOKE"
 
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)

@@ -1,6 +1,6 @@
 from .mesh import default_num_workers, get_mesh, shard_rows
 from .partition import PartitionDescriptor
-from .context import RemoteRankError, TpuContext
+from .context import ControlPlaneTimeout, RemoteRankError, TpuContext
 from . import faults
 
 __all__ = [
@@ -8,6 +8,7 @@ __all__ = [
     "get_mesh",
     "shard_rows",
     "PartitionDescriptor",
+    "ControlPlaneTimeout",
     "RemoteRankError",
     "TpuContext",
     "faults",

@@ -22,13 +22,103 @@
 from __future__ import annotations
 
 import json
-import socket
-from typing import Any, List, Optional, Protocol
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
 
 import jax
 
+from ..utils import env_float as _env_float
 from ..utils import get_logger
 from . import faults
+
+# -- srml-shield / srml-wire control-plane knobs (docs/robustness.md) ---------
+# Shared by EVERY plane implementation (FileControlPlane, TcpControlPlane):
+# per-ROUND bounded timeout instead of one session-wide cliff, and retrying
+# I/O with exponential backoff + deterministic per-rank jitter for transient
+# transport errors (NFS burps on the file plane, connection resets on the
+# socket plane).
+ROUND_TIMEOUT_ENV = "SRML_CP_ROUND_TIMEOUT_S"
+RETRIES_ENV = "SRML_CP_RETRIES"
+BACKOFF_ENV = "SRML_CP_BACKOFF_S"
+# jax.distributed coordination-service heartbeat cadence (seconds x count):
+# bounds how long any jax-layer teardown can dangle on a dead peer
+JAX_HEARTBEAT_ENV = "SRML_JAX_HEARTBEAT_S"
+JAX_MAX_MISSING_ENV = "SRML_JAX_MAX_MISSING_HEARTBEATS"
+_DEFAULT_ROUND_TIMEOUT_S = 300.0
+_DEFAULT_RETRIES = 3
+_DEFAULT_BACKOFF_S = 0.05
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """The SRML_CP_RETRIES / SRML_CP_BACKOFF_S contract, parsed ONCE at
+    plane construction (a per-I/O env re-parse was the old file-plane shape)
+    and shared verbatim by the file and TCP planes.  `run` retries `fn` on
+    the given transient exception types with exponential backoff and
+    deterministic per-rank jitter (explicitly seeded: graftlint R4)."""
+
+    retries: int
+    backoff_s: float
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(
+            retries=int(_env_float(RETRIES_ENV, _DEFAULT_RETRIES)),
+            backoff_s=_env_float(BACKOFF_ENV, _DEFAULT_BACKOFF_S),
+        )
+
+    def run(
+        self,
+        fn,
+        jitter: random.Random,
+        retry_on: Tuple[type, ...] = (OSError,),
+        counter: str = "cp.io_retries",
+    ):
+        from .. import profiling
+
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except retry_on:
+                if attempt >= self.retries:
+                    raise
+                delay = self.backoff_s * (2 ** attempt) * (
+                    1.0 + 0.25 * jitter.random()
+                )
+                profiling.incr_counter(counter)
+                attempt += 1
+                time.sleep(delay)
+
+
+class ControlPlaneTimeout(TimeoutError):
+    """A gather round ran out its per-round budget with ranks still missing.
+    Typed (vs the old builtin TimeoutError) so callers can distinguish "the
+    collective never completed" from arbitrary stdlib timeouts, and
+    self-describing: it carries the round number, the ranks that never
+    posted, and the knob that bounds the budget.  Still a TimeoutError
+    subclass so existing `except TimeoutError` handlers keep working."""
+
+    def __init__(
+        self,
+        plane: str,
+        round_no: int,
+        missing_ranks: Sequence[int],
+        timeout_s: float,
+        knob: str = ROUND_TIMEOUT_ENV,
+    ):
+        self.plane = plane
+        self.round_no = int(round_no)
+        self.missing_ranks = sorted(int(r) for r in missing_ranks)
+        self.timeout_s = float(timeout_s)
+        self.knob = knob
+        super().__init__(
+            f"{plane} round {self.round_no}: ranks {self.missing_ranks} "
+            f"never posted within {self.timeout_s}s ({knob} bounds each "
+            "round)"
+        )
 
 
 class RemoteRankError(RuntimeError):
@@ -107,28 +197,16 @@ class LocalControlPlane:
     def read_health(self) -> dict:
         return dict(self._health)
 
+    # srml-shield abort surface (single-controller: no peers to warn, but
+    # the conformance suite holds every plane to the same method shape)
+    def abort(self, payload: str) -> None:
+        return None
 
-def _local_ip() -> str:
-    """Routable local IP: a UDP connect() selects the egress interface without
-    sending packets, avoiding /etc/hosts entries that pin the hostname to
-    127.0.x.1 (common on Debian TPU-VMs)."""
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    try:
-        s.connect(("10.255.255.255", 1))
-        return s.getsockname()[0]
-    except OSError:
-        return socket.gethostbyname(socket.gethostname())
-    finally:
-        s.close()
+    def check_abort(self) -> Optional[Dict[str, Any]]:
+        return None
 
-
-def _free_port() -> int:
-    # NOTE: inherently racy (jax.distributed.initialize rebinds the port after
-    # we release it) — the coordinator retries are jax's own; picking from the
-    # kernel ephemeral range keeps collisions rare.
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
+    def close(self) -> None:
+        return None
 
 
 class TpuContext:
@@ -176,8 +254,19 @@ class TpuContext:
 
             ensure_cpu_collectives()
             # rank 0 advertises coordinator host:port; everyone gathers it.
+            # A port-allocating control plane (TcpControlPlane) hands out a
+            # coordinator-reserved port — no two sessions through the same
+            # coordinator can collide, killing the _free_port rebind race
+            # between sibling jobs on one host.  Planes without the surface
+            # (file / Spark barrier) keep the best-effort ephemeral pick.
+            from .netplane import _free_port, _local_ip
+
             if self._rank == 0:
-                addr = f"{_local_ip()}:{_free_port()}"
+                if hasattr(self._cp, "allocate_port"):
+                    port = self._cp.allocate_port()
+                else:
+                    port = _free_port()
+                addr = f"{_local_ip()}:{port}"
             else:
                 addr = ""
             gathered = self._cp.allGather(json.dumps({"rank": self._rank, "addr": addr}))
@@ -191,10 +280,24 @@ class TpuContext:
                 "rank %d/%d connecting to coordinator %s",
                 self._rank, self._nranks, coordinator,
             )
-            jax.distributed.initialize(
+            # Coordination-service heartbeats tightened from the 10 s x 10
+            # default: 100 s was how long a survivor's teardown dangled on
+            # a dead peer before the client's missed-heartbeat handler
+            # fired (srml-wire chaos drive).  The control plane still owns
+            # FAST detection (ms-scale markers/leases); these bound the
+            # jax-layer tail so no teardown outlives ~interval x missing.
+            from ..compat import distributed_initialize
+
+            distributed_initialize(
                 coordinator_address=coordinator,
                 num_processes=self._nranks,
                 process_id=self._rank,
+                heartbeat_interval_s=max(
+                    1, int(_env_float(JAX_HEARTBEAT_ENV, 1.0))
+                ),
+                max_missing_heartbeats=max(
+                    2, int(_env_float(JAX_MAX_MISSING_ENV, 10.0))
+                ),
             )
             self._initialized_distributed = True
         return self
@@ -229,16 +332,24 @@ class TpuContext:
                 # its failure is LOGGED, not swallowed (graftlint R9)
                 self._logger.warning("abort broadcast failed: %s", abort_exc)
         if self._initialized_distributed:
-            try:
-                jax.distributed.shutdown()
-            except Exception as exc:  # noqa: BLE001 - nccl abort-path mirror
-                if exc_type is None:
-                    raise
-                # abort path: a shutdown failure while unwinding a real
-                # error is expected (the coordinator may already be gone);
-                # log it, never mask the original exception
+            if exc_type is not None:
+                # The abort-vs-destroy contract, for real:
+                # jax.distributed.shutdown() runs a COLLECTIVE shutdown
+                # barrier.  On any abort path a peer is dead or about to
+                # be (it is unwinding this same path), so the barrier can
+                # never complete — and the 0.4.37 client LOG(FATAL)s the
+                # whole process after the ~100 s coordination heartbeat
+                # timeout, killing the typed RemoteRankError before it
+                # reaches the user (found by the srml-wire chaos drive).
+                # Abort therefore means detach WITHOUT the barrier: skip
+                # the call, let process teardown reclaim the sockets —
+                # exactly NCCL abort() vs destroy().
                 self._logger.warning(
-                    "jax.distributed.shutdown failed during abort "
-                    "teardown: %s", exc,
+                    "abort path (%s unwinding): skipping the collective "
+                    "jax.distributed.shutdown barrier — it cannot "
+                    "complete once a peer is gone",
+                    exc_type.__name__,
                 )
+            else:
+                jax.distributed.shutdown()
         return None

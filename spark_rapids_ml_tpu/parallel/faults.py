@@ -12,8 +12,13 @@
 # Named INJECTION SITES are threaded through the layers that can hang or
 # die in production:
 #
-#   cp.gather         FileControlPlane._gather_round (every collective round)
-#   cp.barrier        FileControlPlane.barrier (before the empty gather)
+#   cp.gather         FileControlPlane._gather_round / TcpControlPlane.
+#                     _gather_round (every collective round, either plane)
+#   cp.barrier        ControlPlane.barrier (before the empty gather)
+#   cp.net.send       TcpControlPlane._send_frame — every outbound wire
+#                     frame of the socket control plane (srml-wire)
+#   cp.net.recv       TcpControlPlane receiver thread — every inbound wire
+#                     frame, after the socket read
 #   exchange.ring_pass  exchange.ring_pass_bytes (the kNN ring hop wire)
 #   knn.ring_hop      ops/knn._distributed_ring (per ring rotation)
 #   runner.fit        the fit task body — BOTH the barrier runner
@@ -29,12 +34,15 @@
 #   SRML_FAULTS = spec[;spec...]
 #   spec        = site[:field]...
 #   field       = rank=<int> | call=<int> | tag=<str>
-#               | action=(die|raise|kill|delay|corrupt) | delay=<float s>
+#               | action=(die|raise|kill|delay|corrupt|drop|partition)
+#               | delay=<float s>
 #
 #   cp.gather:rank=1:call=2:action=die      rank 1 dies on its 2nd gather
 #   serving.dispatch:tag=km:call=3:action=kill   km's worker dies, batch 3
 #   exchange.ring_pass:rank=0:action=corrupt     rank 0's frames flip bytes
 #   cp.barrier:rank=2:delay=5                    rank 2 stalls 5 s per barrier
+#   cp.net.send:rank=1:call=5:action=partition   rank 1 partitioned from
+#                                                frame 5 onward (both ways)
 #
 # Actions:
 #   die      os._exit(17): the process vanishes mid-protocol — no abort
@@ -52,6 +60,16 @@
 #   corrupt  flip bytes in the site's payload (frame corruption on the
 #            wire; the receiver's codec must fail loudly, never decode
 #            garbage silently).
+#   drop     return the DROPPED sentinel instead of the payload: the wire
+#            site discards this one frame (packet loss).  Valid ONLY at
+#            cp.net.* sites (strictly enforced at parse time) — callers
+#            there check `is DROPPED`; a dropped collective payload
+#            anywhere else would have no silent recovery.
+#   partition  like drop, but STICKY: from this arrival on, EVERY cp.net.*
+#            frame for the matched rank is dropped in both directions —
+#            the network-partition shape.  The rank falls silent without
+#            dying; survivors must detect it through lease expiry, and the
+#            partitioned rank itself loses the coordinator.
 #
 # THE UNARMED PATH IS FREE: with SRML_FAULTS unset, _PLAN is None and
 # site() is one module-global load + one `is None` branch — no env read, no
@@ -87,6 +105,8 @@ DIE_EXIT_CODE = 17
 SITES = (
     "cp.gather",
     "cp.barrier",
+    "cp.net.send",
+    "cp.net.recv",
     "exchange.ring_pass",
     "knn.ring_hop",
     "runner.fit",
@@ -94,7 +114,23 @@ SITES = (
     "context.init",
 )
 
-_ACTIONS = ("die", "raise", "kill", "delay", "corrupt")
+_ACTIONS = ("die", "raise", "kill", "delay", "corrupt", "drop", "partition")
+
+# wire sites share one sticky partition set: a partition armed at either
+# direction silences BOTH (a real partition has no half-duplex)
+_WIRE_PREFIX = "cp.net."
+
+
+class _Dropped:
+    """Singleton sentinel returned by action=drop/partition at wire sites:
+    the caller discards the frame (send skips the write, recv skips the
+    dispatch).  Identity-checked (`is DROPPED`), never equality."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<faults.DROPPED>"
+
+
+DROPPED = _Dropped()
 
 
 class FaultInjected(RuntimeError):
@@ -188,6 +224,12 @@ def _parse_spec(text: str) -> FaultSpec:
         raise ValueError(
             f"{FAULTS_ENV}: action=delay needs delay=<seconds> in {text!r}"
         )
+    if action in ("drop", "partition") and not site.startswith(_WIRE_PREFIX):
+        raise ValueError(
+            f"{FAULTS_ENV}: action={action} only applies to wire sites "
+            f"({_WIRE_PREFIX}*) — {text!r} would silently vanish a "
+            "collective payload"
+        )
     return FaultSpec(
         site=site,
         action=action,
@@ -207,15 +249,23 @@ class FaultPlan:
         self.specs = list(specs)
         self._lock = threading.Lock()
         self._counts: Dict[Tuple[str, Optional[str]], int] = {}
+        # ranks whose cp.net.* traffic is sticky-dropped (action=partition)
+        self._partitioned: set = set()
 
     def counts(self) -> Dict[Tuple[str, Optional[str]], int]:
         with self._lock:
             return dict(self._counts)
 
+    def partitioned(self) -> set:
+        with self._lock:
+            return set(self._partitioned)
+
     def fire(self, name: str, rank: Optional[int], tag: Optional[str], payload):
         key = (name, tag)
         with self._lock:
             self._counts[key] = count = self._counts.get(key, 0) + 1
+            if name.startswith(_WIRE_PREFIX) and rank in self._partitioned:
+                return DROPPED  # the partition swallows both directions
         for spec in self.specs:
             if spec.site != name or not spec.matches(rank, tag, count):
                 continue
@@ -238,6 +288,12 @@ class FaultPlan:
         if spec.action == "delay":
             time.sleep(spec.delay_s)
             return payload
+        if spec.action == "drop":
+            return DROPPED
+        if spec.action == "partition":
+            with self._lock:
+                self._partitioned.add(rank)
+            return DROPPED
         # corrupt: flip bytes in the payload; a site with nothing to
         # corrupt degrades to the orderly failure
         if not isinstance(payload, (bytes, bytearray)) or len(payload) == 0:

@@ -54,15 +54,41 @@ from .. import profiling
 # of one session-wide 300 s cliff, so a wedged round is diagnosed at round
 # granularity.  Retries: shared-FS I/O (NFS on TPU-VM pods) throws transient
 # OSErrors under churn; each read/write retries with exponential backoff and
-# deterministic per-rank jitter before giving up.
-ROUND_TIMEOUT_ENV = "SRML_CP_ROUND_TIMEOUT_S"
-RETRIES_ENV = "SRML_CP_RETRIES"
-BACKOFF_ENV = "SRML_CP_BACKOFF_S"
-_DEFAULT_ROUND_TIMEOUT_S = 300.0
-_DEFAULT_RETRIES = 3
-_DEFAULT_BACKOFF_S = 0.05
+# deterministic per-rank jitter before giving up.  The knobs and the parsed
+# RetryPolicy live in parallel/context.py (ONE policy shared by the file and
+# TCP planes); the names are re-exported here for compatibility.
+from .context import (  # noqa: E402 - knob re-exports
+    BACKOFF_ENV,
+    ControlPlaneTimeout,
+    RETRIES_ENV,
+    ROUND_TIMEOUT_ENV,
+    RetryPolicy,
+    _DEFAULT_ROUND_TIMEOUT_S,
+)
 
 from ..utils import env_float as _env_float  # noqa: E402 - knob parsing
+
+# which control plane make_control_plane builds: "file" (default, shared
+# filesystem) or "tcp" (srml-wire socket plane, parallel/netplane.py)
+CP_ENV = "SRML_CP"
+
+
+def make_control_plane(
+    root: str, rank: int, nranks: int, timeout: Optional[float] = None
+):
+    """Control-plane factory honoring SRML_CP: the process launchers and
+    multicontroller workers build their plane through this ONE chokepoint,
+    so the whole fit/kneighbors matrix reruns on the TCP plane by flipping
+    an env var (the conformance contract: same surface, same math,
+    bitwise-equal results — tests/test_multicontroller.py gates it)."""
+    kind = os.environ.get(CP_ENV, "file").strip().lower() or "file"
+    if kind == "file":
+        return FileControlPlane(root, rank, nranks, timeout=timeout)
+    if kind == "tcp":
+        from .netplane import bootstrap_tcp_plane
+
+        return bootstrap_tcp_plane(root, rank, nranks, timeout=timeout)
+    raise ValueError(f"{CP_ENV}={kind!r}: known planes are 'file' and 'tcp'")
 
 
 class FileControlPlane:
@@ -104,8 +130,11 @@ class FileControlPlane:
             else _env_float(ROUND_TIMEOUT_ENV, _DEFAULT_ROUND_TIMEOUT_S)
         )
         self._poll = poll
-        # deterministic per-rank backoff jitter (explicitly seeded: R4)
+        # deterministic per-rank backoff jitter (explicitly seeded: R4);
+        # the retry policy is parsed ONCE here (matching _timeout) and
+        # shared-by-contract with the TCP plane (context.RetryPolicy)
         self._jitter = random.Random(10007 + rank)
+        self._retry = RetryPolicy.from_env()
         os.makedirs(root, exist_ok=True)
         # liveness: pid + an exclusive flock held for the process lifetime.
         # The LOCK is the primary death signal — the kernel releases it the
@@ -158,22 +187,9 @@ class FileControlPlane:
     # -- retrying I/O ---------------------------------------------------------
     def _retry_io(self, fn, what: str):
         """Run `fn` retrying transient OSErrors with exponential backoff +
-        deterministic jitter (SRML_CP_RETRIES / SRML_CP_BACKOFF_S)."""
-        retries = int(_env_float(RETRIES_ENV, _DEFAULT_RETRIES))
-        backoff = _env_float(BACKOFF_ENV, _DEFAULT_BACKOFF_S)
-        attempt = 0
-        while True:
-            try:
-                return fn()
-            except OSError:
-                if attempt >= retries:
-                    raise
-                delay = backoff * (2 ** attempt) * (
-                    1.0 + 0.25 * self._jitter.random()
-                )
-                profiling.incr_counter("cp.io_retries")
-                attempt += 1
-                time.sleep(delay)
+        deterministic jitter — the construction-parsed RetryPolicy
+        (SRML_CP_RETRIES / SRML_CP_BACKOFF_S), NOT a per-call env re-read."""
+        return self._retry.run(fn, self._jitter)
 
     def _write_atomic(self, path: str, text_or_bytes) -> None:
         data = (
@@ -231,10 +247,8 @@ class FileControlPlane:
             self._raise_if_aborted()
             self._raise_if_peer_dead(missing)
             if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"FileControlPlane round {r}: ranks {missing} never "
-                    f"posted within {self._timeout}s "
-                    f"({ROUND_TIMEOUT_ENV} bounds each round)"
+                raise ControlPlaneTimeout(
+                    "FileControlPlane", r, missing, self._timeout
                 )
             time.sleep(self._poll)
         out = []

@@ -82,6 +82,15 @@ def stall_threshold_s() -> float:
     return _env_float(STALL_ENV, 0.0)
 
 
+def heartbeat_interval_s() -> float:
+    """SRML_WATCH_HEARTBEAT_S: the per-rank heartbeat period.  This is the
+    ONE liveness cadence the health plane is expressed in — the srml-wire
+    membership lease defaults to 1.5x this value (netplane.lease_interval_s),
+    which is what makes "a lost rank is named within 2 heartbeat intervals"
+    a contract instead of a coincidence."""
+    return _env_float(HEARTBEAT_ENV, _DEFAULT_HEARTBEAT_S)
+
+
 # -- the flight recorder ------------------------------------------------------
 
 _wtls = threading.local()
@@ -622,9 +631,7 @@ class HeartbeatPublisher:
             watch_ident if watch_ident is not None else threading.get_ident()
         )
         self.interval_s = (
-            interval_s
-            if interval_s is not None
-            else _env_float(HEARTBEAT_ENV, _DEFAULT_HEARTBEAT_S)
+            interval_s if interval_s is not None else heartbeat_interval_s()
         )
         self._stop = threading.Event()
         self._seq = 0
@@ -772,7 +779,7 @@ def start_fit_health(
         nranks <= 1
         or _recorder is None
         or not hasattr(control_plane, "publish_health")
-        or _env_float(HEARTBEAT_ENV, _DEFAULT_HEARTBEAT_S) <= 0
+        or heartbeat_interval_s() <= 0
     ):
         return _FitHealth()
     publisher = HeartbeatPublisher(control_plane, rank)

@@ -14,7 +14,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from spark_rapids_ml_tpu.ops.knn import distributed_kneighbors  # noqa: E402
-from spark_rapids_ml_tpu.parallel.runner import FileControlPlane  # noqa: E402
+from spark_rapids_ml_tpu.parallel.runner import make_control_plane  # noqa: E402
 
 
 def main() -> None:
@@ -26,7 +26,7 @@ def main() -> None:
     query_parts = (
         [(data["q_X"], data["q_id"])] if data["q_X"].shape[0] else []
     )
-    cp = FileControlPlane(os.path.join(root, "cp"), rank, nranks, timeout=180)
+    cp = make_control_plane(os.path.join(root, "cp"), rank, nranks, timeout=180)
     results = distributed_kneighbors(
         item_parts, query_parts, job["k"], rank, nranks, cp
     )

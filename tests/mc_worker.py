@@ -21,8 +21,8 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from spark_rapids_ml_tpu.core import load
     from spark_rapids_ml_tpu.parallel.runner import (
-        FileControlPlane,
         distributed_session,
+        make_control_plane,
     )
 
     shard = np.load(os.path.join(root, f"shard_{rank}.npz"))
@@ -38,7 +38,9 @@ def main() -> None:
     with open(os.path.join(root, "estimators.json")) as f:
         names = json.load(f)
 
-    cp = FileControlPlane(os.path.join(root, "cp"), rank, nranks)
+    # plane kind honors SRML_CP (file | tcp) — the whole fit matrix reruns
+    # over the srml-wire socket plane by flipping the env var
+    cp = make_control_plane(os.path.join(root, "cp"), rank, nranks)
     out = {}
     # one jax.distributed lifetime for every fit (the session amortizes the
     # bootstrap; each fit still barriers like the reference's per-fit NCCL)

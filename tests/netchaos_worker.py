@@ -1,0 +1,96 @@
+# One rank of the srml-wire chaos matrix: a real OS process doing
+# control-plane gather rounds over the plane SRML_CP selects (tcp for the
+# wire matrix; the same worker drives the file plane for cross-plane
+# comparisons) while SRML_FAULTS kills / partitions / corrupts one of the
+# cohort mid-round — or the DRIVER SIGKILLs a whole process (rank 0 hosts
+# the coordinator under SRML_CP=tcp, so killing rank 0 IS the
+# "kill the coordinator" case).  Exit codes are the protocol:
+#
+#    0  clean run (all rounds completed, teardown clean)
+#    7  survivor: raised RemoteRankError naming a dead/aborted/partitioned
+#       peer
+#    8  survivor of a lost CONTROL PLANE: CoordinatorLost (the coordinator
+#       died or this host is partitioned from it) or StaleEpochError (this
+#       process was fenced as a zombie)
+#    9  victim of action=raise: published its abort marker and exited
+#   17  victim of action=die (faults.DIE_EXIT_CODE): os._exit, no teardown
+#
+# Survivors print one machine-readable line:
+#   SHIELD rank=<me> kind=<remote|plane> culprit=<rank|-1> dt=<s> \
+#          span=<span> etype=<t>
+# where dt measures entry-into-the-failing-gather -> typed error — the
+# detection latency the ISSUE bounds at 2 heartbeat intervals.
+#
+# Invoked as: python netchaos_worker.py <rank> <nranks> <jobdir> [rounds]
+# (rounds <= 0 means "loop until killed": the coordinator-kill case needs
+# workers that outlive the driver's aim.)
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from spark_rapids_ml_tpu.parallel.context import RemoteRankError  # noqa: E402
+from spark_rapids_ml_tpu.parallel.faults import FaultInjected  # noqa: E402
+from spark_rapids_ml_tpu.parallel.netplane import (  # noqa: E402
+    CoordinatorLost,
+    StaleEpochError,
+)
+from spark_rapids_ml_tpu.parallel.runner import make_control_plane  # noqa: E402
+
+
+def main() -> None:
+    rank, nranks, root = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    rounds = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    cp = make_control_plane(
+        os.path.join(root, "cp"), rank, nranks, timeout=120
+    )
+    print(f"SHIELD rank={rank} joined", flush=True)
+    t0 = time.monotonic()
+    r = 0
+    try:
+        while rounds <= 0 or r < rounds:
+            t0 = time.monotonic()
+            got = cp.allGather(f"rank{rank}:round{r}")
+            assert len(got) == nranks, got
+            r += 1
+            time.sleep(0.05)  # a window for the driver's SIGKILL to land
+    except RemoteRankError as exc:
+        dt = time.monotonic() - t0
+        print(
+            f"SHIELD rank={rank} kind=remote culprit={exc.rank} dt={dt:.3f} "
+            f"span={exc.span} etype={exc.etype}",
+            flush=True,
+        )
+        cp.close()
+        sys.exit(7)
+    except (CoordinatorLost, StaleEpochError) as exc:
+        dt = time.monotonic() - t0
+        print(
+            f"SHIELD rank={rank} kind=plane culprit=-1 dt={dt:.3f} "
+            f"span=None etype={type(exc).__name__}",
+            flush=True,
+        )
+        cp.close()
+        sys.exit(8)
+    except FaultInjected as exc:
+        # the orderly victim: publish the abort marker the way
+        # TpuContext.__exit__ does on the exception path, then leave
+        import json
+
+        cp.abort(json.dumps({
+            "rank": rank,
+            "etype": type(exc).__name__,
+            "message": str(exc),
+            "span": "netchaos.gather",
+        }))
+        cp.close()
+        sys.exit(9)
+    print(f"SHIELD rank={rank} clean", flush=True)
+    cp.close()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,8 @@ from jax.sharding import PartitionSpec as P  # noqa: E402
 from spark_rapids_ml_tpu.parallel.context import (  # noqa: E402
     LocalControlPlane,
     TpuContext,
+)
+from spark_rapids_ml_tpu.parallel.netplane import (  # noqa: E402
     _free_port,
     _local_ip,
 )
@@ -72,6 +74,13 @@ class TestTpuContext:
 
         monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
         monkeypatch.setattr(jax.distributed, "shutdown", fake_shutdown)
+        # the real __enter__ would arm gloo collectives — with the FAKE
+        # initialize there is never a distributed client, and a gloo flag
+        # armed clientless breaks every later backend init in this process
+        # (the standalone-run landmine memory/jax-0437 documents)
+        from spark_rapids_ml_tpu import compat
+
+        monkeypatch.setattr(compat, "ensure_cpu_collectives", lambda: False)
 
         # rank 0 first (it mints the coordinator address, like the NCCL uid
         # in cuml_context.py:75-103), then rank 1 sees it via the gather
@@ -88,6 +97,9 @@ class TestTpuContext:
 
     def test_rank0_address_missing_raises(self, monkeypatch):
         monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: None)
+        from spark_rapids_ml_tpu import compat
+
+        monkeypatch.setattr(compat, "ensure_cpu_collectives", lambda: False)
 
         class EmptyCp:
             def allGather(self, message):

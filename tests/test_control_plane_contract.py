@@ -1,0 +1,280 @@
+# Control-plane CONFORMANCE suite (srml-wire satellite): ONE contract test
+# module parameterized over every plane implementation, so the three can
+# never drift.  The contract (parallel/context.ControlPlane + the
+# srml-shield/srml-watch extensions):
+#
+#   - allGather returns messages INDEXED BY RANK (result[r] = rank r's
+#     message) — exchange.py and the kneighbors protocol index positionally
+#   - allGatherBytes moves raw binary frames (no utf-8 assumption)
+#   - barrier completes when every rank arrives
+#   - publish_health / read_health: non-collective, never blocks
+#   - abort publishes a marker whose decoded shape carries rank / etype /
+#     message / span; peers see it via check_abort and blocked gathers
+#     raise RemoteRankError naming the origin
+#   - a gather that runs out its round budget raises the TYPED
+#     ControlPlaneTimeout (a TimeoutError) naming round + missing ranks +
+#     the SRML_CP_ROUND_TIMEOUT_S knob
+#   - close() is idempotent and leaves no presence files behind
+#
+# LocalControlPlane is the single-controller degenerate case: the same
+# surface, collectives are identities, abort is a no-op (no peers).
+import contextlib
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from spark_rapids_ml_tpu.parallel.context import (
+    ControlPlaneTimeout,
+    LocalControlPlane,
+    RemoteRankError,
+)
+from spark_rapids_ml_tpu.parallel.netplane import (
+    CoordinatorServer,
+    TcpControlPlane,
+)
+from spark_rapids_ml_tpu.parallel.runner import FileControlPlane
+
+NRANKS = 3
+
+
+class _PlaneHarness:
+    """nranks plane instances over one rendezvous + their teardown."""
+
+    def __init__(self, kind, tmp_path):
+        self.kind = kind
+        self.tmp_path = tmp_path
+        self._server = None
+
+    def build(self, timeout=30.0):
+        if self.kind == "file":
+            return [
+                FileControlPlane(
+                    str(self.tmp_path / "cp"), r, NRANKS, timeout=timeout
+                )
+                for r in range(NRANKS)
+            ]
+        self._server = CoordinatorServer(
+            NRANKS, host="127.0.0.1", advertise_host="127.0.0.1", lease_s=5.0
+        )
+        addr = self._server.start()
+        return [
+            TcpControlPlane(addr, r, NRANKS, timeout=timeout)
+            for r in range(NRANKS)
+        ]
+
+    def teardown(self, planes):
+        for p in planes:
+            with contextlib.suppress(Exception):
+                p.close()
+        if self._server is not None:
+            self._server.stop(grace_s=0.2)
+            self._server = None
+
+
+@pytest.fixture(params=["file", "tcp"])
+def harness(request, tmp_path):
+    h = _PlaneHarness(request.param, tmp_path)
+    built = []
+    orig = h.build
+
+    def build(**kw):
+        planes = orig(**kw)
+        built.extend(planes)
+        return planes
+
+    h.build = build
+    yield h
+    h.teardown(built)
+
+
+def _run_ranks(fn, planes):
+    """Run fn(rank, plane) on one thread per rank (the collective shape);
+    returns {rank: result} and re-raises the first worker error."""
+    results, errors = {}, {}
+
+    def run(r):
+        try:
+            results[r] = fn(r, planes[r])
+        except Exception as exc:  # noqa: BLE001 - relayed to the test
+            errors[r] = exc
+
+    threads = [
+        threading.Thread(target=run, args=(r,), name=f"cpc-r{r}")
+        for r in range(len(planes))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    if errors:
+        raise next(iter(errors.values()))
+    return results
+
+
+# -- gather ordering + binary round-trip --------------------------------------
+
+
+def test_allgather_is_rank_indexed(harness):
+    planes = harness.build()
+    results = _run_ranks(lambda r, p: p.allGather(f"msg-from-{r}"), planes)
+    for r in range(NRANKS):
+        assert results[r] == [f"msg-from-{i}" for i in range(NRANKS)], (
+            f"{harness.kind}: rank {r} saw {results[r]} — allGather MUST "
+            "index results by rank"
+        )
+
+
+def test_allgather_bytes_round_trips_raw_binary(harness):
+    planes = harness.build()
+    payloads = [bytes([r, 0x00, 0xFF, 0xFE]) + b"\x80raw" for r in range(NRANKS)]
+    results = _run_ranks(
+        lambda r, p: p.allGatherBytes(payloads[r]), planes
+    )
+    for r in range(NRANKS):
+        assert results[r] == payloads, f"{harness.kind}: binary frames drifted"
+
+
+def test_consecutive_rounds_stay_ordered(harness):
+    planes = harness.build()
+
+    def rounds(r, p):
+        out = []
+        for i in range(4):
+            out.append(p.allGather(f"{r}:{i}"))
+        p.barrier()
+        return out
+
+    results = _run_ranks(rounds, planes)
+    for r in range(NRANKS):
+        for i in range(4):
+            assert results[r][i] == [f"{j}:{i}" for j in range(NRANKS)]
+
+
+# -- health surface -----------------------------------------------------------
+
+
+def test_health_publish_read_is_nonblocking(harness):
+    planes = harness.build()
+
+    def publish(r, p):
+        p.publish_health(json.dumps({"rank": r, "progress": r * 10}))
+        return True
+
+    _run_ranks(publish, planes)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        health = planes[0].read_health()
+        if set(health) == set(range(NRANKS)):
+            break
+        time.sleep(0.02)
+    assert set(health) == set(range(NRANKS))
+    for r, payload in health.items():
+        assert json.loads(payload)["rank"] == r
+    # republish overwrites (latest-wins, not append)
+    planes[1].publish_health(json.dumps({"rank": 1, "progress": 99}))
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if json.loads(planes[0].read_health()[1])["progress"] == 99:
+            break
+        time.sleep(0.02)
+    assert json.loads(planes[0].read_health()[1])["progress"] == 99
+
+
+# -- abort marker shape -------------------------------------------------------
+
+
+def test_abort_marker_shape_and_gather_interrupt(harness):
+    planes = harness.build()
+    marker = {
+        "rank": 1, "etype": "ValueError",
+        "message": "induced", "span": "solver.step",
+    }
+    errs = {}
+
+    def waiter(rank):
+        try:
+            planes[rank].allGather("blocked")
+        except RemoteRankError as exc:
+            errs[rank] = exc
+
+    threads = [
+        threading.Thread(target=waiter, args=(r,), name=f"cpc-abort-r{r}")
+        for r in (0, 2)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    planes[1].abort(json.dumps(marker))
+    for t in threads:
+        t.join(timeout=15.0)
+    assert set(errs) == {0, 2}
+    for exc in errs.values():
+        assert (exc.rank, exc.etype, exc.span) == (1, "ValueError", "solver.step")
+    # the non-blocking surface decodes the same shape
+    info = planes[0].check_abort()
+    assert info is not None and info["rank"] == 1
+    assert info["etype"] == "ValueError" and info["span"] == "solver.step"
+
+
+# -- typed round timeout ------------------------------------------------------
+
+
+def test_round_timeout_typed_with_round_and_missing_ranks(harness):
+    planes = harness.build(timeout=0.4)
+    errs = {}
+
+    def run(r, p):
+        if r == 1:
+            time.sleep(1.2)  # rank 1 never posts within the budget
+            return None
+        try:
+            p.allGather("present")
+        except ControlPlaneTimeout as exc:
+            errs[r] = exc
+        return None
+
+    _run_ranks(run, planes)
+    assert set(errs) == {0, 2}
+    for exc in errs.values():
+        assert isinstance(exc, TimeoutError)  # compatibility subclass
+        assert exc.round_no == 0
+        assert exc.missing_ranks == [1]
+        assert exc.timeout_s == 0.4
+        assert exc.knob == "SRML_CP_ROUND_TIMEOUT_S"
+        assert "SRML_CP_ROUND_TIMEOUT_S" in str(exc)
+
+
+# -- close idempotence --------------------------------------------------------
+
+
+def test_close_is_idempotent_and_reaps_presence(harness, tmp_path):
+    planes = harness.build()
+    _run_ranks(lambda r, p: p.allGather(f"{r}"), planes)
+    for p in planes:
+        p.close()
+        p.close()  # second close must be a no-op, never an error
+    if harness.kind == "file":
+        leftovers = [
+            f for f in os.listdir(tmp_path / "cp")
+            if f.startswith(("alive_", "health_"))
+        ]
+        assert leftovers == []
+
+
+# -- the single-controller degenerate case ------------------------------------
+
+
+def test_local_plane_satisfies_the_surface():
+    cp = LocalControlPlane()
+    assert cp.allGather("m") == ["m"]
+    assert cp.allGatherBytes(b"\x00\xff") == [b"\x00\xff"]
+    assert cp.barrier() is None
+    cp.publish_health(json.dumps({"rank": 0, "progress": 1}))
+    assert json.loads(cp.read_health()[0])["progress"] == 1
+    cp.abort(json.dumps({"rank": 0}))  # no peers: a no-op, not an error
+    assert cp.check_abort() is None
+    cp.close()
+    cp.close()

@@ -750,6 +750,86 @@ def test_r9_pragma_escape():
     assert _lint(src, path="spark_rapids_ml_tpu/parallel/exchange.py") == []
 
 
+# -- R10: raw-socket confinement + bounded socket waits -----------------------
+
+R10_SOCKET_OUTSIDE = """
+    import socket
+
+    def pick_port():
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+    def dial(addr):
+        return socket.create_connection(addr, timeout=5.0)
+"""
+
+R10_UNBOUNDED_RECV = """
+    def read_all(sock, conn_listener):
+        conn, _ = conn_listener.accept()
+        return sock.recv(4096)
+"""
+
+R10_BOUNDED_RECV = """
+    def read_all(sock, conn_listener):
+        conn_listener.settimeout(0.25)
+        sock.settimeout(0.25)
+        conn, _ = conn_listener.accept()
+        return sock.recv(4096)
+"""
+
+
+def test_r10_fires_on_raw_sockets_outside_netplane():
+    findings = _lint(
+        R10_SOCKET_OUTSIDE, path="spark_rapids_ml_tpu/parallel/context.py"
+    )
+    assert _rules_of(findings) == ["R10"]
+    assert len(findings) == 2  # socket.socket + socket.create_connection
+    assert "parallel/netplane.py" in findings[0].message
+
+
+def test_r10_constructors_allowed_inside_netplane():
+    assert _lint(
+        R10_SOCKET_OUTSIDE,
+        path="spark_rapids_ml_tpu/parallel/netplane.py",
+    ) == []
+
+
+def test_r10_fires_on_unbounded_recv_accept_in_netplane():
+    findings = _lint(
+        R10_UNBOUNDED_RECV, path="spark_rapids_ml_tpu/parallel/netplane.py"
+    )
+    assert _rules_of(findings) == ["R10"]
+    assert len(findings) == 2  # accept + recv, both timeout-less
+    assert "settimeout" in findings[0].message
+
+
+def test_r10_silent_when_settimeout_precedes_the_wait():
+    assert _lint(
+        R10_BOUNDED_RECV, path="spark_rapids_ml_tpu/parallel/netplane.py"
+    ) == []
+
+
+def test_r10_scoped_to_the_package():
+    # tests/benchmarks may socket however they like; the recv discipline
+    # applies only inside the confined module itself
+    assert _lint(R10_SOCKET_OUTSIDE, path="tests/chaos_driver.py") == []
+    assert _lint(
+        R10_UNBOUNDED_RECV, path="spark_rapids_ml_tpu/serving/engine.py"
+    ) == []
+
+
+def test_r10_pragma_escape():
+    src = """
+        import socket
+
+        def legacy_probe():
+            s = socket.socket()  # graftlint: disable=R10 (pre-wire probe, bounded by caller)
+            return s
+    """
+    assert _lint(src, path="spark_rapids_ml_tpu/utils.py") == []
+
+
 # -- the gate: the real tree is clean -----------------------------------------
 
 

@@ -89,19 +89,27 @@ def _estimators():
     }
 
 
-def _worker_env(devs_per_rank: int = DEVS_PER_RANK):
+def _worker_env(devs_per_rank: int = DEVS_PER_RANK, plane: str = "file"):
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devs_per_rank}"
     env["PYTHONPATH"] = REPO
+    # which control plane the workers rendezvous over (srml-wire: the SAME
+    # matrix must pass on the TCP plane with bitwise-equal results)
+    env["SRML_CP"] = plane
     return env
 
 
-@pytest.fixture(scope="module")
-def multicontroller_attrs(tmp_path_factory):
-    """Stage data + estimators, run the 2-process fit once, return its
-    attrs alongside the single-controller baselines."""
-    root = str(tmp_path_factory.mktemp("mcjob"))
+# one fit-matrix run per control plane, cached so the per-plane fixture
+# params and the cross-plane bitwise gate share the two expensive runs
+_MATRIX_CACHE: dict = {}
+_BASELINE_CACHE: dict = {}
+
+
+def _matrix_payload(tmp_path_factory, plane: str):
+    if plane in _MATRIX_CACHE:
+        return _MATRIX_CACHE[plane]
+    root = str(tmp_path_factory.mktemp(f"mcjob_{plane}"))
     X, y, y_bin, y_multi = _make_data()
     halves = np.array_split(np.arange(N), NRANKS)
     for r, idx in enumerate(halves):
@@ -120,7 +128,7 @@ def multicontroller_attrs(tmp_path_factory):
         subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests", "mc_worker.py"),
              str(r), str(NRANKS), root],
-            env=_worker_env(),
+            env=_worker_env(plane=plane),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -136,21 +144,58 @@ def multicontroller_attrs(tmp_path_factory):
             out, _ = p.communicate()
         outs.append(out)
     for r, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert p.returncode == 0, f"[{plane}] rank {r} failed:\n{out}"
 
     with open(os.path.join(root, "attrs.json")) as f:
         payload = json.load(f)
+    _MATRIX_CACHE[plane] = payload
+    return payload
 
-    # single-controller baseline on the identical global dataset (the main
-    # pytest process runs an 8-device CPU mesh via conftest)
+
+def _baselines():
+    """Single-controller baseline on the identical global dataset (the main
+    pytest process runs an 8-device CPU mesh via conftest); cached across
+    the plane params."""
+    if _BASELINE_CACHE:
+        return _BASELINE_CACHE["models"]
     import pandas as pd
 
+    X, y, y_bin, y_multi = _make_data()
     pdf = pd.DataFrame(
         {"features": list(X), "label": y, "y_bin": y_bin, "y_multi": y_multi}
     )
     df = DataFrame.from_pandas(pdf, num_partitions=NRANKS)
-    baselines = {name: est.fit(df) for name, est in _estimators().items()}
-    return payload, baselines
+    _BASELINE_CACHE["models"] = {
+        name: est.fit(df) for name, est in _estimators().items()
+    }
+    return _BASELINE_CACHE["models"]
+
+
+@pytest.fixture(scope="module", params=["file", "tcp"])
+def multicontroller_attrs(request, tmp_path_factory):
+    """The 2-process fit matrix attrs + single-controller baselines — run
+    once per CONTROL PLANE (file, then srml-wire tcp), so every numeric
+    gate below holds verbatim over the socket plane."""
+    return _matrix_payload(tmp_path_factory, request.param), _baselines()
+
+
+def test_fit_matrix_bitwise_equal_across_planes(tmp_path_factory):
+    """srml-wire acceptance: the full fit matrix on SRML_CP=tcp produces
+    BITWISE-equal model attributes vs the file plane — the plane carries
+    rendezvous metadata only, it must never touch the math."""
+    pf = _matrix_payload(tmp_path_factory, "file")
+    pt = _matrix_payload(tmp_path_factory, "tcp")
+    assert set(pf["results"]) == set(pt["results"])
+    for name in sorted(pf["results"]):
+        a, b = _decoded(pf, name), _decoded(pt, name)
+        assert set(a) == set(b), (name, set(a) ^ set(b))
+        for key in sorted(a):
+            va, vb = np.asarray(a[key]), np.asarray(b[key])
+            assert va.shape == vb.shape and va.dtype == vb.dtype, (name, key)
+            np.testing.assert_array_equal(
+                va, vb,
+                err_msg=f"{name}.{key} drifted between file and tcp planes",
+            )
 
 
 def test_global_mesh_spans_both_processes(multicontroller_attrs):
@@ -482,11 +527,15 @@ def test_kneighbors_multirank_uneven_and_empty_rank(tmp_path, nranks):
     assert (i_mc == i_sc).mean() > 0.99  # ids may swap only on exact ties
 
 
-def test_kneighbors_across_processes_matches_single_controller(tmp_path):
+@pytest.mark.parametrize("plane", ["file", "tcp"])
+def test_kneighbors_across_processes_matches_single_controller(tmp_path, plane):
     """distributed_kneighbors over 2 OS processes (VERDICT round 3, item 1):
     item rows stay in their owning process, query blocks + candidate lists
-    ride the FileControlPlane, and the merged result must equal a
-    single-process knn_search over the concatenated item set."""
+    ride the control plane — the FileControlPlane AND the srml-wire TCP
+    plane (the kneighbors protocol is pure control-plane traffic, so the
+    plane swap exercises every binary-gather path) — and the merged result
+    must equal a single-process knn_search over the concatenated item
+    set."""
     from spark_rapids_ml_tpu.ops.knn import knn_search
     from spark_rapids_ml_tpu.parallel.mesh import get_mesh
 
@@ -511,14 +560,14 @@ def test_kneighbors_across_processes_matches_single_controller(tmp_path):
         subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests", "knn_mc_worker.py"),
              str(r), str(NRANKS), root],
-            env=_worker_env(),
+            env=_worker_env(plane=plane),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for r in range(NRANKS)
     ]
     for r, p in enumerate(procs):
         out, _ = p.communicate(timeout=600)
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert p.returncode == 0, f"[{plane}] rank {r} failed:\n{out}"
 
     d_mc = np.zeros((n_query, k), np.float32)
     i_mc = np.zeros((n_query, k), np.int64)
@@ -529,6 +578,63 @@ def test_kneighbors_across_processes_matches_single_controller(tmp_path):
     d_sc, i_sc = knn_search(items, item_ids, queries, k, get_mesh(None))
     np.testing.assert_allclose(d_mc, d_sc, rtol=1e-5, atol=1e-6)
     assert (i_mc == i_sc).mean() > 0.99  # ids may swap only on exact ties
+
+
+@pytest.mark.parametrize("plane", ["file", "tcp"])
+def test_killed_rank_mid_fit_surfaces_typed_and_bounded(tmp_path, plane):
+    """Chaos over a REAL jax.distributed session (the gap the srml-wire
+    verify drive exposed): rank 1 dies mid-fit (action=die at its 2nd
+    gather — after the jax.distributed bootstrap, before the solve).  The
+    survivor must (a) raise RemoteRankError NAMING rank 1, and (b) have
+    its whole teardown complete in bounded wall time — the stock jax
+    coordination heartbeats (10 s x 10) let the survivor dangle ~100 s in
+    the collective shutdown barrier and then LOG(FATAL) the process,
+    eating the typed error.  Fixed by the abort-path shutdown skip
+    (TpuContext.__exit__) + tightened heartbeats
+    (compat.distributed_initialize)."""
+    import time
+
+    root = str(tmp_path)
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((128, 4)).astype(np.float32)
+    y = (X @ np.ones(4, np.float32)).astype(np.float32)
+    for r, idx in enumerate(np.array_split(np.arange(128), NRANKS)):
+        np.savez(os.path.join(root, f"shard_{r}.npz"), X=X[idx], y=y[idx])
+    LinearRegression().save(os.path.join(root, "est_lr"))
+    with open(os.path.join(root, "estimators.json"), "w") as f:
+        json.dump(["lr"], f)
+    env = _worker_env(devs_per_rank=2, plane=plane)
+    env["SRML_FAULTS"] = "cp.gather:rank=1:call=2:action=die"
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "mc_worker.py"),
+             str(r), str(NRANKS), root],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(NRANKS)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out += "\n<TIMEOUT: killed by driver>"
+        outs.append(out)
+    wall = time.monotonic() - t0
+    from spark_rapids_ml_tpu.parallel.faults import DIE_EXIT_CODE
+
+    assert procs[1].returncode == DIE_EXIT_CODE, outs[1]
+    assert procs[0].returncode not in (0, None), outs[0]
+    assert "RemoteRankError" in outs[0] and "rank 1" in outs[0], outs[0]
+    assert "<TIMEOUT" not in outs[0], "survivor teardown dangled"
+    assert wall < 60.0, (
+        f"[{plane}] cohort took {wall:.0f}s to wind down — the jax-layer "
+        "teardown tail is unbounded again"
+    )
 
 
 def test_allgather_bytes_chunks_over_frame_limit(tmp_path):

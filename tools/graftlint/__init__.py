@@ -45,6 +45,12 @@
 #                    peer turns an unbounded wait into the srml-shield
 #                    motivating failure mode ("hang for 5 minutes, then
 #                    die without naming the culprit").
+#   R10 raw-socket   socket.socket/create_connection outside parallel/
+#                    netplane.py (the ONE audited home of the wire
+#                    surface — anywhere else is un-lease-fenced and
+#                    un-fault-injectable), and recv/accept inside
+#                    netplane without a preceding settimeout in the same
+#                    function body (the socket analog of R9).
 #
 # Suppression: `# graftlint: disable=R1 (reason)` on the finding line or the
 # line directly above.  Granted pragmas are audited in NOTES.md.
@@ -83,6 +89,7 @@ RULE_NAMES = {
     "R7": "unnamed-thread",
     "R8": "remote-dma",
     "R9": "unbounded-wait",
+    "R10": "raw-socket",
 }
 
 # Findings sanctioned by construction, not by pragma.  Entries are

@@ -24,7 +24,7 @@ from . import (
 def main(argv: List[str] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="JAX/TPU invariant checks (R1-R7) — see docs/graftlint.md",
+        description="JAX/TPU invariant checks (R1-R10) — see docs/graftlint.md",
     )
     parser.add_argument("paths", nargs="+", help="files or package dirs to lint")
     parser.add_argument(

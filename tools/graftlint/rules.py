@@ -23,7 +23,7 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9")
+RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10")
 
 FindingTuple = Tuple[str, int, str, str]  # (rule, line, message, func-qualname)
 
@@ -886,6 +886,83 @@ def _r9_check_except(
     )
 
 
+# -- R10: raw-socket confinement + bounded socket waits -----------------------
+# The srml-wire control plane (parallel/netplane.py) is the ONE audited
+# home of the raw socket API inside the package: a stray socket.socket()
+# elsewhere is an unbounded, un-lease-fenced, un-fault-injectable side
+# channel the chaos matrix can never exercise (the R8 confinement argument,
+# ported from remote-DMA to the network).  Within netplane itself, every
+# blocking socket wait must be poll-bounded: a `.recv()`/`.accept()` whose
+# function body has no PRECEDING `.settimeout()` is the wire analog of
+# R9's timeout-less `.result()` — a dead peer turns it into a forever-block
+# no watchdog can attribute.
+
+_R10_CONSTRUCTORS = {"socket.socket", "socket.create_connection"}
+_R10_WAITERS = {"recv", "accept"}
+
+
+def _r10_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "spark_rapids_ml_tpu/" in norm or norm.startswith(
+        "spark_rapids_ml_tpu"
+    )
+
+
+def _r10_confined(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return norm.endswith("parallel/netplane.py")
+
+
+def _r10_check_call(
+    call: ast.Call, index: ModuleIndex, qualname: str, path: str
+) -> Iterator[FindingTuple]:
+    name = index.dotted(call.func)
+    if name in _R10_CONSTRUCTORS and not _r10_confined(path):
+        yield (
+            "R10",
+            call.lineno,
+            f"{name} outside parallel/netplane.py: the raw socket surface "
+            "is confined to the ONE audited wire module — route control "
+            "traffic through TcpControlPlane / CoordinatorServer so it is "
+            "lease-fenced, fault-injectable, and bounded "
+            "(docs/graftlint.md#r10)",
+            qualname,
+        )
+
+
+def _r10_check_function(
+    fn: ast.FunctionDef, index: ModuleIndex, qualname: str
+) -> Iterator[FindingTuple]:
+    """Within netplane.py: every recv/accept must follow a settimeout in
+    the SAME function body (the local-invariant discipline — a reader
+    helper enforces its own poll bound instead of trusting callers)."""
+    first_settimeout: Optional[int] = None
+    waits: List[Tuple[int, str]] = []
+    for node in _walk_own_body(fn):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+        ):
+            continue
+        attr = node.func.attr
+        if attr == "settimeout":
+            if first_settimeout is None or node.lineno < first_settimeout:
+                first_settimeout = node.lineno
+        elif attr in _R10_WAITERS:
+            waits.append((node.lineno, attr))
+    for line, attr in sorted(waits):
+        if first_settimeout is None or line < first_settimeout:
+            yield (
+                "R10",
+                line,
+                f".{attr}() with no preceding .settimeout() in this "
+                "function body: a dead peer turns the read into a "
+                "forever-block no watchdog can attribute — set the poll "
+                "timeout where the wait happens (docs/graftlint.md#r10)",
+                qualname,
+            )
+
+
 # -- driver -------------------------------------------------------------------
 
 def lint_tree(
@@ -914,6 +991,12 @@ def lint_tree(
                     and _r8_applies(index.path)
                 ):
                     findings.extend(_r8_check_function(stmt, index, qual))
+                if (
+                    "R10" in selected
+                    and isinstance(stmt, ast.FunctionDef)
+                    and _r10_confined(index.path)
+                ):
+                    findings.extend(_r10_check_function(stmt, index, qual))
                 visit_functions(stmt.body, f"{qual}.", is_jit)
             elif isinstance(stmt, ast.ClassDef):
                 visit_functions(stmt.body, f"{prefix}{stmt.name}.", enclosing_jit)
@@ -966,6 +1049,8 @@ def lint_tree(
                 findings.extend(_r8_check_call(node, index, qual, index.path))
             if "R9" in selected and _r9_applies(index.path):
                 findings.extend(_r9_check_call(node, index, qual))
+            if "R10" in selected and _r10_applies(index.path):
+                findings.extend(_r10_check_call(node, index, qual, index.path))
         if (
             isinstance(node, ast.ExceptHandler)
             and "R9" in selected
