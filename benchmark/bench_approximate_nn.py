@@ -36,6 +36,22 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
             "--no_recall", action="store_true",
             help="skip the exact-path recall pass (the probed arm alone)",
         )
+        self._parser.add_argument(
+            "--algorithm", choices=("ivfflat", "ivfpq"), default="ivfflat",
+            help="index tier: raw f32 lists or product-quantized codes",
+        )
+        self._parser.add_argument(
+            "--pq_m", type=int, default=0,
+            help="ivfpq subspaces (0 = ann/pq.default_m_sub(dim))",
+        )
+        self._parser.add_argument(
+            "--pq_bits", type=int, default=0,
+            help="ivfpq bits per code (0 = 8)",
+        )
+        self._parser.add_argument(
+            "--refine_ratio", type=int, default=0,
+            help="ivfpq f32 re-score factor (0 = the engine default, 4)",
+        )
 
     def run_once(
         self,
@@ -80,6 +96,15 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
 
         nlist = self.args.nlist or default_nlist(X.shape[0])
         nprobe = self.args.nprobe or default_nprobe(nlist)
+        algorithm = self.args.algorithm
+        algo_params = {"nlist": int(nlist), "nprobe": int(nprobe)}
+        if algorithm == "ivfpq":
+            if self.args.pq_m:
+                algo_params["M"] = int(self.args.pq_m)
+            if self.args.pq_bits:
+                algo_params["n_bits"] = int(self.args.pq_bits)
+            if self.args.refine_ratio:
+                algo_params["refine_ratio"] = int(self.args.refine_ratio)
         # block-stashed frames: extract_partition_features returns the SAME
         # array object every call, so staged caches hit on repeats (the kNN
         # arm's spread countermeasure)
@@ -87,7 +112,8 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
         query_bdf = DataFrame.from_numpy(Q)
         est = ApproximateNearestNeighbors(
             k=k,
-            algoParams={"nlist": int(nlist), "nprobe": int(nprobe)},
+            algorithm=algorithm,
+            algoParams=algo_params,
             **self.num_workers_arg(),
         ).setInputCol("features")
         # fit time here IS the index build (quantizer + assignment + layout)
@@ -133,13 +159,27 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
             "transform_time": transform_time,
             "total_time": fit_time + transform_time,
             "qps": Q.shape[0] / max(transform_time, 1e-9),
+            "algorithm": algorithm,
             "nlist": int(nlist),
             "nprobe": int(nprobe),
             "steady_compiles": int(steady_compiles),
+            # the compression headline: device-resident index bytes per
+            # indexed item on this mesh (flat ~4*D+4; pq ~m_sub+4) — run
+            # the flat and pq arms on one dataset and compare (ci/test.sh
+            # step 3n gates the >= 8x ratio at d=256-scale geometry)
+            "index_bytes_per_item": float(model.index_bytes_per_item()),
             "score": float(np.mean(dists[:, -1])),
             "phase_times": phases,
             "precompile_counters": profiling.counters("precompile"),
         }
+        if algorithm == "ivfpq":
+            from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+            idx = model._ensure_staged_pq(get_mesh(model.num_workers))
+            out["pq_m"] = int(idx.m_sub)
+            out["pq_bits"] = int(idx.n_bits)
+            _m, _b, ratio = model._resolved_pq_params(model.n_cols)
+            out["refine_ratio"] = int(ratio)
         if not self.args.no_recall:
             # the exact reference rides the SAME model (exactSearch flips
             # the route, ids share the packed layout's id space)
@@ -159,4 +199,21 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
             out["exact_transform_time"] = exact_time
             out["exact_qps"] = Q.shape[0] / max(exact_time, 1e-9)
             out["speedup_vs_exact"] = exact_time / max(transform_time, 1e-9)
+            if algorithm == "ivfpq":
+                # the RAW ADC recall (refine off) travels next to the
+                # refined number — the gap IS the quantization error the
+                # f32 re-score recovers
+                model.setAlgoParams({**algo_params, "refine_ratio": 1})
+                try:
+                    _, _, raw_df = model.kneighbors(query_bdf)
+                finally:
+                    model.setAlgoParams(algo_params)
+                raw_ids = np.concatenate(
+                    [
+                        np.asarray(list(p["indices"]))
+                        for p in raw_df.partitions
+                        if len(p)
+                    ]
+                )
+                out["recall_at_k_raw"] = float(recall_at_k(raw_ids, exact_ids))
         return out

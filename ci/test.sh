@@ -501,6 +501,62 @@ assert abort["tcp"]["wire_counters"].get("cp.net.pushed_aborts", 0) > 0
 EOF
 rm -rf "$WIRE_SMOKE"
 
+# 3n. srml-pq IVF-PQ gates (also inside the full suite; re-asserted by
+#     name so marker drift can never silently drop them —
+#     docs/ann_engine.md §IVF-PQ).  Runs on the 8-device CPU mesh, forced
+#     explicitly:
+#     - the ADC LUT-accumulation kernel EXACT vs the numpy oracle in
+#       interpret mode (sequential-j accumulation contract, ragged rows,
+#       sub-256 table widths)
+#     - BITWISE 1-device-vs-8-device parity of probed AND refined ivfpq
+#       results (the flat kernel's lex/merge helpers reused verbatim)
+#     - refined recall@10 >= 0.9 at the documented defaults on clustered
+#       data, and zero-new-compile repeat/warmed searches
+#     plus a graftlint-clean re-check of the ann + touched ops modules by
+#     name, and a paired bench_approximate_nn smoke (flat + pq arms on ONE
+#     dataset) asserting refined recall@10 >= 0.9, zero new compiles in
+#     the timed repeat window, and the compression headline:
+#     pq index_bytes_per_item < 1/8 of the flat arm's.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_pq_engine.py -q
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_pq_engine.py -q \
+    -k "lut_kernel or mesh_parity or refined_recall or zero_new_compiles"
+python -m tools.graftlint spark_rapids_ml_tpu/ann \
+    spark_rapids_ml_tpu/ops/pallas_pq.py spark_rapids_ml_tpu/ops/pallas_tpu.py \
+    spark_rapids_ml_tpu/models/approximate_nn.py \
+    benchmark/bench_approximate_nn.py
+PQ_SMOKE=$(mktemp -d)
+python -m benchmark.gen_data blobs --num_rows 2000 --num_cols 32 --n_clusters 8 \
+    --output_dir "$PQ_SMOKE/blobs" --output_num_files 2
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.benchmark_runner approximate_nearest_neighbors \
+    --train_path "$PQ_SMOKE/blobs" --k 10 --nlist 8 --nprobe 4 \
+    --report_path "$PQ_SMOKE/ann.jsonl"
+# pq operating point for the tiny smoke: every list probed + x8 refine
+# (raw ADC recall at 2k rows x 32 dims is ~0.54 — the refine recovery is
+# exactly what the gate exercises), n_bits=6 so the fixed codebook bytes
+# do not swamp the per-item ratio at this tiny item count
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.benchmark_runner approximate_nearest_neighbors \
+    --train_path "$PQ_SMOKE/blobs" --k 10 --nlist 8 --nprobe 8 \
+    --algorithm ivfpq --pq_m 8 --pq_bits 6 --refine_ratio 8 \
+    --report_path "$PQ_SMOKE/ann.jsonl"
+python - "$PQ_SMOKE/ann.jsonl" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1])]
+arms = {r.get("algorithm", "ivfflat"): r for r in recs}
+assert set(arms) == {"ivfflat", "ivfpq"}, sorted(arms)
+pq, flat = arms["ivfpq"], arms["ivfflat"]
+assert pq["recall_at_k"] >= 0.9, pq              # refined recall@10
+assert "recall_at_k_raw" in pq and pq["qps"] > 0, pq
+assert pq["steady_compiles"] == 0, pq            # repeat_new_compiles == 0
+# the compression headline, measured on one dataset: pq < flat / 8
+ratio = flat["index_bytes_per_item"] / pq["index_bytes_per_item"]
+assert ratio >= 8.0, (flat["index_bytes_per_item"], pq["index_bytes_per_item"])
+EOF
+rm -rf "$PQ_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

@@ -10,6 +10,13 @@
 # rides the kNN block pipeline, and every kernel dispatches through the
 # process-wide AOT executable cache.
 #
+# Second tier: IVF-PQ (pq.py) — residual product quantization on top of the
+# same coarse machinery: items stored as m_sub one-byte codes + one ADC
+# scalar (~32x device-memory compression at embedding dims), probed search
+# becomes a per-query lookup-table accumulation over int8 codes
+# (ops/pallas_pq), and recall is recovered by re-scoring top candidates
+# against the host-side f32 payload.
+#
 
 from .ivfflat import (
     IVFFlatIndex,
@@ -22,8 +29,24 @@ from .ivfflat import (
     recall_at_k,
     warm_probe_kernels,
 )
+from .pq import (
+    IVFPQIndex,
+    PackedPQ,
+    build_ivfpq_packed,
+    default_m_sub,
+    index_from_packed_pq,
+    ivfpq_search_prepared,
+    warm_pq_probe_kernels,
+)
 
 __all__ = [
+    "IVFPQIndex",
+    "PackedPQ",
+    "build_ivfpq_packed",
+    "default_m_sub",
+    "index_from_packed_pq",
+    "ivfpq_search_prepared",
+    "warm_pq_probe_kernels",
     "IVFFlatIndex",
     "PackedIVF",
     "build_ivfflat_packed",

@@ -70,6 +70,14 @@ from ..parallel.mesh import (
 )
 from ..ops.precompile import cached_kernel, kernel_cache_key, shape_bucket
 
+# The ONE lexicographic (d2, pos) tie contract: ops/knn.lex_topk (this
+# engine's mesh-parity gate established it; PR 9 moved the implementation
+# into the exact engine's exchange kernels).  Re-exported here — `_lex_topk`
+# used to be a private wrapper around it, and the PQ tier (ann/pq.py)
+# imports the same names — so every selection point in the ANN subsystem
+# shares one total order and one sentinel.
+from ..ops.knn import LEX_POS_SENTINEL, lex_topk as _lex_topk  # noqa: E402
+
 # nlist padding unit: the packed layout pads the list count to a multiple of
 # 8, and staging re-pads to lcm(8, n_dev) — every power-of-two mesh up to 8
 # devices therefore sees the IDENTICAL padded geometry (the parity basis;
@@ -79,8 +87,9 @@ _LIST_ALIGN = 8
 # min-bucket rule)
 _MIN_LIST_SLOTS = 8
 # positions are int32 (list * L_pad + slot); the sentinel marks
-# invalid/padded candidate slots and must exceed every real position
-_POS_SENTINEL = np.int32(np.iinfo(np.int32).max)
+# invalid/padded candidate slots and must exceed every real position —
+# the SAME sentinel lex_topk pads unfillable slots with (one contract)
+_POS_SENTINEL = LEX_POS_SENTINEL
 # byte budget for the gathered (chunk, nprobe, L_pad, D) candidate tile —
 # the probe kernel's only big intermediate; sized per query chunk so HBM
 # use stays flat no matter the query block.  SRML_ANN_TILE_BUDGET overrides
@@ -122,17 +131,63 @@ def _probe_chunk(block: int, nprobe: int, l_pad: int, dim: int) -> int:
     return min(c, block)
 
 
-def _lex_topk(d2: jax.Array, pos: jax.Array, k: int, group: int = 1024):
-    """Smallest k candidates by the lexicographic (d2, pos) key, ascending —
-    ONE implementation shared with the exact engine's exchange kernels
-    (ops/knn.lex_topk, moved there when the ring/gather candidate exchange
-    adopted the same total-order tie contract this engine's mesh-parity
-    gate established).  Positions are unique among valid candidates, so
-    the key is a TOTAL order: the result is identical no matter how the
-    input pool was concatenated."""
-    from ..ops.knn import lex_topk
+def select_probes(
+    q: jax.Array,       # (Q, D) replicated queries
+    c: jax.Array,       # (nlist_pad, D) replicated centroids
+    cn: jax.Array,      # (nlist_pad,) replicated ||c||^2, +inf pad rows
+    nprobe: int,
+    lps: int,           # lists per shard
+    mesh: Mesh,
+):
+    """Replicated probe selection shared by the IVF-Flat and IVF-PQ probe
+    kernels: expanded-form query->centroid distances, top-nprobe lists, and
+    each shard's local-list mapping.  Identical on every shard and every
+    mesh size (pad-list rows carry +inf norms so they lose to every genuine
+    list; lax.top_k tie-break is lowest-index-first, also replicated).
 
-    return lex_topk(d2, pos, k, group=group, sentinel=_POS_SENTINEL)
+    Returns (qn (Q,), d2c_probe (Q, nprobe) probed-centroid distances —
+    the ADC base term the PQ kernel consumes, discarded by IVF-Flat —
+    probes (Q, nprobe) int32, lp (Q, nprobe) clamped local list ids,
+    is_local (Q, nprobe) ownership mask)."""
+    qn = (q * q).sum(axis=1)
+    cross = jnp.matmul(
+        q, c.T,
+        precision=jax.lax.Precision.HIGH,
+        preferred_element_type=jnp.float32,
+    )
+    d2c = qn[:, None] - 2.0 * cross + cn[None, :]
+    neg_d2, probes = jax.lax.top_k(-d2c, nprobe)  # (Q, nprobe)
+    if mesh.shape[DATA_AXIS] > 1:
+        off = jax.lax.axis_index(DATA_AXIS) * lps
+    else:
+        off = jnp.int32(0)
+    local = probes - off
+    is_local = (local >= 0) & (local < lps)
+    lp = jnp.clip(local, 0, lps - 1)
+    return qn, -neg_d2, probes, lp, is_local
+
+
+def merge_shard_topk(
+    best_d: jax.Array, best_p: jax.Array, mesh: Mesh, k: int
+):
+    """The ONE cross-shard candidate merge, shared VERBATIM by the IVF-Flat
+    and IVF-PQ probe kernels (the 1-dev-vs-8-dev bitwise parity contract
+    has a single implementation): per-shard (Q, k) candidates scattered
+    into a (n_dev, Q, k) slab and psum'd (exact — each element is one
+    shard's value plus zeros), then one final lexicographic (d2, pos)
+    selection.  Typed exchange section: uniform exchange.ann.probe_merge.*
+    counters."""
+    if mesh.shape[DATA_AXIS] <= 1:
+        return best_d, best_p
+    from ..parallel.exchange import device_collective
+
+    Q = best_d.shape[0]
+    sec = device_collective("ann.probe_merge")
+    all_d = sec.psum_merge(best_d, DATA_AXIS)
+    all_p = sec.psum_merge(best_p, DATA_AXIS)
+    cand_d = jnp.moveaxis(all_d, 0, 1).reshape(Q, -1)
+    cand_p = jnp.moveaxis(all_p, 0, 1).reshape(Q, -1)
+    return _lex_topk(cand_d, cand_p, k)
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "chunk"))
@@ -157,25 +212,12 @@ def ivf_probe_kernel(
     def per_shard(ld_loc, ln_loc, cnt_loc, c, cn, q):
         lps = ld_loc.shape[0]
         Q = q.shape[0]
-        qn = (q * q).sum(axis=1)
-        # probe selection on REPLICATED data: identical on every shard and
-        # every mesh size (pad-list rows carry +inf norms, so they lose to
-        # every genuine list; lax.top_k tie-break is lowest-index-first,
-        # also replicated)
-        cross = jnp.matmul(
-            q, c.T,
-            precision=jax.lax.Precision.HIGH,
-            preferred_element_type=jnp.float32,
+        # probe selection on REPLICATED data (shared with the PQ kernel;
+        # the probed-centroid distances it also returns are the ADC base
+        # term — unused here, DCE'd by XLA)
+        qn, _d2p, probes, lp, is_local = select_probes(
+            q, c, cn, nprobe, lps, mesh
         )
-        d2c = qn[:, None] - 2.0 * cross + cn[None, :]
-        _, probes = jax.lax.top_k(-d2c, nprobe)  # (Q, nprobe) int32
-        if mesh.shape[DATA_AXIS] > 1:
-            off = jax.lax.axis_index(DATA_AXIS) * lps
-        else:
-            off = jnp.int32(0)
-        local = probes - off
-        is_local = (local >= 0) & (local < lps)
-        lp = jnp.clip(local, 0, lps - 1)
         slot = jnp.arange(l_pad, dtype=jnp.int32)
 
         def chunk_body(carry, i):
@@ -217,21 +259,9 @@ def ivf_probe_kernel(
         _, (ds, ps) = jax.lax.scan(
             chunk_body, 0, jnp.arange(n_chunks, dtype=jnp.int32)
         )
-        best_d = ds.reshape(Q, k)
-        best_p = ps.reshape(Q, k)
-        if mesh.shape[DATA_AXIS] > 1:
-            from ..parallel.exchange import device_collective
-
-            # the ONE cross-shard collective: per-shard (Q, k) candidates
-            # scattered into a (n_dev, Q, k) slab and psum'd (exact — each
-            # element is one shard's value plus zeros).  Typed exchange
-            # section: uniform exchange.ann.probe_merge.* counters.
-            sec = device_collective("ann.probe_merge")
-            all_d = sec.psum_merge(best_d, DATA_AXIS)
-            all_p = sec.psum_merge(best_p, DATA_AXIS)
-            cand_d = jnp.moveaxis(all_d, 0, 1).reshape(Q, -1)
-            cand_p = jnp.moveaxis(all_p, 0, 1).reshape(Q, -1)
-            best_d, best_p = _lex_topk(cand_d, cand_p, k)
+        best_d, best_p = merge_shard_topk(
+            ds.reshape(Q, k), ps.reshape(Q, k), mesh, k
+        )
         return jnp.sqrt(jnp.maximum(best_d, 0.0)), best_p
 
     return shard_map(
@@ -297,34 +327,39 @@ class IVFFlatIndex:
         self.l_pad = l_pad
         self.dim = dim
 
+    def device_bytes(self) -> int:
+        """Global device-resident footprint of the staged index (logical
+        bytes across all shards; ids stay host-side) — the numerator of the
+        benchmark's index_bytes_per_item column, where the flat-vs-PQ
+        compression headline is measured."""
+        return int(
+            self.list_data.nbytes + self.list_norm.nbytes
+            + self.counts.nbytes + self.centroids.nbytes + self.c_norm.nbytes
+        )
 
-def build_ivfflat_packed(
-    items,
-    item_ids: np.ndarray,
-    n_lists: int,
-    seed: int = 0,
+
+def train_coarse_quantizer(
+    items: np.ndarray,
+    n_clusters: int,
+    seed: int,
     max_train_rows: int = _TRAIN_CAP,
     max_iter: int = 25,
     tol: float = 1e-4,
-) -> PackedIVF:
-    """Train the coarse quantizer and pack the inverted lists.
-
-    Every step is mesh-independent by construction: the kmeans engine runs
-    on a SINGLE-device submesh over a deterministic sample (FAISS-style —
-    the quantizer trains on a sample anyway, and a multi-shard psum would
-    tie the centroid bits to the mesh size), assignment is per-row argmin
-    (no cross-row reduction), and the layout is a stable host sort.  The
-    same PackedIVF therefore stages bitwise-identically on any mesh."""
+    phase: str = "ann.train",
+) -> np.ndarray:
+    """Train an (n_clusters, D) quantizer with the EXISTING kmeans engine on
+    a SINGLE-device submesh over a deterministic seed-keyed sample (the
+    FAISS convention — IVF quantizers train on a sample anyway, and a
+    multi-shard psum would tie the centroid bits to the mesh size).  The
+    result is therefore mesh-independent data.  Shared by the IVF coarse
+    quantizer and the PQ per-subspace codebooks (ann/pq.py)."""
     from ..ops.kmeans import lloyd_iterations, scalable_kmeans_pp_init
 
     items = np.ascontiguousarray(np.asarray(items), dtype=np.float32)
-    n, d = items.shape
-    if n == 0:
-        raise ValueError("cannot build an IVF-Flat index over 0 items")
-    n_lists = int(max(1, min(n_lists, n)))
+    n = items.shape[0]
+    n_clusters = int(max(1, min(n_clusters, n)))
     seed = int(seed) & 0x7FFFFFFF
-
-    with profiling.phase("ann.train"):
+    with profiling.phase(phase):
         mesh1 = get_mesh(1)
         rng = np.random.default_rng(seed)
         if n > max_train_rows:
@@ -336,17 +371,31 @@ def build_ivfflat_packed(
         wd = jax.device_put(
             np.ones(train.shape[0], np.float32), data_sharding(mesh1)
         )
-        round_size = max(1, min(2 * n_lists, train.shape[0]))
+        round_size = max(1, min(2 * n_clusters, train.shape[0]))
         centers0 = scalable_kmeans_pp_init(
-            Xd, wd, n_lists, seed, 2.0, rounds=4, round_size=round_size
+            Xd, wd, n_clusters, seed, 2.0, rounds=4, round_size=round_size
         )
         centers, _, _ = lloyd_iterations(
             Xd, wd, centers0, mesh1, max_iter, float(tol),
             min(32768, train.shape[0]),
         )
-        centroids = np.asarray(jax.device_get(centers), np.float32)
+        return np.asarray(jax.device_get(centers), np.float32)
 
-    with profiling.phase("ann.assign"):
+
+def assign_nearest(
+    items: np.ndarray,
+    centroids: np.ndarray,
+    phase: str = "ann.assign",
+    counter: str = "ann.assign_blocks",
+) -> np.ndarray:
+    """Nearest-centroid id per row via the fused distance+argmin kernel in
+    pow2 row blocks through the AOT executable cache, ONE batched fetch.
+    Per-row math with no cross-row reduction — assignments are bitwise
+    mesh-independent.  Shared by IVF list assignment and PQ subspace
+    encoding (same executable when shapes agree)."""
+    items = np.ascontiguousarray(np.asarray(items), dtype=np.float32)
+    n, d = items.shape
+    with profiling.phase(phase):
         cdev = jnp.asarray(centroids)
         block = shape_bucket(min(n, _ASSIGN_BLOCK), lo=256)
         handles = []
@@ -366,8 +415,36 @@ def build_ivfflat_packed(
         # would pay a host round-trip apiece)
         fetched = jax.device_get(handles)
         assign = np.concatenate([np.asarray(a) for a in fetched])[:n]
-        assign = assign.astype(np.int64)
-        profiling.incr_counter("ann.assign_blocks", len(handles))
+        profiling.incr_counter(counter, len(handles))
+        return assign.astype(np.int64)
+
+
+def build_ivfflat_packed(
+    items,
+    item_ids: np.ndarray,
+    n_lists: int,
+    seed: int = 0,
+    max_train_rows: int = _TRAIN_CAP,
+    max_iter: int = 25,
+    tol: float = 1e-4,
+) -> PackedIVF:
+    """Train the coarse quantizer and pack the inverted lists.
+
+    Every step is mesh-independent by construction: the kmeans engine runs
+    on a single-device submesh over a deterministic sample
+    (train_coarse_quantizer), assignment is per-row argmin with no
+    cross-row reduction (assign_nearest), and the layout is a stable host
+    sort.  The same PackedIVF therefore stages bitwise-identically on any
+    mesh."""
+    items = np.ascontiguousarray(np.asarray(items), dtype=np.float32)
+    n, _d = items.shape
+    if n == 0:
+        raise ValueError("cannot build an IVF-Flat index over 0 items")
+    n_lists = int(max(1, min(n_lists, n)))
+    centroids = train_coarse_quantizer(
+        items, n_lists, seed, max_train_rows, max_iter, tol
+    )
+    assign = assign_nearest(items, centroids)
 
     with profiling.phase("ann.layout"):
         nlist_base = -(-n_lists // _LIST_ALIGN) * _LIST_ALIGN

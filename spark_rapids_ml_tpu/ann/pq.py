@@ -1,0 +1,670 @@
+#
+# IVF-PQ: residual product quantization on top of the IVF machinery —
+# the ~32x-compressed 100M+-item tier of the ANN subsystem.
+#
+# IVF-Flat (ivfflat.py) stores raw f32 vectors, so device memory caps the
+# index around ~10M items at embedding dims.  This tier stores each item as
+# m_sub one-byte codes plus one f32 correction scalar (FAISS IVFPQ, Jegou
+# et al. "Product quantization for nearest neighbor search"; cuML
+# algorithm='ivfpq'):
+#
+#   build:  the coarse quantizer and list assignment are the SHARED IVF
+#           helpers (train_coarse_quantizer / assign_nearest — the kmeans
+#           engine + the fused distance+argmin kernel).  Residuals
+#           r = x - centroid[assign] are split into m_sub subspaces
+#           (feature dim zero-padded to m_sub * dsub, dsub a pow2), each
+#           subspace gets its own ksub=2^n_bits-centroid codebook trained
+#           with the SAME kmeans engine (single-device submesh, FAISS
+#           training-sample cap), and encoding is the SAME fused
+#           distance+argmin kernel per subspace.  The packed payload
+#           (codes + per-item ADC scalars + list layout) is
+#           mesh-independent, exactly like PackedIVF.
+#   search: asymmetric distance computation (ADC).  With r^ the item's
+#           reconstructed residual (disjoint subspace codewords),
+#
+#             d2(q, item) = ||q - centroid_l - r^||^2
+#                         = ||q - centroid_l||^2            (probe term)
+#                         + sum_j  -2 q_j . cb[j, code_j]   (query table)
+#                         + (||r^||^2 + 2 centroid_l . r^)  (item scalar)
+#
+#           The probe term falls out of probe selection (select_probes
+#           already computes every query->centroid distance), the item
+#           scalar is packed per item at build time, and the query table
+#           T (m_sub, ksub) is computed ONCE per query block and stays
+#           VMEM-resident while the int8 codes of the probed lists stream
+#           through the LUT-accumulation kernel (ops/pallas_pq — MXU-free,
+#           and the per-item HBM traffic is m_sub bytes instead of
+#           IVF-Flat's 4*D: the scan is bandwidth-optimal by layout).
+#           Selection and the cross-shard merge are REUSED VERBATIM from
+#           the flat kernel (lexicographic (d2, pos) total order +
+#           merge_shard_topk), so probed PQ results are bitwise identical
+#           on 1-device and 8-device meshes, same contract, same gate.
+#   refine: ADC distances are quantized approximations; recall is
+#           recovered by probing top (k * refine_ratio) candidates and
+#           re-scoring them against the f32 vectors the exactSearch
+#           fallback already keeps HOST-side (the expanded-form f32
+#           formulation the exact engine uses).  The device index stays
+#           codes-only — compression is a device-memory claim; the f32
+#           payload lives in host RAM with the model.
+#
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import profiling
+from ..compat import shard_map
+from ..parallel.mesh import (
+    DATA_AXIS,
+    axis_sharding,
+    data_sharding,
+    replicated_sharding,
+)
+from ..ops.pallas_pq import lut_accumulate
+from ..ops.precompile import cached_kernel, kernel_cache_key, shape_bucket
+from .ivfflat import (
+    _LIST_ALIGN,
+    _MIN_LIST_SLOTS,
+    _POS_SENTINEL,
+    _TRAIN_CAP,
+    _lex_topk,
+    _probe_tile_budget,
+    assign_nearest,
+    merge_shard_topk,
+    select_probes,
+    train_coarse_quantizer,
+)
+
+# ADC re-score chunk budget: bytes of gathered (q_chunk, R, D) f32
+# candidates the host refine materializes at once
+_REFINE_BUDGET = 256 << 20
+# subspace-seed stride: each codebook trains with its own deterministic
+# seed so subspaces do not share init draws
+_SUBSPACE_SEED_STRIDE = 0x51F1_5EED
+
+DEFAULT_N_BITS = 8
+DEFAULT_REFINE_RATIO = 4
+
+
+def default_m_sub(dim: int) -> int:
+    """Subspace count: the largest power of two <= dim/8 clamped to
+    [4, 64] (and never above dim) — ~8 feature dims per one-byte code,
+    the 32x-compression operating point at embedding dims (documented
+    with the measured recall table in docs/ann_engine.md)."""
+    target = max(4, dim // 8)
+    m = 1 << (target.bit_length() - 1)
+    return int(max(1, min(64, m, dim)))
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def pq_geometry(dim: int, m_sub: int) -> Tuple[int, int, int]:
+    """(m_sub, dsub, d_pad): subspace width is the pow2 bucket of
+    ceil(dim / m_sub) and the feature axis zero-pads to m_sub * dsub —
+    pow2-padded subspaces keep every per-subspace kernel at one static
+    lane-aligned geometry."""
+    m_sub = int(max(1, min(m_sub, dim)))
+    dsub = _pow2_ceil(-(-dim // m_sub))
+    return m_sub, dsub, m_sub * dsub
+
+
+def _pad_features(x: np.ndarray, d_pad: int) -> np.ndarray:
+    if x.shape[1] == d_pad:
+        return x
+    out = np.zeros((x.shape[0], d_pad), np.float32)
+    out[:, : x.shape[1]] = x
+    return out
+
+
+class PackedPQ:
+    """Host-side, mesh-INDEPENDENT IVF-PQ payload: per-item codes + ADC
+    scalars sorted by list (stable, the SAME layout rule as PackedIVF),
+    per-list counts, the coarse centroids, and the subspace codebooks.
+    This is what the model persists through the core npz path;
+    index_from_packed_pq expands it per mesh."""
+
+    __slots__ = (
+        "codes", "scalars", "ids", "items", "counts", "centroids",
+        "codebooks", "n_lists", "n_items", "dim", "m_sub", "n_bits",
+    )
+
+    def __init__(
+        self, codes, scalars, ids, items, counts, centroids, codebooks,
+        n_lists, n_items, dim, m_sub, n_bits,
+    ):
+        self.codes = codes          # (N, m_sub) uint8, list-sorted
+        self.scalars = scalars      # (N,) f32 ADC item scalars, list-sorted
+        self.ids = ids              # (N,) int64 user ids, list-sorted
+        self.items = items          # (N, dim) f32 list-sorted — HOST-side
+        #                             refine/exactSearch payload, never staged
+        self.counts = counts        # (nlist_base,) int64 per-list counts
+        self.centroids = centroids  # (n_lists, dim) f32 coarse quantizer
+        self.codebooks = codebooks  # (m_sub, ksub, dsub) f32
+        self.n_lists = int(n_lists)
+        self.n_items = int(n_items)
+        self.dim = int(dim)
+        self.m_sub = int(m_sub)
+        self.n_bits = int(n_bits)
+
+
+def reconstruct(packed: PackedPQ, rows: Optional[np.ndarray] = None) -> np.ndarray:
+    """Decode rows back to (approximate) vectors: coarse centroid + the
+    subspace codewords, truncated to the true feature dim.  The encode/
+    decode round-trip oracle in tests/test_pq_engine.py rides this."""
+    m_sub, dsub, d_pad = pq_geometry(packed.dim, packed.m_sub)
+    if rows is None:
+        rows = np.arange(packed.codes.shape[0])
+    codes = packed.codes[rows].astype(np.int64)
+    rec = np.zeros((codes.shape[0], d_pad), np.float32)
+    for j in range(m_sub):
+        rec[:, j * dsub : (j + 1) * dsub] = packed.codebooks[j][codes[:, j]]
+    row_list = np.repeat(
+        np.arange(packed.counts.shape[0]), packed.counts
+    )[rows]
+    cpad = _pad_features(packed.centroids, d_pad)
+    return (rec + cpad[row_list])[:, : packed.dim]
+
+
+def build_ivfpq_packed(
+    items,
+    item_ids: np.ndarray,
+    n_lists: int,
+    m_sub: int,
+    n_bits: int = DEFAULT_N_BITS,
+    seed: int = 0,
+    max_train_rows: int = _TRAIN_CAP,
+    max_iter: int = 25,
+    tol: float = 1e-4,
+) -> PackedPQ:
+    """Train the coarse quantizer + per-subspace codebooks and pack the
+    code lists.  Mesh-independent by the same construction as the flat
+    build: every kmeans runs on a single-device submesh over a
+    deterministic sample, encoding is per-row argmin, the ADC scalars are
+    host float64 math rounded once to f32 (index DATA, like c_norm), and
+    the layout is a stable host sort."""
+    items = np.ascontiguousarray(np.asarray(items), dtype=np.float32)
+    n, d = items.shape
+    if n == 0:
+        raise ValueError("cannot build an IVF-PQ index over 0 items")
+    if not 1 <= int(n_bits) <= 8:
+        raise ValueError(f"n_bits must be in [1, 8]; got {n_bits}")
+    n_lists = int(max(1, min(n_lists, n)))
+    m_sub, dsub, d_pad = pq_geometry(d, m_sub)
+    ksub = 1 << int(n_bits)
+    seed = int(seed) & 0x7FFFFFFF
+
+    centroids = train_coarse_quantizer(
+        items, n_lists, seed, max_train_rows, max_iter, tol
+    )
+    assign = assign_nearest(items, centroids)
+
+    with profiling.phase("ann.pq_train"):
+        # residuals on the padded feature axis; pad dims are exactly zero,
+        # so codebook centroids stay exactly zero there (means of zeros)
+        cpad = _pad_features(centroids, d_pad)
+        res = _pad_features(items, d_pad) - cpad[assign]
+        codebooks = np.stack(
+            [
+                train_coarse_quantizer(
+                    res[:, j * dsub : (j + 1) * dsub],
+                    ksub,
+                    (seed + _SUBSPACE_SEED_STRIDE * (j + 1)) & 0x7FFFFFFF,
+                    max_train_rows,
+                    max_iter,
+                    tol,
+                    phase="ann.pq_codebook",
+                )
+                for j in range(m_sub)
+            ]
+        )  # (m_sub, ksub_eff, dsub); ksub_eff = min(ksub, n)
+
+    with profiling.phase("ann.pq_encode"):
+        codes = np.empty((n, m_sub), np.uint8)
+        for j in range(m_sub):
+            cj = assign_nearest(
+                res[:, j * dsub : (j + 1) * dsub],
+                codebooks[j],
+                phase="ann.pq_encode_block",
+                counter="ann.pq_encode_blocks",
+            )
+            codes[:, j] = cj.astype(np.uint8)
+
+    with profiling.phase("ann.pq_scalars"):
+        # s_item = ||r^||^2 + 2 centroid . r^  in float64, stored f32:
+        # mesh-independent index DATA (the same once-rounded contract as
+        # the staged c_norm/x_norm)
+        rec = np.zeros((n, d_pad), np.float64)
+        idx = codes.astype(np.int64)
+        for j in range(m_sub):
+            rec[:, j * dsub : (j + 1) * dsub] = codebooks[j][idx[:, j]]
+        scalars = (
+            np.einsum("nd,nd->n", rec, rec)
+            + 2.0 * np.einsum("nd,nd->n", cpad[assign].astype(np.float64), rec)
+        ).astype(np.float32)
+
+    with profiling.phase("ann.layout"):
+        nlist_base = -(-n_lists // _LIST_ALIGN) * _LIST_ALIGN
+        counts = np.bincount(assign, minlength=nlist_base).astype(np.int64)
+        order = np.argsort(assign, kind="stable")
+    return PackedPQ(
+        codes[order],
+        scalars[order],
+        np.asarray(item_ids, np.int64)[order],
+        items[order],
+        counts,
+        centroids,
+        codebooks.astype(np.float32),
+        n_lists,
+        n,
+        d,
+        m_sub,
+        n_bits,
+    )
+
+
+class IVFPQIndex:
+    """Device-staged IVF-PQ index (one mesh's layout of a PackedPQ).  The
+    device-resident per-item cost is m_sub bytes of codes + 4 bytes of ADC
+    scalar — the compression headline device_bytes() measures."""
+
+    __slots__ = (
+        "codes", "scalars", "counts", "centroids", "c_norm", "codebooks",
+        "ids", "rows", "n_items", "n_lists", "nlist_pad", "l_pad",
+        "dim", "d_pad", "m_sub", "dsub", "ksub", "n_bits",
+    )
+
+    def __init__(
+        self, codes, scalars, counts, centroids, c_norm, codebooks, ids,
+        rows, n_items, n_lists, nlist_pad, l_pad, dim, d_pad, m_sub, dsub,
+        ksub, n_bits,
+    ):
+        self.codes = codes          # (nlist_pad, L_pad, m_sub) u8 sharded
+        self.scalars = scalars      # (nlist_pad, L_pad) f32 sharded
+        self.counts = counts        # (nlist_pad,) int32 sharded
+        self.centroids = centroids  # (nlist_pad, d_pad) f32 replicated
+        self.c_norm = c_norm        # (nlist_pad,) f32 replicated, inf pads
+        self.codebooks = codebooks  # (m_sub, ksub, dsub) f32 replicated
+        self.ids = ids              # (nlist_pad * L_pad,) int64 HOST, -1 pads
+        self.rows = rows            # (nlist_pad * L_pad,) int64 HOST packed
+        #                             row per slot, -1 pads (the refine map)
+        self.n_items = n_items
+        self.n_lists = n_lists
+        self.nlist_pad = nlist_pad
+        self.l_pad = l_pad
+        self.dim = dim
+        self.d_pad = d_pad
+        self.m_sub = m_sub
+        self.dsub = dsub
+        self.ksub = ksub
+        self.n_bits = n_bits
+
+    def device_bytes(self) -> int:
+        """Global device-resident footprint (logical bytes across shards;
+        ids/rows and the refine f32 payload stay host-side)."""
+        return int(
+            self.codes.nbytes + self.scalars.nbytes + self.counts.nbytes
+            + self.centroids.nbytes + self.c_norm.nbytes
+            + self.codebooks.nbytes
+        )
+
+
+def index_from_packed_pq(packed: PackedPQ, mesh: Mesh) -> IVFPQIndex:
+    """Expand a PackedPQ into this mesh's device layout — the SAME pow2
+    bucket geometry as the flat index (L_pad = pow2 of the longest list,
+    nlist_pad a multiple of lcm(8, n_dev), int32 position overflow guard),
+    with (nlist_pad, L_pad, m_sub) uint8 codes + (nlist_pad, L_pad) f32 ADC
+    scalars row-sharded on the LIST axis instead of f32 vectors."""
+    m_sub, dsub, d_pad = pq_geometry(packed.dim, packed.m_sub)
+    ksub = packed.codebooks.shape[1]
+    n_dev = mesh.shape[DATA_AXIS]
+    mult = math.lcm(_LIST_ALIGN, n_dev)
+    nlist_pad = -(-max(packed.n_lists, 1) // mult) * mult
+    counts = np.zeros(nlist_pad, np.int64)
+    counts[: packed.counts.shape[0]] = packed.counts
+    l_pad = shape_bucket(int(max(counts.max(), 1)), lo=_MIN_LIST_SLOTS)
+    if nlist_pad * l_pad > int(_POS_SENTINEL):
+        raise ValueError(
+            f"IVF-PQ layout overflows int32 positions: {nlist_pad} lists x "
+            f"{l_pad} slots; raise nlist so lists shrink"
+        )
+    n = packed.codes.shape[0]
+    offs = np.zeros(nlist_pad + 1, np.int64)
+    np.cumsum(counts, out=offs[1:])
+    row_list = np.repeat(np.arange(nlist_pad, dtype=np.int64), counts)
+    slot = np.arange(n, dtype=np.int64) - offs[row_list]
+    flat = row_list * l_pad + slot
+    codes = np.zeros((nlist_pad * l_pad, m_sub), np.uint8)
+    codes[flat] = packed.codes
+    scal = np.zeros(nlist_pad * l_pad, np.float32)
+    scal[flat] = packed.scalars
+    ids_pad = np.full(nlist_pad * l_pad, -1, np.int64)
+    ids_pad[flat] = packed.ids
+    rows_pad = np.full(nlist_pad * l_pad, -1, np.int64)
+    rows_pad[flat] = np.arange(n, dtype=np.int64)
+    cpad = np.zeros((nlist_pad, d_pad), np.float32)
+    cpad[: packed.n_lists] = _pad_features(packed.centroids, d_pad)
+    c_norm = np.einsum(
+        "nd,nd->n", cpad.astype(np.float64), cpad.astype(np.float64)
+    ).astype(np.float32)
+    c_norm[packed.n_lists :] = np.inf  # pad lists never win a probe slot
+    stage_bytes = int(codes.nbytes + scal.nbytes)
+    with profiling.phase("ann.stage", bytes=stage_bytes):
+        index = IVFPQIndex(
+            codes=jax.device_put(
+                codes.reshape(nlist_pad, l_pad, m_sub),
+                axis_sharding(mesh, 0, 3),
+            ),
+            scalars=jax.device_put(
+                scal.reshape(nlist_pad, l_pad), axis_sharding(mesh, 0, 2)
+            ),
+            counts=jax.device_put(counts.astype(np.int32), data_sharding(mesh)),
+            centroids=jax.device_put(cpad, replicated_sharding(mesh)),
+            c_norm=jax.device_put(c_norm, replicated_sharding(mesh)),
+            codebooks=jax.device_put(
+                np.ascontiguousarray(packed.codebooks, np.float32),
+                replicated_sharding(mesh),
+            ),
+            ids=ids_pad,
+            rows=rows_pad,
+            n_items=packed.n_items,
+            n_lists=packed.n_lists,
+            nlist_pad=nlist_pad,
+            l_pad=l_pad,
+            dim=packed.dim,
+            d_pad=d_pad,
+            m_sub=m_sub,
+            dsub=dsub,
+            ksub=ksub,
+            n_bits=packed.n_bits,
+        )
+    profiling.incr_counter("ann.stage_bytes", stage_bytes)
+    return index
+
+
+def _pq_probe_chunk(block: int, nprobe: int, l_pad: int, m_sub: int) -> int:
+    """Power-of-two query-chunk size whose gathered code tile + the LUT
+    gather intermediate fit the shared probe tile budget
+    (SRML_ANN_TILE_BUDGET).  `block` is a pow2 bucket, so the chunk always
+    divides it — the scan needs no ragged tail."""
+    per_row = max(nprobe * l_pad * (4 * m_sub + 8), 1)
+    c = max(1, _probe_tile_budget() // per_row)
+    c = 1 << (c.bit_length() - 1)
+    return min(c, block)
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "chunk"))
+def ivfpq_probe_kernel(
+    codes: jax.Array,      # (nlist_pad, L_pad, m_sub) u8 list-sharded
+    scalars: jax.Array,    # (nlist_pad, L_pad) f32 list-sharded ADC scalars
+    counts: jax.Array,     # (nlist_pad,) int32 list-sharded
+    centroids: jax.Array,  # (nlist_pad, d_pad) replicated
+    c_norm: jax.Array,     # (nlist_pad,) replicated, +inf pad rows
+    codebooks: jax.Array,  # (m_sub, ksub, dsub) replicated
+    queries: jax.Array,    # (Q, d_pad) replicated
+    mesh: Mesh,
+    k: int,
+    nprobe: int,
+    chunk: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Probed IVF-PQ ADC search: (euclidean ADC distances (Q, k) ascending,
+    positions (Q, k) into the padded list layout — the flat kernel's exact
+    output contract, -1/inf sentinel mapping included).  Selection and the
+    cross-shard merge are the flat kernel's own helpers, so the bitwise
+    1-dev-vs-8-dev parity argument carries over verbatim: ADC terms reduce
+    over fixed-shape tiles (m_sub-wide LUT rows, dsub-wide table einsum)
+    identical on every mesh size, and every selection orders by the total
+    (d2, pos) key."""
+    _nlist_pad, l_pad, m_sub = codes.shape
+    ksub = codebooks.shape[1]
+    dsub = codebooks.shape[2]
+
+    def per_shard(cd_loc, sc_loc, cnt_loc, c, cn, cb, q):
+        lps = cd_loc.shape[0]
+        Q = q.shape[0]
+        _qn, d2p, probes, lp, is_local = select_probes(
+            q, c, cn, nprobe, lps, mesh
+        )
+        # the per-query ADC table T[q, j, c] = -2 q_j . cb[j, c] — computed
+        # once per block on REPLICATED data, resident across the list scan
+        tables = -2.0 * jnp.einsum(
+            "qjd,jcd->qjc",
+            q.reshape(Q, m_sub, dsub),
+            cb,
+            precision=jax.lax.Precision.HIGH,
+            preferred_element_type=jnp.float32,
+        )  # (Q, m_sub, ksub)
+        slot = jnp.arange(l_pad, dtype=jnp.int32)
+
+        def chunk_body(carry, i):
+            d2p_c = jax.lax.dynamic_slice_in_dim(d2p, i * chunk, chunk)
+            lp_c = jax.lax.dynamic_slice_in_dim(lp, i * chunk, chunk)
+            loc_c = jax.lax.dynamic_slice_in_dim(is_local, i * chunk, chunk)
+            pr_c = jax.lax.dynamic_slice_in_dim(probes, i * chunk, chunk)
+            t_c = jax.lax.dynamic_slice_in_dim(tables, i * chunk, chunk)
+            # gather the chunk's probed CODE lists from the resident shard:
+            # (chunk, nprobe, L_pad, m_sub) uint8 — m_sub bytes/item, the
+            # whole bandwidth story
+            ctile = jnp.take(cd_loc, lp_c, axis=0)
+            stile = jnp.take(sc_loc, lp_c, axis=0)  # (chunk, nprobe, L_pad)
+            acc = lut_accumulate(
+                t_c, ctile.reshape(chunk, nprobe * l_pad, m_sub)
+            ).reshape(chunk, nprobe, l_pad)
+            # ADC distance: probe term + query-table term + item scalar,
+            # fixed association order (parity: same shapes on every mesh)
+            d2 = d2p_c[:, :, None] + (acc + stile)
+            valid = loc_c[:, :, None] & (
+                slot[None, None, :] < jnp.take(cnt_loc, lp_c, axis=0)[:, :, None]
+            )
+            d2 = jnp.where(valid, d2, jnp.inf)
+            pos = pr_c[:, :, None] * l_pad + slot[None, None, :]
+            pos = jnp.where(valid, pos, _POS_SENTINEL)
+            bd, bp = _lex_topk(
+                d2.reshape(chunk, -1), pos.reshape(chunk, -1), k
+            )
+            return carry, (bd, bp)
+
+        n_chunks = Q // chunk
+        _, (ds, ps) = jax.lax.scan(
+            chunk_body, 0, jnp.arange(n_chunks, dtype=jnp.int32)
+        )
+        best_d, best_p = merge_shard_topk(
+            ds.reshape(Q, k), ps.reshape(Q, k), mesh, k
+        )
+        return jnp.sqrt(jnp.maximum(best_d, 0.0)), best_p
+
+    return shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(), P(),
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(codes, scalars, counts, centroids, c_norm, codebooks, queries)
+
+
+def _effective_nprobe(index: IVFPQIndex, nprobe: int) -> int:
+    return int(max(1, min(nprobe, index.nlist_pad)))
+
+
+def _probe_k(k_eff: int, refine_ratio: int, n_items: int) -> int:
+    """Candidate count the probe kernel selects: k itself without refine,
+    k * refine_ratio (clamped to the item count) with it.  Static — part
+    of the kernel cache key, derived identically by warm and dispatch."""
+    if refine_ratio <= 1:
+        return k_eff
+    return int(max(k_eff, min(k_eff * int(refine_ratio), n_items)))
+
+
+def ivfpq_search_prepared(
+    index: IVFPQIndex,
+    queries,
+    k: int,
+    nprobe: int,
+    mesh: Mesh,
+    query_block: int = 8192,
+    refine_items: Optional[np.ndarray] = None,
+    refine_ratio: int = DEFAULT_REFINE_RATIO,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Probed ADC search + optional f32 refine: returns (distances
+    (Q, k_eff) ascending euclidean, ids (Q, k_eff) int64, -1 unfillable),
+    k_eff = min(k, n_items) — the flat engine's exact frame contract.
+
+    With `refine_items` (the model's list-sorted f32 payload, the same
+    array the exactSearch route scores), the kernel selects the top
+    k * refine_ratio ADC candidates and the host re-scores them against
+    the true vectors (expanded-form f32, lexicographic (d2, pos) ties) —
+    deterministic given the probed candidates, which are themselves
+    bitwise mesh-independent, so refined results inherit mesh parity.
+
+    Query blocks ride the kNN engine's dispatch/collect pipeline and every
+    kernel dispatch rides the AOT executable cache: repeat same-shape
+    searches perform zero new compilations (refine adds none — it is host
+    numpy)."""
+    from ..ops.knn import _pipeline_window, _query_block_bucket, _run_block_pipeline
+
+    q = np.asarray(queries, dtype=np.float32)
+    if q.ndim != 2 or q.shape[1] != index.dim:
+        raise ValueError(f"queries must be (n, {index.dim}); got {q.shape}")
+    k_eff = min(k, index.n_items)
+    if q.shape[0] == 0:
+        return (
+            np.zeros((0, k_eff), dtype=np.float32),
+            np.zeros((0, k_eff), dtype=np.int64),
+        )
+    refine = refine_items is not None and int(refine_ratio) > 1
+    kp = _probe_k(k_eff, int(refine_ratio) if refine else 1, index.n_items)
+    np_eff = _effective_nprobe(index, nprobe)
+    qp = _pad_features(q, index.d_pad)
+    block = _query_block_bucket(q.shape[0], query_block)
+    chunk = _pq_probe_chunk(block, np_eff, index.l_pad, index.m_sub)
+    starts = list(range(0, q.shape[0], block))
+    pending: list = []
+    out_d, out_p = [], []
+
+    def _dispatch(bi):
+        start = starts[bi]
+        qb = qp[start : start + block]
+        n_q = qb.shape[0]
+        if n_q != block:
+            qb = np.concatenate(
+                [qb, np.zeros((block - n_q, index.d_pad), np.float32)]
+            )
+        d, pos = cached_kernel(
+            "ann_pq_probe", ivfpq_probe_kernel,
+            index.codes, index.scalars, index.counts,
+            index.centroids, index.c_norm, index.codebooks, jnp.asarray(qb),
+            mesh=mesh, k=kp, nprobe=np_eff, chunk=chunk,
+        )
+        for h in (d, pos):
+            try:
+                h.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                break
+        pending.append((d, pos, n_q))
+
+    def _collect(bi):
+        d, pos, n_q = pending.pop(0)
+        d_host, pos_host = jax.device_get((d, pos))
+        out_d.append(d_host[:n_q])
+        out_p.append(pos_host[:n_q])
+    _run_block_pipeline(
+        len(starts), _dispatch, _collect, _pipeline_window(2),
+        phase_prefix="ann",
+    )
+    profiling.incr_counter("ann.searches")
+    d_all = np.concatenate(out_d)
+    p_all = np.concatenate(out_p)
+    if refine:
+        with profiling.phase("ann.refine"):
+            return _refine_host(
+                index, refine_items, q, d_all, p_all, k_eff
+            )
+    with profiling.phase("ann.merge"):
+        ids = index.ids[np.minimum(p_all, index.ids.size - 1)]
+        ids[np.isinf(d_all)] = -1
+        return d_all[:, :k_eff], ids[:, :k_eff]
+
+
+def _refine_host(
+    index: IVFPQIndex,
+    items: np.ndarray,      # (N, dim) f32 list-sorted (the packed payload)
+    q: np.ndarray,          # (Q, dim) f32 queries, true feature width
+    d_probe: np.ndarray,    # (Q, R) ADC distances (inf = invalid)
+    pos: np.ndarray,        # (Q, R) padded-layout positions
+    k_eff: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Re-score the probed ADC candidates against the f32 vectors: the
+    expanded-form distance the exact engine uses (||q||^2 - 2 q.x +
+    ||x||^2, f32), lexicographic (d2, pos) selection — the ONE tie
+    contract.  Chunked over queries so the gathered (chunk, R, D)
+    candidate tile stays inside a fixed byte budget."""
+    Q, R = d_probe.shape
+    rows = index.rows[np.minimum(pos, index.rows.size - 1)]
+    invalid = np.isinf(d_probe) | (rows < 0)
+    rows = np.where(invalid, 0, rows)
+    qn = np.einsum("qd,qd->q", q, q, dtype=np.float32)
+    q_chunk = max(1, _REFINE_BUDGET // max(R * index.dim * 4, 1))
+    out_d = np.empty((Q, k_eff), np.float32)
+    out_i = np.empty((Q, k_eff), np.int64)
+    for s in range(0, Q, q_chunk):
+        e = min(s + q_chunk, Q)
+        cand = items[rows[s:e]]                      # (c, R, D) f32
+        xn = np.einsum("crd,crd->cr", cand, cand, dtype=np.float32)
+        cross = np.einsum("cd,crd->cr", q[s:e], cand, dtype=np.float32)
+        d2 = qn[s:e, None] - 2.0 * cross + xn
+        d2 = np.where(invalid[s:e], np.inf, d2)
+        order = np.lexsort((pos[s:e], d2), axis=-1)[:, :k_eff]
+        rsel = np.take_along_axis(d2, order, axis=1)
+        psel = np.take_along_axis(pos[s:e], order, axis=1)
+        ids = index.ids[np.minimum(psel, index.ids.size - 1)]
+        ids[np.isinf(rsel)] = -1
+        out_d[s:e] = np.sqrt(np.maximum(rsel, 0.0))
+        out_i[s:e] = ids
+    profiling.incr_counter("ann.refined_queries", int(Q))
+    return out_d, out_i
+
+
+def warm_pq_probe_kernels(
+    index: IVFPQIndex,
+    k: int,
+    nprobe: int,
+    mesh: Mesh,
+    n_queries: int = None,
+    query_block: int = 8192,
+    refine: bool = True,
+    refine_ratio: int = DEFAULT_REFINE_RATIO,
+) -> list:
+    """Submit the AOT compilation the next same-shape probed PQ search will
+    dispatch — key derived by the SAME kernel_cache_key/_probe_k/_pq_probe_chunk
+    the dispatch path uses, so the first dispatch lands on the warmed
+    executable (the serving entry's warm hook, flat-warm contract)."""
+    from ..ops.knn import _query_block_bucket
+    from ..ops.precompile import aval, global_precompiler
+
+    k_eff = min(k, index.n_items)
+    kp = _probe_k(k_eff, int(refine_ratio) if refine else 1, index.n_items)
+    np_eff = _effective_nprobe(index, nprobe)
+    block = _query_block_bucket(n_queries or query_block, query_block)
+    chunk = _pq_probe_chunk(block, np_eff, index.l_pad, index.m_sub)
+    q_aval = aval((block, index.d_pad), np.float32)
+    args = (
+        index.codes, index.scalars, index.counts,
+        index.centroids, index.c_norm, index.codebooks, q_aval,
+    )
+    statics = dict(k=kp, nprobe=np_eff, chunk=chunk)
+    key = kernel_cache_key("ann_pq_probe", args, mesh, statics)
+    global_precompiler().submit(
+        key, ivfpq_probe_kernel, *args, mesh=mesh, **statics
+    )
+    return [key]

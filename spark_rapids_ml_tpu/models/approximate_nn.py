@@ -1,20 +1,25 @@
 #
-# ApproximateNearestNeighbors estimator/model (IVF-Flat).
+# ApproximateNearestNeighbors estimator/model (IVF-Flat + IVF-PQ).
 #
 # Param-surface parity with the reference's ApproximateNearestNeighbors
-# (cuML algorithm='ivfflat', algoParams={'nlist', 'nprobe'}): fit TRAINS the
-# coarse quantizer and packs the inverted lists (unlike the exact
-# NearestNeighbors, whose fit only captures the frame — an ANN index is a
-# real artifact), kneighbors runs the probed search, and `exactSearch=True`
-# routes through the exact brute-force engine over the same packed items (a
-# recall-vs-latency escape hatch that shares ids with the probed path).
-# Unlike the exact model, this model IS persistable: the packed index
-# (items sorted by list, ids, per-list counts, centroids) rides the core
+# (cuML algorithm='ivfflat'|'ivfpq'; algoParams={'nlist', 'nprobe'} plus
+# the PQ keys {'M', 'n_bits', 'usePrecomputedTables'}): fit TRAINS the
+# coarse quantizer (and, for ivfpq, the per-subspace codebooks) and packs
+# the inverted lists (unlike the exact NearestNeighbors, whose fit only
+# captures the frame — an ANN index is a real artifact), kneighbors runs
+# the probed search, and `exactSearch=True` routes through the exact
+# brute-force engine over the same packed items (a recall-vs-latency
+# escape hatch that shares ids with the probed path).  The ivfpq tier
+# additionally re-scores its top k*refine_ratio ADC candidates against the
+# host-side f32 payload (the same array exactSearch scores) to recover
+# recall — the device index itself stays ~32x compressed.  Unlike the
+# exact model, this model IS persistable: the packed index rides the core
 # npz persistence path and restages onto whatever mesh loads it.
 #
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -29,6 +34,16 @@ from ..ann.ivfflat import (
     ivfflat_search_prepared,
     warm_probe_kernels,
 )
+from ..ann.pq import (
+    DEFAULT_N_BITS,
+    DEFAULT_REFINE_RATIO,
+    PackedPQ,
+    build_ivfpq_packed,
+    default_m_sub,
+    index_from_packed_pq,
+    ivfpq_search_prepared,
+    warm_pq_probe_kernels,
+)
 from ..core import _TpuEstimatorSupervised, _TpuModel
 from ..dataframe import DataFrame, as_dataframe
 from ..params import (
@@ -41,7 +56,15 @@ from ..params import (
 )
 from ..parallel.mesh import get_mesh
 
-_ALGO_PARAM_KEYS = {"nlist", "nprobe"}
+# per-algorithm algoParams surfaces (a typo'd key is a hard error, never a
+# silent default); the PQ keys follow the upstream cuML names
+_ALGO_PARAM_KEYS = {
+    "ivfflat": {"nlist", "nprobe"},
+    "ivfpq": {
+        "nlist", "nprobe", "M", "n_bits", "usePrecomputedTables",
+        "refine_ratio",
+    },
+}
 
 
 class ApproximateNearestNeighborsClass(_TpuParams):
@@ -64,8 +87,8 @@ class _ApproximateNearestNeighborsParams(
 ):
     k = Param(_dummy(), "k", "the number of nearest neighbors to retrieve (> 0)", TypeConverters.toInt)
     idCol = Param(_dummy(), "idCol", "id column name; if unset a monotonically increasing id column is generated", TypeConverters.toString)
-    algorithm = Param(_dummy(), "algorithm", "the ANN algorithm (only 'ivfflat' is supported)", TypeConverters.toString)
-    algoParams = Param(_dummy(), "algoParams", "algorithm parameters: {'nlist': coarse lists, 'nprobe': probed lists per query}", TypeConverters.identity)
+    algorithm = Param(_dummy(), "algorithm", "the ANN algorithm: 'ivfflat' (raw f32 lists) or 'ivfpq' (product-quantized lists)", TypeConverters.toString)
+    algoParams = Param(_dummy(), "algoParams", "algorithm parameters: {'nlist', 'nprobe'} (both tiers) plus, for ivfpq, {'M': subspaces, 'n_bits': bits per code, 'refine_ratio': f32 re-score factor, 'usePrecomputedTables': ignored}", TypeConverters.identity)
     exactSearch = Param(_dummy(), "exactSearch", "route kneighbors through the exact brute-force engine over the indexed items (recall escape hatch)", TypeConverters.toBoolean)
 
     def __init__(self, *args: Any, **kwargs: Any) -> None:
@@ -112,17 +135,23 @@ class _ApproximateNearestNeighborsParams(
             self._set_params(featuresCols=value)
         return self
 
+    def _validated_algo_params(self) -> Dict[str, Any]:
+        algo = self.getAlgorithm()
+        ap = dict(self.getAlgoParams() or {})
+        known = _ALGO_PARAM_KEYS[algo]
+        unknown = set(ap) - known
+        if unknown:
+            raise ValueError(
+                f"unknown algoParams {sorted(unknown)} for algorithm "
+                f"{algo!r}; supported: {sorted(known)}"
+            )
+        return ap
+
     def _resolved_algo_params(self, n_items: int, n_lists: int = None) -> Tuple[int, int]:
         """(nlist, nprobe) with the documented defaults (ann/ivfflat
         default_nlist/default_nprobe) filling unset keys; unknown keys are
         a hard error (a typo'd 'nprobes' must not silently probe 1/4)."""
-        ap = dict(self.getAlgoParams() or {})
-        unknown = set(ap) - _ALGO_PARAM_KEYS
-        if unknown:
-            raise ValueError(
-                f"unknown algoParams {sorted(unknown)}; supported: "
-                f"{sorted(_ALGO_PARAM_KEYS)}"
-            )
+        ap = self._validated_algo_params()
         nlist = int(ap.get("nlist", n_lists or default_nlist(n_items)))
         nprobe = int(ap.get("nprobe", default_nprobe(nlist)))
         if nlist < 1 or nprobe < 1:
@@ -131,11 +160,39 @@ class _ApproximateNearestNeighborsParams(
             )
         return nlist, nprobe
 
+    def _resolved_pq_params(
+        self, dim: int, warn: bool = False
+    ) -> Tuple[int, int, int]:
+        """(M, n_bits, refine_ratio) for algorithm='ivfpq' with the
+        documented defaults (ann/pq default_m_sub, 8 bits, refine x4).
+        usePrecomputedTables is accepted for upstream compatibility but
+        IGNORED with a warning (once, at fit): the ADC formulation folds
+        the list-dependent table term into the packed per-item scalar, so
+        there is no separate precomputed-table mode to toggle."""
+        ap = self._validated_algo_params()
+        if warn and "usePrecomputedTables" in ap:
+            warnings.warn(
+                "algoParams['usePrecomputedTables'] is ignored: the IVF-PQ "
+                "engine always folds the list-dependent ADC term into the "
+                "packed per-item scalar (docs/ann_engine.md#ivf-pq)",
+                stacklevel=3,
+            )
+        m = int(ap.get("M", default_m_sub(dim)))
+        n_bits = int(ap.get("n_bits", DEFAULT_N_BITS))
+        ratio = int(ap.get("refine_ratio", DEFAULT_REFINE_RATIO))
+        if m < 1:
+            raise ValueError(f"M ({m}) must be >= 1")
+        if not 1 <= n_bits <= 8:
+            raise ValueError(f"n_bits ({n_bits}) must be in [1, 8]")
+        if ratio < 0:
+            raise ValueError(f"refine_ratio ({ratio}) must be >= 0")
+        return m, n_bits, ratio
+
     def _check_algorithm(self) -> None:
-        if self.getAlgorithm() != "ivfflat":
+        if self.getAlgorithm() not in _ALGO_PARAM_KEYS:
             raise ValueError(
-                f"algorithm={self.getAlgorithm()!r} is not supported; only "
-                "'ivfflat' is implemented (the first ANN tier)"
+                f"algorithm={self.getAlgorithm()!r} is not supported; "
+                f"implemented tiers: {sorted(_ALGO_PARAM_KEYS)}"
             )
 
 
@@ -189,17 +246,39 @@ class ApproximateNearestNeighbors(
         X = np.concatenate(feats) if len(feats) > 1 else feats[0]
         item_ids = np.concatenate(ids) if len(ids) > 1 else ids[0]
         nlist, _nprobe = self._resolved_algo_params(X.shape[0])
-        packed = build_ivfflat_packed(X, item_ids, nlist, seed=0)
-        model = ApproximateNearestNeighborsModel(
-            centroids_=packed.centroids,
-            packed_items_=packed.items,
-            packed_ids_=packed.ids,
-            list_counts_=packed.counts,
-            n_lists=packed.n_lists,
-            n_items=packed.n_items,
-            n_cols=int(X.shape[1]),
-            dtype="float32",
-        )
+        if self.getAlgorithm() == "ivfpq":
+            m_sub, n_bits, _ratio = self._resolved_pq_params(
+                int(X.shape[1]), warn=True
+            )
+            pq = build_ivfpq_packed(
+                X, item_ids, nlist, m_sub=m_sub, n_bits=n_bits, seed=0
+            )
+            model = ApproximateNearestNeighborsModel(
+                centroids_=pq.centroids,
+                packed_items_=pq.items,
+                packed_ids_=pq.ids,
+                list_counts_=pq.counts,
+                n_lists=pq.n_lists,
+                n_items=pq.n_items,
+                n_cols=int(X.shape[1]),
+                dtype="float32",
+                pq_codes_=pq.codes,
+                pq_scalars_=pq.scalars,
+                pq_codebooks_=pq.codebooks,
+                pq_n_bits=pq.n_bits,
+            )
+        else:
+            packed = build_ivfflat_packed(X, item_ids, nlist, seed=0)
+            model = ApproximateNearestNeighborsModel(
+                centroids_=packed.centroids,
+                packed_items_=packed.items,
+                packed_ids_=packed.ids,
+                list_counts_=packed.counts,
+                n_lists=packed.n_lists,
+                n_items=packed.n_items,
+                n_cols=int(X.shape[1]),
+                dtype="float32",
+            )
         self._copyValues(model)
         model._tpu_params.update(self._tpu_params)
         model._num_workers = self._num_workers
@@ -236,6 +315,10 @@ class ApproximateNearestNeighborsModel(
         n_items: int,
         n_cols: int,
         dtype: str = "float32",
+        pq_codes_: Optional[np.ndarray] = None,
+        pq_scalars_: Optional[np.ndarray] = None,
+        pq_codebooks_: Optional[np.ndarray] = None,
+        pq_n_bits: Optional[int] = None,
     ) -> None:
         super().__init__(
             centroids_=np.asarray(centroids_),
@@ -246,6 +329,12 @@ class ApproximateNearestNeighborsModel(
             n_items=int(n_items),
             n_cols=int(n_cols),
             dtype=str(dtype),
+            pq_codes_=None if pq_codes_ is None else np.asarray(pq_codes_),
+            pq_scalars_=None if pq_scalars_ is None else np.asarray(pq_scalars_),
+            pq_codebooks_=None
+            if pq_codebooks_ is None
+            else np.asarray(pq_codebooks_),
+            pq_n_bits=None if pq_n_bits is None else int(pq_n_bits),
         )
         self.centroids_ = np.asarray(centroids_, np.float32)
         self.packed_items_ = np.asarray(packed_items_, np.float32)
@@ -255,11 +344,25 @@ class ApproximateNearestNeighborsModel(
         self.n_items = int(n_items)
         self.n_cols = int(n_cols)
         self.dtype = str(dtype)
+        # the PQ tier's extra payload (None on an ivfflat model): one-byte
+        # codes, ADC item scalars, and the subspace codebooks — together
+        # with the shared list layout they form the PackedPQ
+        self.pq_codes_ = None if pq_codes_ is None else np.asarray(
+            pq_codes_, np.uint8
+        )
+        self.pq_scalars_ = None if pq_scalars_ is None else np.asarray(
+            pq_scalars_, np.float32
+        )
+        self.pq_codebooks_ = None if pq_codebooks_ is None else np.asarray(
+            pq_codebooks_, np.float32
+        )
+        self.pq_n_bits = None if pq_n_bits is None else int(pq_n_bits)
         self._item_df: Optional[DataFrame] = None
         # per-mesh staging caches (die with the model, like the exact
-        # model's _staged_items): the probed index and the exactSearch
-        # prepared item set
+        # model's _staged_items): the probed index (flat or pq) and the
+        # exactSearch prepared item set
         self._staged_index: Optional[Tuple[Any, Any]] = None
+        self._staged_pq: Optional[Tuple[Any, Any]] = None
         self._staged_exact: Optional[Tuple[Any, Any]] = None
 
     def _packed(self) -> PackedIVF:
@@ -270,6 +373,27 @@ class ApproximateNearestNeighborsModel(
             self.centroids_,
             self.n_lists,
             self.n_items,
+        )
+
+    def _packed_pq(self) -> PackedPQ:
+        if self.pq_codes_ is None:
+            raise ValueError(
+                "this model was fit with algorithm='ivfflat'; it carries no "
+                "PQ payload — refit with algorithm='ivfpq'"
+            )
+        return PackedPQ(
+            self.pq_codes_,
+            self.pq_scalars_,
+            self.packed_ids_,
+            self.packed_items_,
+            self.list_counts_,
+            self.centroids_,
+            self.pq_codebooks_,
+            self.n_lists,
+            self.n_items,
+            self.n_cols,
+            self.pq_codes_.shape[1],
+            self.pq_n_bits,
         )
 
     def _mesh_key(self, mesh) -> Tuple:
@@ -284,6 +408,14 @@ class ApproximateNearestNeighborsModel(
         if self._staged_index is None or self._staged_index[0] != key:
             self._staged_index = (key, index_from_packed(self._packed(), mesh))
         return self._staged_index[1]
+
+    def _ensure_staged_pq(self, mesh):
+        key = self._mesh_key(mesh)
+        if self._staged_pq is None or self._staged_pq[0] != key:
+            self._staged_pq = (
+                key, index_from_packed_pq(self._packed_pq(), mesh)
+            )
+        return self._staged_pq[1]
 
     def _ensure_staged_exact(self, mesh):
         from ..ops.knn import prepare_items
@@ -326,10 +458,14 @@ class ApproximateNearestNeighborsModel(
             self.n_items, n_lists=self.n_lists
         )
         exact = self.getExactSearch()
+        pq = not exact and self.getAlgorithm() == "ivfpq"
         if exact:
             from ..ops.knn import knn_search_prepared
 
             prepared = self._ensure_staged_exact(mesh)
+        elif pq:
+            index = self._ensure_staged_pq(mesh)
+            _m, _b, refine_ratio = self._resolved_pq_params(self.n_cols)
         else:
             index = self._ensure_staged_index(mesh)
         from .. import profiling
@@ -349,6 +485,14 @@ class ApproximateNearestNeighborsModel(
                 )
                 if exact:
                     dists, ids = knn_search_prepared(prepared, feats, k, mesh)
+                elif pq:
+                    dists, ids = ivfpq_search_prepared(
+                        index, feats, k, nprobe, mesh,
+                        refine_items=(
+                            self.packed_items_ if refine_ratio > 1 else None
+                        ),
+                        refine_ratio=refine_ratio,
+                    )
                 else:
                     dists, ids = ivfflat_search_prepared(
                         index, feats, k, nprobe, mesh
@@ -372,35 +516,79 @@ class ApproximateNearestNeighborsModel(
 
     def _serving_entry(self, mesh: Any = None):
         """Online ANN hook (serving/): each coalesced batch is ONE probed
-        ivfflat_search_prepared call against the staged index; warm submits
-        the probe-kernel geometry for every engine bucket (the engine's
-        pow2 buckets feed the search's own >=64 query-block rule, same
-        contract as the exact kNN entry)."""
+        search (flat or PQ per the algorithm param) against the staged
+        index; warm submits the probe-kernel geometry for every engine
+        bucket (the engine's pow2 buckets feed the search's own >=64
+        query-block rule, same contract as the exact kNN entry) — served
+        steady state performs zero new compilations on BOTH tiers."""
         from ..serving.entry import ServingEntry
 
         self._check_algorithm()
         mesh = mesh or get_mesh(self.num_workers)
-        index = self._ensure_staged_index(mesh)
+        pq = self.getAlgorithm() == "ivfpq"
         k = self.getK()
         _nlist, nprobe = self._resolved_algo_params(
             self.n_items, n_lists=self.n_lists
         )
         dtype = np.dtype(np.float32)
+        info = {
+            "k": int(min(k, self.n_items)),
+            "n_items": int(self.n_items),
+            "nlist": int(self.n_lists),
+            "nprobe": int(nprobe),
+            "algorithm": self.getAlgorithm(),
+        }
+        if pq:
+            index = self._ensure_staged_pq(mesh)
+            _m, _b, refine_ratio = self._resolved_pq_params(self.n_cols)
+            refine_items = (
+                self.packed_items_ if refine_ratio > 1 else None
+            )
+            info["m_sub"] = int(index.m_sub)
+            info["n_bits"] = int(index.n_bits)
+            info["refine_ratio"] = int(refine_ratio)
 
-        def call(batch: np.ndarray) -> Dict[str, np.ndarray]:
-            dists, ids = ivfflat_search_prepared(index, batch, k, nprobe, mesh)
-            return {
-                "indices": np.asarray(ids),
-                "distances": np.asarray(dists, dtype=np.float32),
-            }
-
-        def warm(buckets) -> list:
-            keys = []
-            for b in sorted({max(int(x), 64) for x in buckets}):
-                keys.extend(
-                    warm_probe_kernels(index, k, nprobe, mesh, n_queries=b)
+            def call(batch: np.ndarray) -> Dict[str, np.ndarray]:
+                dists, ids = ivfpq_search_prepared(
+                    index, batch, k, nprobe, mesh,
+                    refine_items=refine_items, refine_ratio=refine_ratio,
                 )
-            return keys
+                return {
+                    "indices": np.asarray(ids),
+                    "distances": np.asarray(dists, dtype=np.float32),
+                }
+
+            def warm(buckets) -> list:
+                keys = []
+                for b in sorted({max(int(x), 64) for x in buckets}):
+                    keys.extend(
+                        warm_pq_probe_kernels(
+                            index, k, nprobe, mesh, n_queries=b,
+                            refine=refine_items is not None,
+                            refine_ratio=refine_ratio,
+                        )
+                    )
+                return keys
+
+        else:
+            index = self._ensure_staged_index(mesh)
+
+            def call(batch: np.ndarray) -> Dict[str, np.ndarray]:
+                dists, ids = ivfflat_search_prepared(
+                    index, batch, k, nprobe, mesh
+                )
+                return {
+                    "indices": np.asarray(ids),
+                    "distances": np.asarray(dists, dtype=np.float32),
+                }
+
+            def warm(buckets) -> list:
+                keys = []
+                for b in sorted({max(int(x), 64) for x in buckets}):
+                    keys.extend(
+                        warm_probe_kernels(index, k, nprobe, mesh, n_queries=b)
+                    )
+                return keys
 
         return ServingEntry(
             name="serve.ann",
@@ -409,10 +597,19 @@ class ApproximateNearestNeighborsModel(
             out_cols=["indices", "distances"],
             call=call,
             warm=warm,
-            info={
-                "k": int(min(k, self.n_items)),
-                "n_items": int(self.n_items),
-                "nlist": int(self.n_lists),
-                "nprobe": int(nprobe),
-            },
+            info=info,
         )
+
+    def index_bytes_per_item(self, mesh: Any = None) -> float:
+        """Device-resident index bytes per indexed item on this mesh — the
+        flat-vs-PQ compression headline benchmark/bench_approximate_nn.py
+        reports (host-side payloads — ids, the PQ refine f32 vectors — are
+        deliberately excluded: device HBM is the capacity constraint the
+        PQ tier exists to lift)."""
+        self._check_algorithm()
+        mesh = mesh or get_mesh(self.num_workers)
+        if self.getAlgorithm() == "ivfpq":
+            index = self._ensure_staged_pq(mesh)
+        else:
+            index = self._ensure_staged_index(mesh)
+        return index.device_bytes() / max(self.n_items, 1)

@@ -1,0 +1,143 @@
+#
+# Pallas TPU lookup-table accumulation kernel for IVF-PQ ADC search.
+#
+# A new kernel SHAPE for this repo: every earlier Pallas kernel is a fused
+# distance computation (MXU matmul + epilogue).  PQ's asymmetric-distance
+# scan has no matmul at all — per query it reduces to
+#
+#     out[r] = sum_j  T[j, codes[r, j]]          j in [0, m_sub)
+#
+# a gather from a tiny per-query table T (m_sub, ksub) over an int8 code
+# tile.  The table lives in VMEM for the whole row sweep (its block index
+# map ignores the row-tile grid axis), the code tile is the ONLY per-item
+# HBM traffic (m_sub bytes/item vs 4*D for IVF-Flat — the ~32x bandwidth
+# win IS the point of the kernel), and the lookup itself is a
+# compare-select sweep over the ksub table lanes on the VPU: Mosaic has no
+# general vector gather, but `(code == c) ? T[j,c] : 0` summed over c is
+# exact — every row of the compare tile has exactly ONE nonzero, and
+# x + 0.0 == x in f32 — so the select-sum IS the gather, bit for bit
+# (the same trick ops/pallas_tpu._bin_kernel uses for feature binning).
+# MXU-free by construction: the usual TPU alternative (one-hot codes
+# matmul'd against the table) materializes a (rows, m_sub*ksub) one-hot
+# slab, 256x the code bytes, to feed an MXU the scan doesn't need.
+#
+# Layout: everything arrives pre-transposed so stores land along lanes —
+# tables  (B, ksub, m_sub): T[:, j] is a sublane column, broadcast to lanes
+# codes   (B, m_sub, R):    code row j is a lane vector
+# out     (B, R):           one (1, TILE_R) store per grid cell
+# Grid (B, R / TILE_R), table block resident across the R sweep.
+#
+# Accumulation ORDER is part of the contract: the j-loop is a static
+# unroll, so out[r] is the SEQUENTIAL f32 running sum over j=0..m_sub-1 of
+# exactly-gathered table values.  The numpy oracle in tests/test_pq_engine
+# reproduces that order and asserts EXACT equality in interpret mode.
+#
+# CPU / non-TPU fallback: lut_accumulate routes through an identical-math
+# XLA take_along_axis formulation (tier-1 searches ride it; the kernel
+# itself is gated in interpret mode).  Mosaic-compile validation on real
+# hardware is pending — the route keeps the SRML_DISABLE_PALLAS escape
+# hatch shared with the other TPU kernels.
+#
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_tpu import _round_up, pallas_enabled
+
+# rows of the code tile swept per grid cell; the (ksub, TILE_R) f32
+# compare-select tile is the kernel's only big intermediate (512 KB at
+# ksub=256) and the table block is ksub * m_sub * 4 bytes (32 KB at
+# ksub=256, m_sub=32) — VMEM stays far under budget at any supported shape
+_LUT_TILE_R = 512
+
+
+def _lut_accum_kernel(t_ref, c_ref, o_ref, *, m_sub: int):
+    # t_ref (1, ksub, m_sub) f32 — this query's ADC table, grid-resident
+    # c_ref (1, m_sub, TILE_R) int8 — code tile, rows along lanes
+    # o_ref (1, TILE_R) f32
+    ksub = t_ref.shape[1]
+    codes = c_ref[0].astype(jnp.int32)                 # (m_sub, TILE_R)
+    tile_r = codes.shape[1]
+    cls = jax.lax.broadcasted_iota(jnp.int32, (ksub, tile_r), 0)
+    acc = jnp.zeros((1, tile_r), jnp.float32)
+    for j in range(m_sub):
+        # exactly one lane of `eq` is True per row: the masked sublane sum
+        # gathers T[j, code] bit-exactly (x + 0.0 == x)
+        eq = codes[j, :][None, :] == cls               # (ksub, TILE_R)
+        acc = acc + jnp.sum(
+            jnp.where(eq, t_ref[0, :, j][:, None], 0.0),
+            axis=0,
+            keepdims=True,
+        )
+    o_ref[:] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _lut_accumulate_pallas(
+    tables: jax.Array,  # (B, m_sub, ksub) f32
+    codes: jax.Array,   # (B, R, m_sub) uint8
+    interpret: bool = False,
+) -> jax.Array:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, m_sub, ksub = tables.shape
+    r = codes.shape[1]
+    r_pad = _round_up(max(r, 1), _LUT_TILE_R)
+    # pre-transpose into the lane-major layouts documented above; pad rows
+    # carry code 0 (a valid table column — the result is sliced off)
+    t_t = jnp.swapaxes(tables, 1, 2)                   # (B, ksub, m_sub)
+    c_t = jnp.swapaxes(codes, 1, 2)                    # (B, m_sub, R)
+    if r_pad != r:
+        c_t = jnp.pad(c_t, ((0, 0), (0, 0), (0, r_pad - r)))
+    out = pl.pallas_call(
+        functools.partial(_lut_accum_kernel, m_sub=m_sub),
+        grid=(b, r_pad // _LUT_TILE_R),
+        in_specs=[
+            pl.BlockSpec(
+                (1, ksub, m_sub), lambda qi, ri: (qi, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, m_sub, _LUT_TILE_R), lambda qi, ri: (qi, 0, ri),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _LUT_TILE_R), lambda qi, ri: (qi, ri),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, r_pad), jnp.float32),
+        interpret=interpret,
+    )(t_t, c_t)
+    return out[:, :r]
+
+
+def _lut_accumulate_xla(tables: jax.Array, codes: jax.Array) -> jax.Array:
+    """Identical-math XLA formulation: gather every subspace's table value
+    (take_along_axis over the ksub axis), reduce over m_sub.  Same
+    fixed-shape per-item reduction on every mesh size — the bitwise
+    mesh-parity basis for the CPU/tier-1 route."""
+    idx = jnp.swapaxes(codes, 1, 2).astype(jnp.int32)  # (B, m_sub, R)
+    gathered = jnp.take_along_axis(tables, idx, axis=2)
+    return jnp.sum(gathered, axis=1)                   # (B, R)
+
+
+def lut_accumulate(
+    tables: jax.Array,  # (B, m_sub, ksub) f32 per-query ADC tables
+    codes: jax.Array,   # (B, R, m_sub) uint8 gathered candidate codes
+    interpret: bool = False,
+) -> jax.Array:
+    """ADC lookup-table accumulation: out[b, r] = sum_j tables[b, j,
+    codes[b, r, j]].  Pallas on TPU (or interpret=True for tests), the
+    identical-math XLA gather elsewhere — same routing contract as
+    ops/pallas_tpu.min_dist_argmin.  Code values must lie in [0, ksub)
+    (the PQ encoder guarantees it; out-of-range values contribute 0 on the
+    pallas route and clamp on the XLA route — both masked upstream)."""
+    if interpret or pallas_enabled():
+        return _lut_accumulate_pallas(tables, codes, interpret=interpret)
+    return _lut_accumulate_xla(tables, codes)

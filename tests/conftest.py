@@ -103,7 +103,7 @@ def armed_faults(monkeypatch):
 def model_zoo():
     """Lazily-fitted tiny models over one shared dataset, keyed by arm name
     ("kmeans", "pca", "linreg", "logreg", "rf_clf", "rf_reg", "umap",
-    "knn", "ann").  Returns a factory: model_zoo(name) -> (model, X) with X the
+    "knn", "ann", "ivfpq").  Returns a factory: model_zoo(name) -> (model, X) with X the
     float32 feature matrix the model was fit on.  Session-scoped and cached
     so the persistence matrix and the serving tests share ONE fit per
     class instead of re-fitting per test."""
@@ -161,6 +161,15 @@ def model_zoo():
             # equivalence gates are deterministic AND recall-1.0 vs exact
             return ApproximateNearestNeighbors(
                 k=4, algoParams={"nlist": 4, "nprobe": 4}
+            ).setFeaturesCol("features").fit(df)
+        if name == "ivfpq":
+            # the PQ tier at tiny geometry (2 subspaces x 16 codewords,
+            # every list probed + refine): deterministic end to end, so the
+            # serving/persistence gates hold bit-exactly like the flat arm
+            return ApproximateNearestNeighbors(
+                k=4,
+                algorithm="ivfpq",
+                algoParams={"nlist": 4, "nprobe": 4, "M": 2, "n_bits": 4},
             ).setFeaturesCol("features").fit(df)
         raise KeyError(name)
 

@@ -266,7 +266,7 @@ def test_model_param_validation():
             algoParams={"nprobes": 3}
         ).setFeaturesCol("features").fit(df)
     with pytest.raises(ValueError, match="not supported"):
-        ApproximateNearestNeighbors(algorithm="ivfpq").setFeaturesCol(
+        ApproximateNearestNeighbors(algorithm="hnsw").setFeaturesCol(
             "features"
         ).fit(df)
     est = ApproximateNearestNeighbors(k=3)

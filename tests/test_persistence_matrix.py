@@ -55,18 +55,21 @@ def test_save_load_transform_equivalence(arm, model_zoo, tmp_path):
         ), f"{arm}: column {col!r} changed across save/load"
 
 
-def test_ann_save_load_kneighbors_equivalence(model_zoo, tmp_path):
-    """The ANN model has no transform — its persistence gate is
+@pytest.mark.parametrize("arm", ["ann", "ivfpq"])
+def test_ann_save_load_kneighbors_equivalence(arm, model_zoo, tmp_path):
+    """The ANN models have no transform — their persistence gate is
     save -> load -> kneighbors BIT-IDENTICAL to the in-memory model (the
-    packed index layout is mesh-independent data, and the probed search is
-    deterministic, so exact equality is the right bar here too)."""
-    model, X = model_zoo("ann")
-    path = str(tmp_path / "ann")
+    packed index layout — raw lists for ivfflat, codes + ADC scalars +
+    codebooks for ivfpq — is mesh-independent data, and the probed search
+    is deterministic, so exact equality is the right bar here too)."""
+    model, X = model_zoo(arm)
+    path = str(tmp_path / arm)
     model.save(path)
     loaded = core_load(path)
     assert type(loaded) is type(model)
     assert loaded.getK() == model.getK()
     assert loaded.getAlgoParams() == model.getAlgoParams()
+    assert loaded.getAlgorithm() == model.getAlgorithm()
     qdf = DataFrame.from_numpy(X[:20], num_partitions=2)
     _, _, before = model.kneighbors(qdf)
     _, _, after = loaded.kneighbors(qdf)
@@ -77,7 +80,29 @@ def test_ann_save_load_kneighbors_equivalence(model_zoo, tmp_path):
         a = np.concatenate(
             [np.asarray(list(p[col])) for p in after.partitions if len(p)]
         )
-        assert np.array_equal(a, b), f"ann: column {col!r} changed across save/load"
+        assert np.array_equal(a, b), f"{arm}: column {col!r} changed across save/load"
+    if arm == "ivfpq":
+        # across mesh SHAPES too: the loaded payload staged on a 1-device
+        # mesh must answer bit-identically to the default (8-device) mesh —
+        # the engine parity gate re-asserted through the persisted artifact
+        from spark_rapids_ml_tpu.ann.pq import (
+            index_from_packed_pq,
+            ivfpq_search_prepared,
+        )
+        from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+        packed = loaded._packed_pq()
+        out = {}
+        for tag, mesh in (("one", get_mesh(1)), ("all", get_mesh())):
+            idx = index_from_packed_pq(packed, mesh)
+            out[tag] = ivfpq_search_prepared(
+                idx, X[:16], 4, 4, mesh,
+                refine_items=packed.items, refine_ratio=4,
+            )
+        np.testing.assert_array_equal(out["one"][1], out["all"][1])
+        np.testing.assert_array_equal(
+            out["one"][0].view(np.uint32), out["all"][0].view(np.uint32)
+        )
 
 
 # -- hot-swap persistence semantics (srml-router, docs/serving.md §router) ---

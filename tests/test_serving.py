@@ -481,6 +481,27 @@ def test_served_ann_matches_probed_search(model_zoo):
         srv.assert_steady_state()
 
 
+def test_served_ivfpq_matches_probed_search(model_zoo):
+    """Served IVF-PQ == batch probed+refined search (the srml-pq serving
+    gate): the online entry answers from the same staged code index,
+    cached probe executables, and host refine the batch kneighbors path
+    uses — ids exactly equal, steady state zero new compiles."""
+    model, X = model_zoo("ivfpq")
+    _, _, knn_df = model.kneighbors(
+        __import__("spark_rapids_ml_tpu.dataframe", fromlist=["DataFrame"])
+        .DataFrame.from_numpy(X[:8], num_partitions=1)
+    )
+    expect_ids = np.asarray(list(knn_df.partitions[0]["indices"]))
+    expect_d = np.asarray(list(knn_df.partitions[0]["distances"]))
+    with ModelServer("eq_ivfpq", model, max_batch=32, max_wait_ms=2) as srv:
+        assert srv._entry.info["algorithm"] == "ivfpq"
+        got = srv.predict(X[:8])
+        assert np.array_equal(got["indices"], expect_ids)
+        np.testing.assert_allclose(got["distances"], expect_d, rtol=1e-5, atol=1e-5)
+        srv.drain()
+        srv.assert_steady_state()
+
+
 def test_served_knn_matches_kneighbors(model_zoo):
     model, X = model_zoo("knn")
     _, _, knn_df = model.kneighbors(
