@@ -1,0 +1,203 @@
+#
+# srml-stream benchmark: streaming ingest throughput vs the batch refit it
+# replaces, plus the serving blip a live refresh() costs.
+#
+#   python -m benchmark.bench_streaming --algos linreg,kmeans --rows 40000 \
+#       --cols 64 --chunk_rows 2048 --report_path out.jsonl
+#
+# Three numbers per algo arm:
+#   rows_per_sec       steady-state partial_fit ingest rate (timed window
+#                      starts AFTER the first chunk so the one bucket
+#                      compile lands in warm-up; the window gates
+#                      repeat_new_compiles == 0 — the zero-compile steady
+#                      ingest contract)
+#   batch_refit_sec    one full batch fit over the same accumulated rows —
+#                      the cost a non-streaming system pays per model
+#                      refresh, and the denominator of refresh_speedup
+#                      (incremental refresh cost = finalize, not re-ingest)
+#   refresh_p99_ms     client-observed p99 latency before / during / after
+#                      a StreamingSession.refresh() through a serving
+#                      registry under paced load, with refresh_errors
+#                      required zero (the PR 11 swap guarantees driven by
+#                      the streaming plane)
+#
+
+from __future__ import annotations
+
+import argparse
+import pprint
+import statistics
+import threading
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from spark_rapids_ml_tpu import profiling
+
+from .utils import append_report, with_benchmark
+
+
+def _build(algo: str, rows: int, cols: int, seed: int = 42):
+    """(estimator factory, X, y) for one algo arm."""
+    from spark_rapids_ml_tpu import KMeans, LinearRegression
+
+    rng = np.random.default_rng(seed)
+    if algo == "linreg":
+        X = rng.standard_normal((rows, cols)).astype(np.float32)
+        coef = rng.standard_normal(cols).astype(np.float32)
+        y = (X @ coef + 0.1 * rng.standard_normal(rows)).astype(np.float64)
+        return lambda: LinearRegression(standardization=False), X, y
+    if algo == "kmeans":
+        k = 16
+        centers = rng.standard_normal((k, cols)).astype(np.float32) * 4
+        X = (
+            centers[rng.integers(0, k, rows)]
+            + rng.standard_normal((rows, cols)).astype(np.float32)
+        ).astype(np.float32)
+        return (
+            lambda: KMeans(k=k, maxIter=10, seed=1).setFeaturesCol("features"),
+            X,
+            None,
+        )
+    raise SystemExit(f"unknown algo {algo!r} (use linreg,kmeans)")
+
+
+def _percentile_ms(samples: List[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    return round(float(np.percentile(np.asarray(samples), q)) * 1e3, 3)
+
+
+def run_arm(algo: str, args) -> Dict[str, Any]:
+    from spark_rapids_ml_tpu.dataframe import DataFrame
+
+    make_est, X, y = _build(algo, args.rows, args.cols)
+    chunks = [
+        (X[s : s + args.chunk_rows],
+         None if y is None else y[s : s + args.chunk_rows])
+        for s in range(0, args.rows, args.chunk_rows)
+    ]
+    record: Dict[str, Any] = {
+        "algo": algo,
+        "metric": "streaming_ingest_rows_per_sec",
+        "rows": args.rows,
+        "cols": args.cols,
+        "chunk_rows": args.chunk_rows,
+        "chunks": len(chunks),
+    }
+
+    # -- steady-state ingest rate (warm-up = first chunk: bucket compile) --
+    eng = make_est().streaming()
+    c0 = profiling.counters("stream.")
+    Xc, yc = chunks[0]
+    with_benchmark(f"{algo} stream warm-up chunk", lambda: eng.partial_fit(Xc, y=yc))
+    before = profiling.counters("precompile.")
+    t0 = time.perf_counter()
+    for Xc, yc in chunks[1:]:
+        eng.partial_fit(Xc, y=yc)
+    ingest_sec = time.perf_counter() - t0
+    delta = profiling.counter_deltas(before, "precompile.")
+    timed_rows = sum(len(c[0]) for c in chunks[1:])
+    record["ingest_sec"] = round(ingest_sec, 4)
+    record["rows_per_sec"] = round(timed_rows / max(ingest_sec, 1e-9), 1)
+    record["repeat_new_compiles"] = int(
+        delta.get("precompile.compile", 0) + delta.get("precompile.fallback", 0)
+    )
+    record["counters"] = profiling.counter_deltas(c0, "stream.")
+
+    # -- the refresh itself (finalize) vs a full batch refit ---------------
+    _, finalize_sec = with_benchmark(f"{algo} finalize", eng.finalize)
+    record["finalize_sec"] = round(finalize_sec, 4)
+    if y is None:
+        df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=4)
+    else:
+        df = DataFrame.from_numpy(X, y=y, num_partitions=4)
+    est = make_est()
+    with_benchmark(f"{algo} batch warm-up fit", lambda: est.fit(df))
+    _, refit_sec = with_benchmark(f"{algo} batch refit", lambda: est.fit(df))
+    record["batch_refit_sec"] = round(refit_sec, 4)
+    record["refresh_speedup"] = round(refit_sec / max(finalize_sec, 1e-9), 2)
+
+    # -- refresh blip under serving load -----------------------------------
+    from spark_rapids_ml_tpu.serving import ModelRegistry
+    from spark_rapids_ml_tpu.stream import StreamingSession
+
+    eng2 = make_est().streaming()
+    eng2.partial_fit(chunks[0][0], y=chunks[0][1])
+    reg = ModelRegistry(max_batch=64, max_wait_ms=2)
+    errors: List[BaseException] = []
+    phases: Dict[str, List[float]] = {"before": [], "during": [], "after": []}
+    try:
+        session = StreamingSession(eng2, name=f"bench_{algo}", registry=reg)
+        session.refresh()
+        server = reg.get(f"bench_{algo}")
+        q = X[:8]
+
+        def measure(phase: str, n: int, stop_when=None):
+            i = 0
+            while (i < n) if stop_when is None else not stop_when.is_set():
+                t = time.perf_counter()
+                try:
+                    server = reg.get(f"bench_{algo}")
+                    server.predict(q)
+                    phases[phase].append(time.perf_counter() - t)
+                except BaseException as exc:  # noqa: BLE001 - the gate counts these
+                    errors.append(exc)
+                i += 1
+
+        measure("before", args.blip_requests)
+        eng2.partial_fit(chunks[-1][0], y=chunks[-1][1])
+        done = threading.Event()
+
+        def do_refresh():
+            try:
+                session.refresh()
+            finally:
+                done.set()
+
+        t = threading.Thread(target=do_refresh, name="srml-bench-refresh")
+        t.start()
+        measure("during", 0, stop_when=done)
+        t.join()
+        measure("after", args.blip_requests)
+    finally:
+        reg.shutdown(drain=False)
+    record["refresh_errors"] = len(errors)
+    for phase, samples in phases.items():
+        record[f"p99_{phase}_ms"] = _percentile_ms(samples, 99)
+        record[f"p50_{phase}_ms"] = _percentile_ms(samples, 50)
+    record["refreshes"] = session.stats()["refreshes"]
+    return record
+
+
+def main(argv: List[str] = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="python -m benchmark.bench_streaming",
+        description="streaming ingest throughput, refresh cost, serving blip",
+    )
+    parser.add_argument("--algos", default="linreg,kmeans")
+    parser.add_argument("--rows", type=int, default=40_000)
+    parser.add_argument("--cols", type=int, default=64)
+    parser.add_argument("--chunk_rows", type=int, default=2048)
+    parser.add_argument("--blip_requests", type=int, default=50)
+    parser.add_argument("--report_path", default="")
+    args = parser.parse_args(argv)
+    for algo in args.algos.split(","):
+        record = run_arm(algo.strip(), args)
+        print("-" * 88)
+        pprint.pprint(record)
+        print(
+            f"{algo}: {record['rows_per_sec']} rows/s ingest, refresh "
+            f"{record['finalize_sec']}s vs batch refit "
+            f"{record['batch_refit_sec']}s ({record['refresh_speedup']}x), "
+            f"refresh p99 {record['p99_during_ms']}ms "
+            f"(before {record['p99_before_ms']}ms), "
+            f"errors={record['refresh_errors']}, "
+            f"repeat_new_compiles={record['repeat_new_compiles']}"
+        )
+        append_report(args.report_path, record)
+
+
+if __name__ == "__main__":
+    main()

@@ -557,6 +557,55 @@ assert ratio >= 8.0, (flat["index_bytes_per_item"], pq["index_bytes_per_item"])
 EOF
 rm -rf "$PQ_SMOKE"
 
+# 3o. srml-stream gates (also inside the full suite; re-asserted by name
+#     so marker drift can never silently drop them — docs/streaming.md):
+#     - streamed==batch EQUALITY: partial_fit over chunks vs batch fit on
+#       the union — BITWISE for linreg coefficients and sign-canonicalized
+#       PCA components on the exact-arithmetic data family, inertia-/
+#       accuracy-gated for the online kmeans/logreg approximations,
+#       against 1/2/8-device batch meshes
+#     - ZERO-COMPILE steady ingest (same-bucket chunks after the first
+#       move aot_hit, never precompile.compile)
+#     - live IVF mutation: recall@10 >= 0.95 across an add/delete/repack
+#       sequence (incl. through serve.ann and a warm-covered overflow
+#       repack with zero steady-state compiles)
+#     - train-while-serve: StreamingSession.refresh() through the router
+#       under concurrent load — zero client-visible errors, zero new
+#       compiles at the same-shape cut-over
+#     plus graftlint over stream/ + the touched modules by name, and a
+#     bench_streaming smoke asserting steady ingest with zero new
+#     compiles and a zero-error refresh blip.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_streaming.py -q
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_streaming.py -q \
+    -k "bitwise_equals_batch or inertia_quality or metric_quality or steady_ingest_zero or add_delete_repack_recall or overflow_repack or served_ann_absorbs or refresh_under_router_load"
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_persistence_matrix.py -q -k "streamed"
+python -m tools.graftlint spark_rapids_ml_tpu/stream \
+    spark_rapids_ml_tpu/ann spark_rapids_ml_tpu/ops/linalg.py \
+    spark_rapids_ml_tpu/ops/glm.py spark_rapids_ml_tpu/ops/kmeans.py \
+    spark_rapids_ml_tpu/ops/logistic.py spark_rapids_ml_tpu/dataframe.py \
+    spark_rapids_ml_tpu/models/approximate_nn.py \
+    benchmark/bench_streaming.py
+STREAM_SMOKE=$(mktemp -d)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.bench_streaming --algos linreg,kmeans \
+    --rows 8000 --cols 32 --chunk_rows 1024 --blip_requests 20 \
+    --report_path "$STREAM_SMOKE/stream.jsonl"
+python - "$STREAM_SMOKE/stream.jsonl" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1])]
+assert {r["algo"] for r in recs} == {"linreg", "kmeans"}, recs
+for r in recs:
+    assert r["rows_per_sec"] > 0, r
+    assert r["repeat_new_compiles"] == 0, r      # zero-compile steady ingest
+    assert r["refresh_errors"] == 0, r           # zero-error refresh blip
+    assert r["refreshes"] == 2 and r["p99_before_ms"] > 0, r
+    assert r["counters"].get("stream.rows", 0) == r["rows"], r
+EOF
+rm -rf "$STREAM_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

@@ -29,6 +29,8 @@ __all__ = [
     "CrossValidator",
     "Pipeline",
     "PipelineModel",
+    "StreamingSession",
+    "streaming_fit",
 ]
 
 
@@ -57,6 +59,8 @@ def __getattr__(name):  # lazy re-exports keep `import spark_rapids_ml_tpu` ligh
         "CrossValidator": ".tuning",
         "Pipeline": ".pipeline",
         "PipelineModel": ".pipeline",
+        "StreamingSession": ".stream",
+        "streaming_fit": ".stream",
     }
     if name in _locations:
         try:

@@ -17,6 +17,10 @@
 # (ops/pallas_pq), and recall is recovered by re-scoring top candidates
 # against the host-side f32 payload.
 #
+# Live mutation (mutable.py, srml-stream): add/delete/repack on a SERVING
+# IVF-Flat index — append slots inside the pow2 geometry, per-list
+# tombstone bitmaps, warm-before-swap repack to the next slot bucket.
+#
 
 from .ivfflat import (
     IVFFlatIndex,
@@ -29,6 +33,7 @@ from .ivfflat import (
     recall_at_k,
     warm_probe_kernels,
 )
+from .mutable import MutableIVFIndex
 from .pq import (
     IVFPQIndex,
     PackedPQ,
@@ -40,6 +45,7 @@ from .pq import (
 )
 
 __all__ = [
+    "MutableIVFIndex",
     "IVFPQIndex",
     "PackedPQ",
     "build_ivfpq_packed",

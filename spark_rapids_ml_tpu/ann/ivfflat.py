@@ -460,19 +460,39 @@ def build_ivfflat_packed(
     )
 
 
-def index_from_packed(packed: PackedIVF, mesh: Mesh) -> IVFFlatIndex:
-    """Expand a PackedIVF into this mesh's device layout: lists padded to
-    the pow2 slot bucket of the LONGEST list (one static geometry for the
-    whole index — rebuilds at nearby sizes reuse compiled kernels), the
-    list axis padded to a multiple of lcm(8, n_dev) with empty lists, and
-    the (nlist_pad, L_pad, D) buffer row-sharded over DATA_AXIS on the
-    list axis.  User ids stay on the host in int64."""
+def item_norms(data: np.ndarray) -> np.ndarray:
+    """||x||^2 per padded row, host-computed in f64 and stored f32: the
+    norms are index DATA (the same bits on every mesh — and across the
+    live-mutation restages of ann/mutable.py), not per-search math."""
+    return np.einsum(
+        "nd,nd->n", data.astype(np.float64), data.astype(np.float64)
+    ).astype(np.float32)
+
+
+def padded_host_layout(packed: PackedIVF, mesh: Mesh, l_pad: int = None):
+    """Expand a PackedIVF into the padded HOST layout this mesh stages:
+    lists padded to the pow2 slot bucket of the LONGEST list (one static
+    geometry for the whole index — rebuilds at nearby sizes reuse compiled
+    kernels), the list axis padded to a multiple of lcm(8, n_dev) with
+    empty lists.  Returns (data (nlist_pad*l_pad, D), x_norm, ids_pad,
+    counts int64, cpad, c_norm, nlist_pad, l_pad).  `l_pad` may be forced
+    UP (the mutable index's repack-with-headroom path); forcing it below
+    the longest list raises.  Shared by index_from_packed and the live
+    mutation tier (ann/mutable.py), so the two can never disagree on the
+    geometry a probe kernel sees."""
     n_dev = mesh.shape[DATA_AXIS]
     mult = math.lcm(_LIST_ALIGN, n_dev)
     nlist_pad = -(-max(packed.n_lists, 1) // mult) * mult
     counts = np.zeros(nlist_pad, np.int64)
     counts[: packed.counts.shape[0]] = packed.counts
-    l_pad = shape_bucket(int(max(counts.max(), 1)), lo=_MIN_LIST_SLOTS)
+    l_need = shape_bucket(int(max(counts.max(), 1)), lo=_MIN_LIST_SLOTS)
+    if l_pad is None:
+        l_pad = l_need
+    elif l_pad < l_need:
+        raise ValueError(
+            f"l_pad={l_pad} cannot hold the longest list ({counts.max()} "
+            f"items needs {l_need} slots)"
+        )
     if nlist_pad * l_pad > int(_POS_SENTINEL):
         raise ValueError(
             f"IVF layout overflows int32 positions: {nlist_pad} lists x "
@@ -490,15 +510,31 @@ def index_from_packed(packed: PackedIVF, mesh: Mesh) -> IVFFlatIndex:
     ids_pad[flat] = packed.ids
     cpad = np.zeros((nlist_pad, d), np.float32)
     cpad[: packed.n_lists] = packed.centroids
-    # host-computed once in f64, stored f32: the norms are index DATA (the
-    # same bits on every mesh), not per-search math
     c_norm = np.einsum(
         "nd,nd->n", cpad.astype(np.float64), cpad.astype(np.float64)
     ).astype(np.float32)
     c_norm[packed.n_lists :] = np.inf  # pad lists never win a probe slot
-    x_norm = np.einsum(
-        "nd,nd->n", data.astype(np.float64), data.astype(np.float64)
-    ).astype(np.float32)
+    x_norm = item_norms(data)
+    return data, x_norm, ids_pad, counts, cpad, c_norm, nlist_pad, l_pad
+
+
+def stage_padded_layout(
+    data: np.ndarray,
+    x_norm: np.ndarray,
+    ids_pad: np.ndarray,
+    counts: np.ndarray,
+    cpad: np.ndarray,
+    c_norm: np.ndarray,
+    nlist_pad: int,
+    l_pad: int,
+    n_items: int,
+    n_lists: int,
+    mesh: Mesh,
+) -> IVFFlatIndex:
+    """device_put a padded host layout as this mesh's IVFFlatIndex (the
+    staging half of index_from_packed, reused verbatim by every live
+    mutation restage — a plain upload, never a compile)."""
+    d = data.shape[1]
     with profiling.phase("ann.stage", bytes=int(data.nbytes)):
         index = IVFFlatIndex(
             list_data=jax.device_put(
@@ -511,14 +547,26 @@ def index_from_packed(packed: PackedIVF, mesh: Mesh) -> IVFFlatIndex:
             centroids=jax.device_put(cpad, replicated_sharding(mesh)),
             c_norm=jax.device_put(c_norm, replicated_sharding(mesh)),
             ids=ids_pad,
-            n_items=packed.n_items,
-            n_lists=packed.n_lists,
+            n_items=n_items,
+            n_lists=n_lists,
             nlist_pad=nlist_pad,
             l_pad=l_pad,
             dim=d,
         )
     profiling.incr_counter("ann.stage_bytes", int(data.nbytes))
     return index
+
+
+def index_from_packed(packed: PackedIVF, mesh: Mesh) -> IVFFlatIndex:
+    """Expand a PackedIVF into this mesh's device layout (padded host
+    layout + staging; user ids stay on the host in int64)."""
+    data, x_norm, ids_pad, counts, cpad, c_norm, nlist_pad, l_pad = (
+        padded_host_layout(packed, mesh)
+    )
+    return stage_padded_layout(
+        data, x_norm, ids_pad, counts, cpad, c_norm, nlist_pad, l_pad,
+        packed.n_items, packed.n_lists, mesh,
+    )
 
 
 def _effective_nprobe(index: IVFFlatIndex, nprobe: int) -> int:

@@ -370,15 +370,45 @@ def random_split_ids(
     int k, shorthand for k equal folds (the CrossValidator case)."""
     if isinstance(weights, int):
         weights = [1.0] * weights
-    rng = np.random.default_rng(seed)
-    perm = rng.permutation(n)
     total = float(sum(weights))
     bounds = np.cumsum([w / total for w in weights])[:-1]
     cut = (bounds * n).astype(int)
+    return _permutation_split(n, cut, seed)
+
+
+def _permutation_split(n: int, cuts: np.ndarray, seed: int) -> np.ndarray:
+    """The ONE seeded-permutation split assignment: permute rows with the
+    seeded generator, cut the permutation at `cuts`, and label each row
+    with its segment.  random_split_ids derives its cuts from fractional
+    weights (the Spark randomSplit semantics); stream_chunk_ids derives
+    EXACT integer cuts — both ride this identical permutation, so the two
+    surfaces can never disagree on what 'seed s over n rows' means."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
     split_id = np.empty(n, dtype=np.int32)
-    for i, g in enumerate(np.split(perm, cut)):
+    for i, g in enumerate(np.split(perm, cuts)):
         split_id[g] = i
     return split_id
+
+
+def stream_chunk_ids(n: int, chunk_rows: int, seed: int = 0) -> np.ndarray:
+    """Per-row CHUNK assignment for a streamed replay of an n-row dataset:
+    row r of the source belongs to streamed chunk ``stream_chunk_ids(...)[r]``
+    (chunks 0..ceil(n/chunk_rows)-1, each of EXACTLY chunk_rows rows except
+    a short tail — exact integer cuts, not randomSplit's fractional
+    rounding, so chunk sizes can never drift a row across a pow2 bucket
+    boundary and break the zero-compile steady-ingest contract).  Shares
+    the ONE seeded-permutation split definition with random_split_ids
+    (_permutation_split), so a replayed stream at the same (n, chunk_rows,
+    seed) produces IDENTICAL chunk membership — the determinism
+    precondition for srml-stream's streamed==batch equality gates
+    (docs/streaming.md §determinism)."""
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    if n <= 0:
+        return np.zeros(0, dtype=np.int32)
+    cuts = np.arange(chunk_rows, n, chunk_rows, dtype=np.int64)
+    return _permutation_split(n, cuts, seed)
 
 
 def _split_pandas(pdf: pd.DataFrame, n: int) -> List[pd.DataFrame]:

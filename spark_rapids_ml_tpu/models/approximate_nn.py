@@ -364,6 +364,11 @@ class ApproximateNearestNeighborsModel(
         self._staged_index: Optional[Tuple[Any, Any]] = None
         self._staged_pq: Optional[Tuple[Any, Any]] = None
         self._staged_exact: Optional[Tuple[Any, Any]] = None
+        # live-mutation holder (ann/mutable.py): once created via
+        # mutable_index(), every staged-flat read — kneighbors AND the
+        # serve.ann entry — snapshots it, so add/delete/repack are visible
+        # to in-flight serving without re-registration
+        self._mutable: Optional[Tuple[Any, Any]] = None
 
     def _packed(self) -> PackedIVF:
         return PackedIVF(
@@ -405,9 +410,68 @@ class ApproximateNearestNeighborsModel(
 
     def _ensure_staged_index(self, mesh):
         key = self._mesh_key(mesh)
+        if self._mutable is not None:
+            if self._mutable[0] != key:
+                raise ValueError(
+                    "this model's index is live-mutable on a different "
+                    "mesh; mutation is per-mesh — freeze_mutations() "
+                    "before staging elsewhere"
+                )
+            return self._mutable[1].index
         if self._staged_index is None or self._staged_index[0] != key:
             self._staged_index = (key, index_from_packed(self._packed(), mesh))
         return self._staged_index[1]
+
+    def mutable_index(self, mesh: Any = None):
+        """The live-mutation holder for this model's IVF-Flat index
+        (ann/mutable.MutableIVFIndex): created on first call (staging the
+        packed payload on `mesh`), returned thereafter.  Once created,
+        kneighbors and the serve.ann entry read the holder's atomic index
+        snapshot, so add_items/delete_items/repack are immediately visible
+        to serving traffic.  Flat-only: the PQ tier's codes are not
+        incrementally mutable (docs/ann_engine.md §incremental-mutation)."""
+        self._check_algorithm()
+        if self.getAlgorithm() == "ivfpq":
+            raise ValueError(
+                "live mutation is IVF-Flat-only; the PQ tier requires "
+                "codebook-consistent codes (refit to mutate an ivfpq model)"
+            )
+        from ..ann.mutable import MutableIVFIndex
+
+        mesh = mesh or get_mesh(self.num_workers)
+        key = self._mesh_key(mesh)
+        if self._mutable is None:
+            self._mutable = (key, MutableIVFIndex(self._packed(), mesh))
+            self._staged_index = None  # the holder owns staging now
+        elif self._mutable[0] != key:
+            raise ValueError(
+                "mutable index already staged on a different mesh; "
+                "freeze_mutations() and re-create to move meshes"
+            )
+        return self._mutable[1]
+
+    def freeze_mutations(self):
+        """Fold the live holder's state back into the model's persistable
+        packed payload (compacted live rows) and drop the holder — after
+        this, save()/staging behave exactly like a freshly-built index
+        over the mutated item set."""
+        if self._mutable is None:
+            return self
+        packed = self._mutable[1].to_packed()
+        self.packed_items_ = packed.items
+        self.packed_ids_ = packed.ids
+        self.list_counts_ = packed.counts
+        self.centroids_ = packed.centroids
+        self.n_items = packed.n_items
+        self._model_attributes["packed_items_"] = packed.items
+        self._model_attributes["packed_ids_"] = packed.ids
+        self._model_attributes["list_counts_"] = packed.counts
+        self._model_attributes["centroids_"] = packed.centroids
+        self._model_attributes["n_items"] = packed.n_items
+        self._mutable = None
+        self._staged_index = None
+        self._staged_exact = None
+        return self
 
     def _ensure_staged_pq(self, mesh):
         key = self._mesh_key(mesh)
@@ -420,6 +484,17 @@ class ApproximateNearestNeighborsModel(
     def _ensure_staged_exact(self, mesh):
         from ..ops.knn import prepare_items
 
+        if self._mutable is not None:
+            # the exact route stages from packed_items_/packed_ids_, which
+            # live mutations do NOT update until freeze — serving a stale
+            # payload here would return tombstoned ids and miss every
+            # added one, silently
+            raise ValueError(
+                "exactSearch is unavailable while the index is live-"
+                "mutable (the exact route reads the persistable packed "
+                "payload, which mutations update only at "
+                "freeze_mutations()); freeze first"
+            )
         key = self._mesh_key(mesh)
         if self._staged_exact is None or self._staged_exact[0] != key:
             self._staged_exact = (
@@ -571,11 +646,15 @@ class ApproximateNearestNeighborsModel(
                 return keys
 
         else:
-            index = self._ensure_staged_index(mesh)
+            self._ensure_staged_index(mesh)  # stage (or validate holder mesh)
 
             def call(batch: np.ndarray) -> Dict[str, np.ndarray]:
+                # re-read per batch: with a live-mutation holder this is
+                # the atomic post-mutation snapshot (add/delete/repack are
+                # serving-visible without re-registration); without one it
+                # is the cached staged tuple — a dict lookup either way
                 dists, ids = ivfflat_search_prepared(
-                    index, batch, k, nprobe, mesh
+                    self._ensure_staged_index(mesh), batch, k, nprobe, mesh
                 )
                 return {
                     "indices": np.asarray(ids),
@@ -583,11 +662,17 @@ class ApproximateNearestNeighborsModel(
                 }
 
             def warm(buckets) -> list:
+                index = self._ensure_staged_index(mesh)
+                holder = self._mutable[1] if self._mutable is not None else None
                 keys = []
                 for b in sorted({max(int(x), 64) for x in buckets}):
                     keys.extend(
                         warm_probe_kernels(index, k, nprobe, mesh, n_queries=b)
                     )
+                    if holder is not None:
+                        # a later repack re-warms exactly the geometries
+                        # serving dispatches before swapping the index in
+                        holder.register_warm(k, nprobe, b)
                 return keys
 
         return ServingEntry(

@@ -201,6 +201,14 @@ class KMeans(_KMeansParams, _TpuEstimator):
     def _create_model(self, result: Dict[str, Any]) -> "KMeansModel":
         return KMeansModel(**result)
 
+    def streaming(self):
+        """Streaming incremental-fit engine over this configured estimator:
+        mini-batch Lloyd with count-weighted per-center merge —
+        partial_fit/merge/finalize (srml-stream, docs/streaming.md)."""
+        from ..stream.engines import StreamingKMeans
+
+        return StreamingKMeans(self)
+
 
 class KMeansModel(_KMeansParams, _TpuModelWithPredictionCol):
     # cluster ids are integral (Spark KMeansModel emits IntegerType)

@@ -332,6 +332,16 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
     def _create_model(self, result: Dict[str, Any]) -> "LinearRegressionModel":
         return LinearRegressionModel(**result)
 
+    def streaming(self):
+        """Streaming incremental-fit engine over this configured estimator:
+        mergeable Gram-moment accumulation finalized through the SAME
+        solve kernels as the batch fit (streamed == batch bitwise on the
+        exact-arithmetic data families) — partial_fit/merge/finalize
+        (srml-stream, docs/streaming.md)."""
+        from ..stream.engines import StreamingLinearRegression
+
+        return StreamingLinearRegression(self)
+
     # -- batched hyperparameter sweep (srml-sweep) -------------------------
     def _supportsBatchedSweep(self, df, paramMaps, evaluator) -> bool:
         if not paramMaps or not self._supportsTransformEvaluate(evaluator):

@@ -348,6 +348,16 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
     def _create_model(self, result: Dict[str, Any]) -> "LogisticRegressionModel":
         return LogisticRegressionModel(**result)
 
+    def streaming(self, classes=None):
+        """Streaming incremental-fit engine over this configured estimator:
+        warm-started per-chunk L-BFGS with count-weighted coefficient
+        averaging — partial_fit/merge/finalize (srml-stream,
+        docs/streaming.md).  Pass classes= when early chunks may not cover
+        the full label set."""
+        from ..stream.engines import StreamingLogisticRegression
+
+        return StreamingLogisticRegression(self, classes=classes)
+
     # -- batched hyperparameter sweep (srml-sweep) -------------------------
     def _supportsBatchedSweep(self, df, paramMaps, evaluator) -> bool:
         if not paramMaps or not self._supportsTransformEvaluate(evaluator):

@@ -126,6 +126,15 @@ class PCA(_PCAParams, _TpuEstimator):
     def _create_model(self, result: Dict[str, Any]) -> "PCAModel":
         return PCAModel(**result)
 
+    def streaming(self):
+        """Streaming incremental-fit engine over this configured estimator:
+        mergeable covariance-moment accumulation, finalized through the
+        batch kernel's shared eigh derivation — partial_fit/merge/finalize
+        (srml-stream, docs/streaming.md)."""
+        from ..stream.engines import StreamingPCA
+
+        return StreamingPCA(self)
+
 
 class PCAModel(_PCAParams, _TpuModel):
     def __init__(

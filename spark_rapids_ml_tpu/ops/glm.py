@@ -333,6 +333,26 @@ def sweep_solve_elasticnet_cd(
 
 
 @jax.jit
+def stream_linreg_chunk_kernel(
+    X: jax.Array, y: jax.Array, w: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One streamed chunk's UNREDUCED linear-regression sufficient
+    statistics (wsum, xwsum, G, ywsum, c, y2) — the srml-stream update
+    kernel.  Raw weighted sums, not means: the streaming accumulator folds
+    chunk partials additively (the same algebra linreg_sufficient_stats
+    psums across shards) and derives means once at finalize."""
+    xw = X * w[:, None]
+    return (
+        w.sum(),
+        xw.sum(axis=0),
+        exact_matmul(xw.T, X),
+        (y * w).sum(),
+        exact_matmul(xw.T, y),
+        (y * y * w).sum(),
+    )
+
+
+@jax.jit
 def linear_predict_kernel(X: jax.Array, coef: jax.Array, intercept: jax.Array) -> jax.Array:
     from .sparse import EllMatrix, ell_matvec
 

@@ -234,6 +234,30 @@ def random_init(X: jax.Array, w: jax.Array, k: int, seed: int):
     return X[idx]
 
 
+@jax.jit
+def stream_kmeans_chunk_kernel(
+    X: jax.Array, w: jax.Array, centers: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One streamed chunk's mini-batch Lloyd statistics against the CURRENT
+    running centers: (per-center weighted sums (k, D), counts (k,),
+    difference-form chunk cost) — the srml-stream kmeans update kernel.
+    Assignment math mirrors _chunked_assign_stats (expanded-form distances
+    on the MXU, exact difference-form cost so the reported running inertia
+    never carries the fast-matmul cancellation error); no scan — streamed
+    chunks are already bucket-sized blocks."""
+    k = centers.shape[0]
+    x_norm = (X * X).sum(axis=1)
+    c_norm = (centers * centers).sum(axis=1)
+    d2 = x_norm[:, None] - 2.0 * (X @ centers.T) + c_norm[None, :]
+    assign = jnp.argmin(d2, axis=1)
+    onehot = jax.nn.one_hot(assign, k, dtype=X.dtype) * w[:, None]
+    sums = onehot.T @ X
+    counts = onehot.sum(axis=0)
+    diff = X - centers[assign]
+    cost = ((diff * diff).sum(axis=1) * w).sum()
+    return sums, counts, cost
+
+
 def kmeans_predict_kernel(X: jax.Array, centers: jax.Array) -> jax.Array:
     # min_dist_argmin routes by regime: the fused Pallas kernel on TPU in the
     # memory-bound low-d/large-k regime (the (N, k) distance tile never

@@ -169,6 +169,16 @@ def pca_fit_kernel(
     singular_values[k]).
     """
     wsum, mean, scatter = _moments(X, w, mesh, chunk)
+    return _pca_from_moments(wsum, mean, scatter, k)
+
+
+def _pca_from_moments(wsum, mean, scatter, k: int):
+    """Covariance + dense eigh + sign-canonicalized top-k from replicated
+    weighted moments — the ONE post-moments derivation, traced identically
+    by the batch kernel above and by the streaming finalize kernel below,
+    so a streamed fit whose accumulated moments carry the same bits as the
+    batch pass yields bit-identical components (the srml-stream equality
+    contract, docs/streaming.md)."""
     cov = (scatter - wsum * jnp.outer(mean, mean)) / (wsum - 1.0)
     cov = (cov + cov.T) * 0.5
     evals, evecs = jnp.linalg.eigh(cov)  # ascending
@@ -180,6 +190,60 @@ def pca_fit_kernel(
     ratio = top_vals / total_var
     singular_values = jnp.sqrt(jnp.maximum(top_vals, 0.0) * (wsum - 1.0))
     return mean, components, top_vals, ratio, singular_values
+
+
+@partial(jax.jit, static_argnames=("k",))
+def pca_from_moments_kernel(
+    wsum: jax.Array, xwsum: jax.Array, scatter: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """PCA finalize for accumulated streaming moments: mean derived from
+    the raw weighted sum exactly like the batch moment passes (xwsum/wsum
+    on replicated values), then the shared _pca_from_moments tail.  Same
+    return tuple as pca_fit_kernel."""
+    return _pca_from_moments(wsum, xwsum / wsum, scatter, k)
+
+
+def pca_finalize_moments(
+    wsum, xwsum, scatter, k: int, host_eigh: bool = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host entry for the streaming PCA finalize: the same device-vs-native
+    eigh routing rule as pca_fit, applied to accumulated (wsum, xwsum,
+    scatter) moments instead of a staged dataset.  Inputs are host arrays
+    in the fit's compute dtype; returns numpy arrays in pca_fit's layout."""
+    wsum = np.asarray(wsum)
+    xwsum = np.asarray(xwsum)
+    scatter = np.asarray(scatter)
+    d = scatter.shape[0]
+    if host_eigh is None:
+        host_eigh = d >= HOST_EIGH_MIN_D and jax.default_backend() == "cpu"
+    if not host_eigh:
+        return tuple(
+            jax.device_get(
+                pca_from_moments_kernel(
+                    jnp.asarray(wsum), jnp.asarray(xwsum), jnp.asarray(scatter), k
+                )
+            )
+        )  # type: ignore[return-value]
+    from .. import native
+
+    # mirror pca_fit's host branch: covariance formed in the compute dtype,
+    # then the f64 native eigh on the HOST copy
+    mean = xwsum / wsum
+    cov = (scatter - wsum * np.outer(mean, mean)) / (wsum - 1.0)
+    cov = (cov + cov.T) * 0.5
+    wsum_f = float(wsum)
+    mean64 = mean.astype(np.float64)  # graftlint: disable=R5 (host-side eigh input)
+    cov64 = cov.astype(np.float64)  # graftlint: disable=R5 (host-side eigh input)
+    evals, comps = native.eigh_descending(cov64)
+    top = np.maximum(evals[:k], 0.0)
+    total = max(evals.sum(), np.finfo(np.float64).tiny)  # graftlint: disable=R5 (host-side f64 epsilon)
+    return (
+        mean64,
+        comps[:k],
+        evals[:k],
+        evals[:k] / total,
+        np.sqrt(top * (wsum_f - 1.0)),
+    )
 
 
 @partial(jax.jit, static_argnames=("mesh", "chunk"))
@@ -384,6 +448,19 @@ def pca_fit(
         evals[:k] / total,
         np.sqrt(top * (wsum - 1.0)),
     )
+
+
+@jax.jit
+def stream_moments_chunk_kernel(
+    X: jax.Array, w: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One streamed chunk's weighted moments (wsum, xwsum, scatter) — the
+    srml-stream PCA update kernel.  Single-device math over a pow2-bucketed
+    chunk (pad rows carry zero weight): the reduction order is fixed by the
+    chunk itself, never by the serving mesh, so accumulated streams are
+    mesh-independent data the same way the IVF coarse quantizer is."""
+    xw = X * w[:, None]
+    return w.sum(), xw.sum(axis=0), exact_matmul(xw.T, X)
 
 
 @jax.jit

@@ -62,25 +62,14 @@ def _softmax_data_loss(theta, X, yidx, w, k, d, fit_intercept):
     return (ll * w).sum() / w.sum()
 
 
-@partial(
-    jax.jit,
-    static_argnames=("k", "fit_intercept", "max_iter", "use_owlqn"),
-)
-def logistic_fit_kernel(
-    X: jax.Array,
-    y_enc: jax.Array,
-    w: jax.Array,
-    k: int,
-    reg: float,
-    l1_ratio: float,
-    fit_intercept: bool,
-    max_iter: int,
-    tol: float,
-    use_owlqn: bool,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Fit one logistic model; k == 1 -> binary sigmoid (y_enc in {0,1}),
-    k >= 2 -> multinomial softmax (y_enc = class index).  Returns
-    (W (k, D), b (k,), n_iter, converged)."""
+def _solve_from(
+    X, y_enc, w, theta0, k, reg, l1_ratio, fit_intercept, max_iter, tol,
+    use_owlqn,
+):
+    """Shared L-BFGS/OWL-QN solve from an explicit starting point — the ONE
+    objective construction behind the batch kernel (zero init) and the
+    streaming warm-start kernel (srml-stream partial_fit resumes from the
+    running coefficients)."""
     d = X.shape[1]
     n_params = k * d + (k if fit_intercept else 0)
     dtype = X.dtype
@@ -104,7 +93,7 @@ def logistic_fit_kernel(
 
     result = minimize_lbfgs(
         value_and_grad,
-        jnp.zeros((n_params,), dtype),
+        theta0,
         l1_weight=l1 * reg_mask,
         max_iter=max_iter,
         tol=tol,
@@ -113,6 +102,68 @@ def logistic_fit_kernel(
     )
     W, b = _unpack(result.x, k, d, fit_intercept)
     return W, b, result.n_iter, result.converged
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "fit_intercept", "max_iter", "use_owlqn"),
+)
+def logistic_fit_kernel(
+    X: jax.Array,
+    y_enc: jax.Array,
+    w: jax.Array,
+    k: int,
+    reg: float,
+    l1_ratio: float,
+    fit_intercept: bool,
+    max_iter: int,
+    tol: float,
+    use_owlqn: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fit one logistic model; k == 1 -> binary sigmoid (y_enc in {0,1}),
+    k >= 2 -> multinomial softmax (y_enc = class index).  Returns
+    (W (k, D), b (k,), n_iter, converged)."""
+    d = X.shape[1]
+    n_params = k * d + (k if fit_intercept else 0)
+    return _solve_from(
+        X, y_enc, w, jnp.zeros((n_params,), X.dtype), k, reg, l1_ratio,
+        fit_intercept, max_iter, tol, use_owlqn,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "fit_intercept", "max_iter", "use_owlqn"),
+)
+def logistic_warm_fit_kernel(
+    X: jax.Array,
+    y_enc: jax.Array,
+    w: jax.Array,
+    W0: jax.Array,
+    b0: jax.Array,
+    reg: jax.Array,
+    l1_ratio: jax.Array,
+    tol: jax.Array,
+    k: int,
+    fit_intercept: bool,
+    max_iter: int,
+    use_owlqn: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """logistic_fit_kernel warm-started from (W0 (k, D), b0 (k,)) — the
+    srml-stream partial_fit kernel: each device-staged chunk resumes the
+    solve from the running streamed coefficients instead of zeros, so a
+    steady stream converges per chunk in a handful of iterations.  The
+    objective (and therefore the fixed point) is identical to the batch
+    kernel's — only the starting point differs.  reg/l1_ratio/tol are
+    TRACED scalars (positional, after the arrays) so the one cached
+    executable serves every regularization setting at a geometry."""
+    theta0 = W0.reshape(-1).astype(X.dtype)
+    if fit_intercept:
+        theta0 = jnp.concatenate([theta0, b0.astype(X.dtype)])
+    return _solve_from(
+        X, y_enc, w, theta0, k, reg, l1_ratio, fit_intercept, max_iter, tol,
+        use_owlqn,
+    )
 
 
 # -- batched hyperparameter sweep (srml-sweep; docs/tuning_engine.md) --------

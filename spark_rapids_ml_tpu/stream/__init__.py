@@ -1,0 +1,31 @@
+# srml-stream: streaming incremental fit + train-while-serve.
+#
+# partial_fit/merge/finalize engines over the batch estimators
+# (engines.py), the mergeable cross-rank state algebra (state.py), and the
+# StreamingSession orchestrator wiring snapshots into the zero-downtime
+# serving swap (session.py).  Live IVF index mutation lives next to the
+# index it mutates: ann/mutable.py.  docs/streaming.md is the contract.
+
+from .engines import (
+    StreamingEngine,
+    StreamingKMeans,
+    StreamingLinearRegression,
+    StreamingLogisticRegression,
+    StreamingPCA,
+    streaming_fit,
+)
+from .session import StreamingSession
+from .state import StreamState, allgather_merge, merge_all
+
+__all__ = [
+    "StreamingEngine",
+    "StreamingKMeans",
+    "StreamingLinearRegression",
+    "StreamingLogisticRegression",
+    "StreamingPCA",
+    "StreamingSession",
+    "StreamState",
+    "allgather_merge",
+    "merge_all",
+    "streaming_fit",
+]

@@ -1,0 +1,151 @@
+#
+# StreamingSession: train-while-serve orchestration (srml-stream).
+#
+# Consumes a chunk iterator through a streaming engine, tracks
+# rows-ingested and staleness (rows/chunks/seconds since the serving plane
+# last saw a snapshot), and on refresh() materializes a model snapshot and
+# pushes it through the PR 11 zero-downtime swap — ModelRegistry.swap
+# and/or rolling Router.swap — so replicas keep taking traffic across the
+# cut-over (warm-before-cutover from the retained AOT cache: a same-shape
+# refresh performs zero new compilations; gated in tests/test_streaming.py
+# under live router load with zero client-visible errors).
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional
+
+from .. import profiling
+from .engines import StreamingEngine
+
+
+class StreamingSession:
+    """One continuously-learning model: engine + serving-refresh wiring.
+
+    `registry`/`router` are optional serving planes; refresh() registers
+    the first snapshot under `name` (ModelRegistry.register /
+    Router.serve) and hot-swaps every later one (swap).  With neither
+    plane, refresh() still snapshots and resets the staleness clock —
+    callers can push the returned model wherever they serve."""
+
+    def __init__(
+        self,
+        engine: StreamingEngine,
+        name: Optional[str] = None,
+        registry: Any = None,
+        router: Any = None,
+        **serve_kwargs: Any,
+    ):
+        if (registry is not None or router is not None) and not name:
+            raise ValueError("a serving plane needs a model name; pass name=")
+        self._engine = engine
+        self._name = name
+        self._registry = registry
+        self._router = router
+        self._serve_kwargs = dict(serve_kwargs)
+        self._refreshes = 0
+        self._rows_at_refresh = 0
+        self._chunks_at_refresh = 0
+        self._last_refresh_t: Optional[float] = None
+        self._model: Any = None
+
+    # -- ingest ------------------------------------------------------------
+    @property
+    def engine(self) -> StreamingEngine:
+        return self._engine
+
+    def partial_fit(self, chunk: Any, y: Any = None, weight: Any = None):
+        """Ingest one chunk (spans stream.ingest around the engine's
+        stream.update; staleness attrs make 'how stale is serving' readable
+        straight off a trace)."""
+        with profiling.span(
+            "stream.ingest",
+            engine=self._engine.kind,
+            stale_rows=self.staleness_rows,
+        ):
+            self._engine.partial_fit(chunk, y=y, weight=weight)
+        return self
+
+    def ingest(self, chunks: Iterable[Any], refresh_every_rows: int = 0):
+        """Drain a chunk iterator; with refresh_every_rows > 0, refresh()
+        fires whenever that many rows have accumulated since the last
+        snapshot (the simple staleness policy; callers needing time-based
+        refresh drive refresh() themselves)."""
+        for chunk in chunks:
+            self.partial_fit(chunk)
+            if (
+                refresh_every_rows > 0
+                and self.staleness_rows >= refresh_every_rows
+            ):
+                self.refresh()
+        return self
+
+    # -- staleness ---------------------------------------------------------
+    @property
+    def rows_ingested(self) -> int:
+        return self._engine.rows_ingested
+
+    @property
+    def staleness_rows(self) -> int:
+        """Rows ingested since the serving plane last saw a snapshot."""
+        return self._engine.rows_ingested - self._rows_at_refresh
+
+    @property
+    def staleness_chunks(self) -> int:
+        return self._engine.chunks_ingested - self._chunks_at_refresh
+
+    @property
+    def staleness_seconds(self) -> Optional[float]:
+        if self._last_refresh_t is None:
+            return None
+        return profiling.now() - self._last_refresh_t
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "name": self._name,
+            "engine": self._engine.kind,
+            "rows_ingested": self._engine.rows_ingested,
+            "chunks_ingested": self._engine.chunks_ingested,
+            "refreshes": self._refreshes,
+            "staleness_rows": self.staleness_rows,
+            "staleness_chunks": self.staleness_chunks,
+            "staleness_seconds": self.staleness_seconds,
+        }
+
+    # -- refresh -----------------------------------------------------------
+    def snapshot(self) -> Any:
+        """Materialize a fitted model from the current state WITHOUT
+        touching the serving planes or the staleness clock."""
+        return self._engine.finalize()
+
+    def refresh(self) -> Any:
+        """Snapshot the current state and push it through the serving
+        plane(s): first refresh registers (ModelRegistry.register /
+        Router.serve), every later one rides the zero-downtime swap —
+        the old generation drains while the new one, warmed from the
+        retained AOT cache, takes the traffic.  Returns the snapshot."""
+        with profiling.span(
+            "stream.refresh",
+            engine=self._engine.kind,
+            rows=self._engine.rows_ingested,
+        ):
+            model = self.snapshot()
+            if self._registry is not None:
+                if self._name in self._registry:
+                    self._registry.swap(self._name, model)
+                else:
+                    self._registry.register(
+                        self._name, model, **self._serve_kwargs
+                    )
+            if self._router is not None:
+                if self._name in self._router:
+                    self._router.swap(self._name, model)
+                else:
+                    self._router.serve(self._name, model, **self._serve_kwargs)
+        self._model = model
+        self._refreshes += 1
+        self._rows_at_refresh = self._engine.rows_ingested
+        self._chunks_at_refresh = self._engine.chunks_ingested
+        self._last_refresh_t = profiling.now()
+        profiling.incr_counter("stream.refreshes")
+        return model

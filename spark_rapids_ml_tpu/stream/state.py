@@ -1,0 +1,171 @@
+#
+# Mergeable streaming-fit state (srml-stream).
+#
+# Every streaming engine's accumulated knowledge is one StreamState: a
+# small pytree of float64 host arrays (counts / weighted sums / Gram and
+# covariance moments / count-weighted coefficient sums) whose merge is
+# FIELD-WISE ADDITION — the same associative+commutative algebra the
+# telemetry snapshots (profiling.TelemetrySnapshot) ride across ranks, so
+# multi-rank streams reduce their states through the existing control
+# plane (allGather of the JSON wire form + fold) with no new collective
+# machinery.  A few fields are identity anchors rather than statistics
+# (the kmeans init centers, the logreg class set): those merge under the
+# "equal" reducer — both sides must carry the same bits, because adding
+# two streams that disagree on their anchor is a user error, not algebra.
+#
+# float64 on the HOST is deliberate: chunk partials are computed on device
+# in the fit dtype (exact f32 sums on the equality-gate data families —
+# see docs/streaming.md §exactness), and the host fold keeps every partial
+# exactly, so merge order can never change the finalized model on the
+# gated data.  This module is host-side numpy only — no jax.
+#
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+WIRE_SCHEMA = "srml-stream/v1"
+
+# per-kind field reducers; any field not listed merges under "add"
+_EQUAL_FIELDS = {
+    "kmeans": ("init_centers",),
+    "logreg": ("classes",),
+}
+
+# the known kinds (one per streaming engine) — wire decode rejects others
+KINDS = ("kmeans", "pca", "linreg", "logreg")
+
+
+class StreamState:
+    """One engine's mergeable accumulator: kind tag + named f64 arrays.
+
+    merge() is pure (returns a NEW state) so rank folds can reduce
+    gathered states without aliasing; engines hold a private mutable copy
+    and fold chunk partials in place via add_()."""
+
+    __slots__ = ("kind", "arrays")
+
+    def __init__(self, kind: str, arrays: Dict[str, np.ndarray]):
+        if kind not in KINDS:
+            raise ValueError(f"unknown stream state kind {kind!r}; one of {KINDS}")
+        self.kind = str(kind)
+        self.arrays = {
+            name: np.asarray(a, np.float64) for name, a in arrays.items()
+        }
+
+    def _check_compatible(self, other: "StreamState") -> None:
+        if self.kind != other.kind:
+            raise ValueError(
+                f"cannot merge stream states of kind {self.kind!r} and "
+                f"{other.kind!r}"
+            )
+        if set(self.arrays) != set(other.arrays):
+            raise ValueError(
+                f"stream state field mismatch: {sorted(self.arrays)} vs "
+                f"{sorted(other.arrays)}"
+            )
+        for name, a in self.arrays.items():
+            b = other.arrays[name]
+            if a.shape != b.shape:
+                raise ValueError(
+                    f"stream state field {name!r} shape mismatch: "
+                    f"{a.shape} vs {b.shape} (different k/D streams?)"
+                )
+
+    def add_(self, partials: Dict[str, Any]) -> "StreamState":
+        """Fold one chunk's partials into this state IN PLACE (engine-side
+        hot path; additive fields only)."""
+        equal = _EQUAL_FIELDS.get(self.kind, ())
+        for name, v in partials.items():
+            if name in equal:
+                raise ValueError(f"field {name!r} is an identity anchor, not additive")
+            self.arrays[name] = self.arrays[name] + np.asarray(v, np.float64)
+        return self
+
+    def merge(self, other: "StreamState") -> "StreamState":
+        """Associative + commutative combine of two streams' states:
+        additive fields sum; identity anchors must agree bitwise."""
+        self._check_compatible(other)
+        equal = _EQUAL_FIELDS.get(self.kind, ())
+        out = {}
+        for name, a in self.arrays.items():
+            b = other.arrays[name]
+            if name in equal:
+                if not np.array_equal(a, b):
+                    raise ValueError(
+                        f"cannot merge {self.kind} streams with different "
+                        f"{name!r} anchors (streams must share their seed/"
+                        "init — see docs/streaming.md §merge)"
+                    )
+                out[name] = a.copy()
+            else:
+                out[name] = a + b
+        return StreamState(self.kind, out)
+
+    def copy(self) -> "StreamState":
+        return StreamState(self.kind, {n: a.copy() for n, a in self.arrays.items()})
+
+    # -- wire format (control-plane allGather payload) ---------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "schema": WIRE_SCHEMA,
+            "kind": self.kind,
+            "arrays": {
+                name: {"shape": list(a.shape), "data": a.ravel().tolist()}
+                for name, a in sorted(self.arrays.items())
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StreamState":
+        if d.get("schema") != WIRE_SCHEMA:
+            raise ValueError(
+                f"unknown stream state schema {d.get('schema')!r}; "
+                f"expected {WIRE_SCHEMA}"
+            )
+        arrays = {
+            name: np.asarray(spec["data"], np.float64).reshape(spec["shape"])
+            for name, spec in d["arrays"].items()
+        }
+        return cls(d["kind"], arrays)
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, StreamState)
+            and self.kind == other.kind
+            and set(self.arrays) == set(other.arrays)
+            and all(
+                np.array_equal(a, other.arrays[n]) for n, a in self.arrays.items()
+            )
+        )
+
+    def __repr__(self) -> str:
+        fields = ", ".join(
+            f"{n}{list(a.shape)}" for n, a in sorted(self.arrays.items())
+        )
+        return f"StreamState({self.kind}: {fields})"
+
+
+def merge_all(states: List[StreamState]) -> StreamState:
+    """Left fold of merge() over a non-empty state list (rank order — the
+    deterministic fold every rank applies to an allGathered list)."""
+    if not states:
+        raise ValueError("merge_all of zero states")
+    out = states[0]
+    for s in states[1:]:
+        out = out.merge(s)
+    return out
+
+
+def allgather_merge(control_plane: Any, state: StreamState) -> StreamState:
+    """Reduce this rank's state with every peer's through the control
+    plane: allGather the JSON wire form (rank-indexed, the ControlPlane
+    ordering contract) and fold in rank order — every rank computes the
+    IDENTICAL merged state, exactly like the fit-telemetry reduction in
+    parallel/runner.py."""
+    import json
+
+    msgs = control_plane.allGather(json.dumps(state.to_dict()))
+    return merge_all([StreamState.from_dict(json.loads(m)) for m in msgs])

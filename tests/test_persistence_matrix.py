@@ -219,6 +219,113 @@ def test_swap_incompatible_model_fails_clean(model_zoo):
         reg.shutdown(drain=False)
 
 
+# -- streamed arms (srml-stream, docs/streaming.md) --------------------------
+# A model built by partial_fit over chunks, then saved and loaded, must
+# equal the BATCH-fit model on the concatenated data: bitwise for the
+# closed-form engines (linreg coefficients, sign-canonicalized PCA
+# components — on the exact-arithmetic integer/pow2-row data family the
+# equality contract gates), quality-gated for the online approximations
+# (kmeans inertia, logreg accuracy) — against batch fits on 1-device AND
+# 8-device meshes (streamed states are mesh-independent data).
+
+STREAM_ARMS = ["kmeans", "pca", "linreg", "logreg"]
+
+
+@pytest.fixture(scope="module")
+def stream_fixture():
+    from spark_rapids_ml_tpu.dataframe import stream_chunk_ids
+
+    rng = np.random.default_rng(13)
+    n, d, k = 256, 6, 3
+    centers = rng.integers(-2, 3, size=(k, d)) * 8
+    assign = rng.integers(0, k, n)
+    X = (centers[assign] + rng.integers(-2, 3, size=(n, d))).astype(np.float32)
+    y_reg = (X @ np.arange(1.0, d + 1.0)).astype(np.float64)
+    w = rng.standard_normal(d)
+    margin = X @ w
+    y_clf = (margin > np.median(margin)).astype(np.float64)
+    cid = stream_chunk_ids(n, 64, seed=5)
+    return X, y_reg, y_clf, cid, k
+
+
+def _stream_pair(arm, fx, n_dev):
+    """(streamed_model, batch_model_on_n_dev_mesh) for one arm."""
+    from spark_rapids_ml_tpu import (
+        KMeans,
+        LinearRegression,
+        LogisticRegression,
+        PCA,
+    )
+
+    X, y_reg, y_clf, cid, k = fx
+
+    def build(est_kw=None):
+        kw = dict(est_kw or {})
+        if arm == "kmeans":
+            return KMeans(k=k, maxIter=10, seed=1, **kw).setFeaturesCol("features")
+        if arm == "pca":
+            return PCA(k=3, **kw).setInputCol("features")
+        if arm == "linreg":
+            return LinearRegression(maxIter=20, **kw)
+        return LogisticRegression(maxIter=20, **kw)
+
+    y = {"linreg": y_reg, "logreg": y_clf}.get(arm)
+    if y is None:
+        df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=2)
+    else:
+        df = DataFrame.from_numpy(X, y=y, num_partitions=2)
+    batch = build({"num_workers": n_dev}).fit(df)
+    eng = build().streaming()
+    for c in range(int(cid.max()) + 1):
+        m = cid == c
+        eng.partial_fit(X[m], y=None if y is None else y[m])
+    return eng.finalize(), batch
+
+
+@pytest.mark.parametrize("arm", STREAM_ARMS)
+@pytest.mark.parametrize("n_dev", [1, 8])
+def test_streamed_save_load_equals_batch(arm, n_dev, stream_fixture, tmp_path):
+    X, y_reg, y_clf, cid, k = stream_fixture
+    streamed, batch = _stream_pair(arm, stream_fixture, n_dev)
+    path = str(tmp_path / f"streamed_{arm}_{n_dev}")
+    streamed.save(path)
+    loaded = core_load(path)
+    assert type(loaded) is type(batch)
+    if arm == "linreg":
+        np.testing.assert_array_equal(loaded.coef_, batch.coef_)
+        assert loaded.intercept_ == batch.intercept_
+    elif arm == "pca":
+        np.testing.assert_array_equal(loaded.components_, batch.components_)
+        np.testing.assert_array_equal(loaded.mean_, batch.mean_)
+    elif arm == "kmeans":
+        def inertia(C):
+            d2 = ((X[:, None, :] - np.asarray(C)[None]) ** 2).sum(-1)
+            return float(d2.min(axis=1).sum())
+
+        assert inertia(loaded.cluster_centers_) <= 1.10 * inertia(
+            batch.cluster_centers_
+        )
+    else:  # logreg: streamed accuracy within 3% of batch on the union
+        df = DataFrame.from_numpy(X, y=y_clf, num_partitions=2)
+
+        def acc(model):
+            out = model.transform(df)
+            preds = np.concatenate(
+                [np.asarray(p["prediction"]) for p in out.partitions if len(p)]
+            )
+            return float((preds == y_clf).mean())
+
+        assert acc(loaded) >= acc(batch) - 0.03
+        np.testing.assert_array_equal(loaded.classes_, batch.classes_)
+    # and the persistence bar itself: the loaded streamed model transforms
+    # bit-identically to its in-memory twin
+    before = _transform_outputs(streamed, X)
+    after = _transform_outputs(loaded, X)
+    assert sorted(before) == sorted(after)
+    for col in before:
+        assert np.array_equal(np.asarray(before[col]), np.asarray(after[col]))
+
+
 def test_loaded_model_attributes_round_trip(model_zoo, tmp_path):
     # spot-check the attribute payload itself (npz + json split): arrays
     # stay arrays, scalars stay scalars
