@@ -6,15 +6,21 @@ set -ex
 cd "$(dirname "$0")/.."
 
 # 1. lint / static checks: byte-compile everything (mypy/black optional in
-#    this image), then graftlint — the JAX/TPU invariant checker (R1-R10:
+#    this image), then graftlint — the JAX/TPU invariant checker (R1-R12:
 #    hidden host syncs, recompile risk, unbound collective axis names,
 #    nondeterministic RNG/set-order, float64 in solver kernels, raw clocks
 #    outside srml-scope, unnamed threads, remote-DMA confinement, unbounded
-#    waits, raw-socket confinement; see docs/graftlint.md).  Fails on ANY finding and
-#    prints the per-rule count; use --baseline to land a new rule warn-only
-#    first.
+#    waits, raw-socket confinement, lock-order/blocking-under-lock,
+#    shared-state write discipline; see docs/graftlint.md).  This is the
+#    ONE whole-package gate: R11/R12 need every module parsed together for
+#    the package-wide lock graph, and --fail-on-new vs the committed
+#    baseline makes any NEW finding a build error while audited debt stays
+#    visible as warnings (the per-PR per-module re-runs that used to ride
+#    each focused step below are consolidated here — same files, one
+#    program, no drift between the module lists and the tree).
 python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
-python -m tools.graftlint spark_rapids_ml_tpu benchmark
+python -m tools.graftlint spark_rapids_ml_tpu benchmark \
+    --baseline ci/graftlint-baseline.json --fail-on-new
 
 # 2. native runtime build
 make -C native
@@ -67,12 +73,9 @@ python -m pytest tests/test_precompile.py -q
 #     - epoch loop issues ceil(n_epochs / SRML_UMAP_EPOCH_BLOCK) dispatches
 #       and repeat same-shape fits perform ZERO new compilations
 #     - graph assembly stays on device (single-upload transfer counters)
-#     plus a graftlint-clean re-check of the engine modules by name.
+#     (graftlint re-check rides the step-1 whole-package gate.)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_umap_engine.py -q
-python -m tools.graftlint spark_rapids_ml_tpu/ops/umap.py \
-    spark_rapids_ml_tpu/models/umap.py spark_rapids_ml_tpu/ops/precompile.py \
-    spark_rapids_ml_tpu/parallel/mesh.py spark_rapids_ml_tpu/parallel/exchange.py
 
 # 3d. focused gates for the device-resident forest engine (also inside the
 #     full suite; re-asserted by name so marker drift can never silently
@@ -84,12 +87,9 @@ python -m tools.graftlint spark_rapids_ml_tpu/ops/umap.py \
 #       (forest.levels.dispatches / forest.level_syncs / forest.d2h_transfers)
 #     - zero-recompile repeat fit + repeat transform (precompile counters)
 #     - interpret-mode sharded+psum MXU histogram rule vs the numpy oracle
-#     plus a graftlint-clean re-check of the engine modules by name.
+#     (graftlint re-check rides the step-1 whole-package gate.)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_forest_engine.py -q
-python -m tools.graftlint spark_rapids_ml_tpu/ops/forest.py \
-    spark_rapids_ml_tpu/ops/forest_hist.py spark_rapids_ml_tpu/ops/forest_mxu.py \
-    spark_rapids_ml_tpu/models/random_forest.py
 
 # 3e. focused gates for the srml-serve subsystem (also inside the full
 #     suite; re-asserted by name so marker drift can never silently drop
@@ -101,14 +101,12 @@ python -m tools.graftlint spark_rapids_ml_tpu/ops/forest.py \
 #     - overload rejects fast with ServerOverloaded instead of blocking;
 #       queued-request deadlines expire with RequestTimeout
 #     - registry serves core.load'ed models with transform-equal outputs
-#     plus a graftlint-clean re-check of the serving modules by name, the
-#     save->load->transform persistence matrix the registry builds on, and
-#     an open-loop bench_serving smoke over two model types (throughput +
-#     p50/p95/p99 columns present, steady-state assertion on).
+#     plus the save->load->transform persistence matrix the registry
+#     builds on, and an open-loop bench_serving smoke over two model types
+#     (throughput + p50/p95/p99 columns present, steady-state assertion
+#     on).  (graftlint re-check rides the step-1 whole-package gate.)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_serving.py tests/test_persistence_matrix.py -q
-python -m tools.graftlint spark_rapids_ml_tpu/serving \
-    spark_rapids_ml_tpu/profiling.py benchmark/bench_serving.py
 SERVE_SMOKE=$(mktemp -d)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m benchmark.bench_serving --models kmeans,linreg --rates 50,200 \
@@ -136,16 +134,13 @@ rm -rf "$SERVE_SMOKE"
 #       and the warm path covers the exact dispatch key
 #     - the SRML_UMAP_ANN=ivfflat knob keeps k=15 neighbor preservation
 #       within the established 1% of the exact-graph layout
-#     plus a graftlint-clean re-check of the ann modules by name and a
-#     bench_approximate_nn smoke asserting recall/qps columns + zero
-#     steady-state compiles on tiny clustered data.
+#     plus a bench_approximate_nn smoke asserting recall/qps columns +
+#     zero steady-state compiles on tiny clustered data.  (graftlint
+#     re-check rides the step-1 whole-package gate.)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_ann_engine.py -q
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_umap_engine.py -q -k ann_graph
-python -m tools.graftlint spark_rapids_ml_tpu/ann \
-    spark_rapids_ml_tpu/models/approximate_nn.py \
-    spark_rapids_ml_tpu/metrics/binary.py benchmark/bench_approximate_nn.py
 ANN_SMOKE=$(mktemp -d)
 python -m benchmark.gen_data blobs --num_rows 2000 --num_cols 16 --n_clusters 8 \
     --output_dir "$ANN_SMOKE/blobs" --output_num_files 2
@@ -222,14 +217,9 @@ rm -rf "$TRACE_SMOKE"
 #     - ModelRegistry.health() reports READY with SLO attainment >= 0 and
 #       the health/memory gauge families render through export_metrics()/
 #       render_prometheus()
-#     plus a graftlint-clean re-check (incl. R7 unnamed-thread) of the
-#     watch/serving/runner modules by name.
+#     (graftlint re-check, incl. R7, rides the step-1 whole-package gate.)
 python -m pytest tests/test_watch.py -q
 python -m pytest tests/test_watch.py -q -k "induced_hang or induced_exception or overhead"
-python -m tools.graftlint spark_rapids_ml_tpu/watch.py \
-    spark_rapids_ml_tpu/profiling.py spark_rapids_ml_tpu/serving \
-    spark_rapids_ml_tpu/parallel/runner.py spark_rapids_ml_tpu/parallel/context.py \
-    spark_rapids_ml_tpu/ops/precompile.py
 WATCH_SMOKE=$(mktemp -d)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     SRML_TRACE_DIR="$WATCH_SMOKE/traces" SRML_SERVE_SLO_MS=500 python - <<'EOF'
@@ -275,17 +265,12 @@ rm -rf "$WATCH_SMOKE"
 #     - fused merge epilogue in interpret mode: nb>1 K-block geometry,
 #       the lex tie contract vs the numpy oracle, and the forced
 #       self-verify fallback through the fused path
-#     plus a graftlint-clean re-check (incl. R8 remote-dma confinement) of
-#     the touched modules by name, and a bench_nearest_neighbors smoke
-#     asserting zero new compiles on repeat search and the bytes-moved
-#     fields present.
+#     plus a bench_nearest_neighbors smoke asserting zero new compiles on
+#     repeat search and the bytes-moved fields present.  (graftlint
+#     re-check, incl. R8, rides the step-1 whole-package gate.)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_knn_exchange.py -q
 python -m pytest tests/test_pallas.py -q -k "fused"
-python -m tools.graftlint spark_rapids_ml_tpu/ops/knn.py \
-    spark_rapids_ml_tpu/ops/pallas_knn.py spark_rapids_ml_tpu/parallel/exchange.py \
-    spark_rapids_ml_tpu/models/knn.py spark_rapids_ml_tpu/ann \
-    benchmark/bench_nearest_neighbors.py
 KNN_SMOKE=$(mktemp -d)
 python -m benchmark.gen_data blobs --num_rows 2000 --num_cols 16 --n_clusters 8 \
     --output_dir "$KNN_SMOKE/blobs" --output_num_files 2
@@ -319,8 +304,7 @@ rm -rf "$KNN_SMOKE"
 #       queued/in-flight requests failed by the typed retryable
 #       ServerRecovering (never a hang) and ZERO new compiles across the
 #       recovery (buckets re-warm from the retained AOT cache)
-#     plus a graftlint-clean re-check (incl. R9 unbounded-wait) of the
-#     touched modules by name.
+#     (graftlint re-check, incl. R9, rides the step-1 whole-package gate.)
 # the explicit full-file run IS the by-name gate: nothing in it is
 # marker-filtered, so no subset re-run is needed (the chaos matrix is the
 # most expensive piece of 3j — run it once)
@@ -328,10 +312,6 @@ python -m pytest tests/test_faults.py -q
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_serving.py -q \
     -k "shield or worker_death or wedge_then or drain_during or budget or rolls_up"
-python -m tools.graftlint spark_rapids_ml_tpu/parallel \
-    spark_rapids_ml_tpu/serving spark_rapids_ml_tpu/watch.py \
-    spark_rapids_ml_tpu/core.py spark_rapids_ml_tpu/ops/knn.py \
-    spark_rapids_ml_tpu/compat.py
 
 # 3k. srml-router gates (also inside the full suite; re-asserted by name
 #     so marker drift can never silently drop them — docs/serving.md
@@ -352,8 +332,7 @@ python -m tools.graftlint spark_rapids_ml_tpu/parallel \
 #       fill ceilings while interactive traffic is still admitted
 #     - the srml_router / srml_health exposition round-trip incl.
 #       per-replica restart counts
-#     plus graftlint (incl. R7 named-threads, R9 unbounded-wait) over the
-#     serving layer, and a bench_serving router smoke asserting the
+#     plus a bench_serving router smoke asserting the
 #     max-sustained-QPS-at-p99-SLO headline per depth, the PAIRED goodput
 #     confirm with depth-2 >= depth-1 at the COMMON SUSTAINED offered
 #     load (min of the two search maxima) and equal SLO, and a zero-error
@@ -377,9 +356,6 @@ XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_router.py -q
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_persistence_matrix.py -q -k "swap"
-python -m tools.graftlint spark_rapids_ml_tpu/serving \
-    spark_rapids_ml_tpu/parallel/mesh.py spark_rapids_ml_tpu/watch.py \
-    spark_rapids_ml_tpu/profiling.py benchmark/bench_serving.py
 ROUTER_SMOKE=$(mktemp -d)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m benchmark.bench_serving --models kmeans \
@@ -428,20 +404,13 @@ rm -rf "$ROUTER_SMOKE"
 #       values (the candidate-bucket AOT key: lanes are traced, not baked)
 #     - kill switch + fallbacks: SRML_SWEEP_BATCH=0, non-lane-batchable
 #       grid params, and sparse CSR input all keep the legacy fold loop
-#     plus a graftlint-clean re-check of the touched modules by name, and
-#     a bench_tuning smoke at the default CI shape asserting the batched
+#     plus a bench_tuning smoke at the default CI shape asserting the batched
 #     route beats the sequential one in candidates/sec on BOTH solver
 #     families and repeats with zero new kernel compilations.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_tuning.py -q -k "batched_sweep or cv_copy"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_spark_cv.py -q -k "batched"
-python -m tools.graftlint spark_rapids_ml_tpu/ops/sweep.py \
-    spark_rapids_ml_tpu/ops/glm.py spark_rapids_ml_tpu/ops/lbfgs.py \
-    spark_rapids_ml_tpu/ops/logistic.py spark_rapids_ml_tpu/tuning.py \
-    spark_rapids_ml_tpu/models/linear_regression.py \
-    spark_rapids_ml_tpu/models/logistic_regression.py \
-    spark_rapids_ml_tpu/dataframe.py benchmark/bench_tuning.py
 TUNE_SMOKE=$(mktemp -d)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m benchmark.bench_tuning --algos linreg,logreg \
@@ -472,16 +441,13 @@ rm -rf "$TUNE_SMOKE"
 #       the culprit within 2 heartbeat intervals (wall-clock asserted),
 #       with zero orphaned sockets/threads/files; a stale-epoch zombie
 #       rejoin is fenced (StaleEpochError), never readmitted
-#     plus graftlint (incl. the new R10 raw-socket confinement) over the
-#     touched modules by name, and a bench_control_plane smoke asserting
+#     plus a bench_control_plane smoke asserting
 #     the pushed abort beats one 50 ms file-plane poll interval.
+#     (graftlint re-check, incl. R10, rides the step-1 whole-package gate.)
 #     (SRML_CI_FULL additionally reruns the full multicontroller fit +
 #     kneighbors matrix on SRML_CP=tcp with the bitwise cross-plane gate —
 #     see the slow-suite block in step 3.)
 python -m pytest tests/test_control_plane_contract.py tests/test_netplane.py -q
-python -m tools.graftlint spark_rapids_ml_tpu/parallel \
-    spark_rapids_ml_tpu/watch.py tools/graftlint/rules.py \
-    benchmark/bench_control_plane.py
 WIRE_SMOKE=$(mktemp -d)
 python -m benchmark.bench_control_plane --planes file,tcp \
     --gather_rounds 60 --abort_trials 3 \
@@ -512,20 +478,16 @@ rm -rf "$WIRE_SMOKE"
 #       results (the flat kernel's lex/merge helpers reused verbatim)
 #     - refined recall@10 >= 0.9 at the documented defaults on clustered
 #       data, and zero-new-compile repeat/warmed searches
-#     plus a graftlint-clean re-check of the ann + touched ops modules by
-#     name, and a paired bench_approximate_nn smoke (flat + pq arms on ONE
+#     plus a paired bench_approximate_nn smoke (flat + pq arms on ONE
 #     dataset) asserting refined recall@10 >= 0.9, zero new compiles in
 #     the timed repeat window, and the compression headline:
-#     pq index_bytes_per_item < 1/8 of the flat arm's.
+#     pq index_bytes_per_item < 1/8 of the flat arm's.  (graftlint
+#     re-check rides the step-1 whole-package gate.)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_pq_engine.py -q
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_pq_engine.py -q \
     -k "lut_kernel or mesh_parity or refined_recall or zero_new_compiles"
-python -m tools.graftlint spark_rapids_ml_tpu/ann \
-    spark_rapids_ml_tpu/ops/pallas_pq.py spark_rapids_ml_tpu/ops/pallas_tpu.py \
-    spark_rapids_ml_tpu/models/approximate_nn.py \
-    benchmark/bench_approximate_nn.py
 PQ_SMOKE=$(mktemp -d)
 python -m benchmark.gen_data blobs --num_rows 2000 --num_cols 32 --n_clusters 8 \
     --output_dir "$PQ_SMOKE/blobs" --output_num_files 2
@@ -572,9 +534,9 @@ rm -rf "$PQ_SMOKE"
 #     - train-while-serve: StreamingSession.refresh() through the router
 #       under concurrent load — zero client-visible errors, zero new
 #       compiles at the same-shape cut-over
-#     plus graftlint over stream/ + the touched modules by name, and a
-#     bench_streaming smoke asserting steady ingest with zero new
-#     compiles and a zero-error refresh blip.
+#     plus a bench_streaming smoke asserting steady ingest with zero new
+#     compiles and a zero-error refresh blip.  (graftlint re-check rides
+#     the step-1 whole-package gate.)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_streaming.py -q
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
@@ -582,12 +544,6 @@ XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     -k "bitwise_equals_batch or inertia_quality or metric_quality or steady_ingest_zero or add_delete_repack_recall or overflow_repack or served_ann_absorbs or refresh_under_router_load"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_persistence_matrix.py -q -k "streamed"
-python -m tools.graftlint spark_rapids_ml_tpu/stream \
-    spark_rapids_ml_tpu/ann spark_rapids_ml_tpu/ops/linalg.py \
-    spark_rapids_ml_tpu/ops/glm.py spark_rapids_ml_tpu/ops/kmeans.py \
-    spark_rapids_ml_tpu/ops/logistic.py spark_rapids_ml_tpu/dataframe.py \
-    spark_rapids_ml_tpu/models/approximate_nn.py \
-    benchmark/bench_streaming.py
 STREAM_SMOKE=$(mktemp -d)
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m benchmark.bench_streaming --algos linreg,kmeans \
@@ -605,6 +561,32 @@ for r in recs:
     assert r["counters"].get("stream.rows", 0) == r["rows"], r
 EOF
 rm -rf "$STREAM_SMOKE"
+
+# 3p. graftlint-cc gates: the concurrency pass (R11 lock-order, R12
+#     shared-state) and its runtime half (also inside the full suite;
+#     re-asserted by name so marker drift can never silently drop them):
+#     - fixture suites: a crafted lock-order inversion fires both directly
+#       nested and through a one-call interprocedural edge, every
+#       blocking-op class under a held lock fires, the condition-wait
+#       idiom stays exempt, guarded-vs-unguarded shared-state writes
+#       separate (incl. the _locked helper convention), stable finding
+#       ids survive line shifts, and --fail-on-new gates fresh findings
+#       against a v2 baseline
+#     - runtime lockdep: a crafted two-thread inversion raises the typed
+#       LockOrderViolation carrying both lock names and both stacks; the
+#       disabled path hands back raw threading primitives (zero overhead)
+#     then the chaos matrix + serving-recovery gates re-run ONCE with the
+#     lockdep sanitizer armed (SRML_SANITIZE=lockdep arms ONLY the
+#     lock-order validator — debug_nans/transfer-guard stay off so
+#     timings hold).  A violation raises out of the acquiring thread, so
+#     a green rerun IS the zero-violations assertion — and the runtime
+#     half covers the alias/cross-module edges the static pass documents
+#     as invisible (docs/graftlint.md#r11).
+python -m pytest tests/test_graftlint_concurrency.py tests/test_lockdep.py -q
+SRML_SANITIZE=lockdep python -m pytest tests/test_faults.py tests/test_netplane.py -q
+SRML_SANITIZE=lockdep XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_serving.py -q \
+    -k "shield or worker_death or wedge_then or drain_during or budget or rolls_up"
 
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)

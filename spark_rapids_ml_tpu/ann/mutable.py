@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import profiling
+from .. import profiling, sanitize
 from ..ops.precompile import shape_bucket
 from .ivfflat import (
     IVFFlatIndex,
@@ -66,7 +66,9 @@ class MutableIVFIndex:
 
     def __init__(self, packed: PackedIVF, mesh: Any):
         self._mesh = mesh
-        self._lock = threading.RLock()
+        self._lock = sanitize.lockdep_lock(
+            "ann.mutable.mutator", factory=threading.RLock
+        )
         (
             self._data, self._norms, self._ids, self._counts,
             self._cpad, self._c_norm, self._nlist_pad, self._l_pad,
@@ -88,7 +90,7 @@ class MutableIVFIndex:
         # by its OWN lock: noting a spec is on the READ path, and taking
         # the mutator lock there would stall searches behind a repack's
         # staging + compile wait — the blocking the snapshot design avoids
-        self._spec_lock = threading.Lock()
+        self._spec_lock = sanitize.lockdep_lock("ann.mutable.warmspec")
         self._warm_specs: set = set()
         self._repacks = 0
         self._index = self._stage()
@@ -220,6 +222,7 @@ class MutableIVFIndex:
                 # from the FINAL staged buffers before the swap, so the
                 # first post-swap search dispatches a ready executable
                 # (probes keep serving the old snapshot meanwhile)
+                # graftlint: disable=R11 (compile wait holds only the mutator lock, by design: probes are lock-free on the snapshot, and releasing mid-mutation would tear the staged swap — NOTES.md)
                 self._warm_for(staged)
             self._index = staged
             profiling.incr_counter("ann.mutate.adds", items.shape[0])
@@ -260,6 +263,7 @@ class MutableIVFIndex:
             self._repack_locked(l_pad)
             staged = self._stage()
             if staged.l_pad != self._index.l_pad:
+                # graftlint: disable=R11 (compile wait holds only the mutator lock, by design: probes are lock-free on the snapshot, and releasing mid-repack would tear the staged swap — NOTES.md)
                 self._warm_for(staged)
             self._index = staged
 

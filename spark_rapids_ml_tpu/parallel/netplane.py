@@ -67,7 +67,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from .. import profiling
+from .. import profiling, sanitize
 from ..utils import env_float as _env_float
 from ..utils import get_logger
 from . import faults
@@ -274,7 +274,12 @@ class _Member:
     rank: int
     epoch: int
     conn: socket.socket
-    send_lock: threading.Lock = field(default_factory=threading.Lock)
+    # class-level lockdep name: every member's send lock is one node (order
+    # is a code discipline); the static R11 pass can't follow this lock
+    # through _send_to's parameter, so the runtime check carries it alone
+    send_lock: Any = field(
+        default_factory=lambda: sanitize.lockdep_lock("net.coord.member_send")
+    )
     last_seen: float = 0.0
 
 
@@ -297,7 +302,7 @@ class CoordinatorServer:
         self._advertise_host = advertise_host
         self._port = port
         self._lease_s = lease_s if lease_s is not None else lease_interval_s()
-        self._lock = threading.Lock()
+        self._lock = sanitize.lockdep_lock("net.coord.state")
         self._members: Dict[int, _Member] = {}
         self._next_epoch: Dict[int, int] = {}
         self._dead: Dict[int, str] = {}            # rank -> reason
@@ -325,7 +330,8 @@ class CoordinatorServer:
         ):
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
-            self._threads.append(t)
+            with self._lock:
+                self._threads.append(t)
         return self._address
 
     @property
@@ -353,9 +359,10 @@ class CoordinatorServer:
         for m in members:
             with contextlib.suppress(OSError):
                 m.conn.close()
-        for t in list(self._threads):
+        with self._lock:
+            threads, self._threads = list(self._threads), []
+        for t in threads:  # join OUTSIDE the lock (R11: no waits under it)
             t.join(timeout=5.0)
-        self._threads = []
 
     # -- accept / per-connection reader --------------------------------------
     def _accept_loop(self) -> None:
@@ -376,9 +383,12 @@ class CoordinatorServer:
             t.start()
             # prune finished per-connection threads as we go: reconnect /
             # fence churn must not grow the list (or stop()'s join sweep)
-            # without bound over a long coordinator lifetime
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+            # without bound over a long coordinator lifetime.  Under the
+            # state lock: stop()'s join sweep snapshots this list from
+            # another thread (graftlint R12)
+            with self._lock:
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         member: Optional[_Member] = None
@@ -678,8 +688,8 @@ class TcpControlPlane:
         self._epoch: Optional[int] = resume_epoch
         self._closed = False
         self._stop = threading.Event()
-        self._send_lock = threading.Lock()
-        self._lock = threading.Lock()
+        self._send_lock = sanitize.lockdep_lock("net.plane.send")
+        self._lock = sanitize.lockdep_lock("net.plane.state")
         self._wake = threading.Condition(self._lock)
         self._results: Dict[int, List[bytes]] = {}
         self._abort: Optional[Dict[str, Any]] = None

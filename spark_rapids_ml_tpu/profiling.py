@@ -838,7 +838,10 @@ class TelemetrySnapshot:
 # Gauge providers: named callables returning {gauge name: float} sampled at
 # export time (unlike counters, gauges describe CURRENT state — memory
 # watermarks, serving health, cache sizes).  srml-watch registers the
-# memory/cache provider; each ModelRegistry registers its health provider.
+# memory/cache provider; each ModelRegistry registers its health provider;
+# sanitize registers lockdep.{locks,edges,violations} when armed (gauges,
+# not counters, because the counter path's flight-recorder hook takes the
+# watch ring lock — itself lockdep-wrapped when armed).
 _gauges_lock = threading.Lock()
 _gauge_providers: Dict[str, Callable[[], Dict[str, float]]] = {}
 

@@ -31,7 +31,7 @@ import numpy as np
 
 from concurrent.futures import Future, InvalidStateError
 
-from .. import profiling
+from .. import profiling, sanitize
 
 
 def resolve_future(fut: "Future", result: Any = None, exc: Any = None) -> bool:
@@ -142,7 +142,7 @@ class MicroBatcher:
             if default_timeout_ms is not None
             else _env_float(TIMEOUT_ENV, 0.0)
         ) / 1000.0
-        self._lock = threading.Lock()
+        self._lock = sanitize.lockdep_lock("serve.batcher.queue")
         self._nonempty = threading.Condition(self._lock)
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._queued_rows = 0
@@ -152,7 +152,7 @@ class MicroBatcher:
         # from arbitrary threads — including take() failing expired requests
         # while it holds _lock — and a done-callback re-acquiring _lock
         # would self-deadlock
-        self._done_lock = threading.Lock()
+        self._done_lock = sanitize.lockdep_lock("serve.batcher.done")
         self._quiescent = threading.Condition(self._done_lock)
         self._outstanding = 0  # admitted requests whose future is unresolved
 

@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import profiling, watch
+from .. import profiling, sanitize, watch
 from ..parallel import faults
 from .batcher import (  # noqa: F401
     MicroBatcher,
@@ -176,7 +176,7 @@ def _compile_watermark() -> int:
 # load-under-traffic case).  Every warmup registers here; a dispatch whose
 # window overlapped any warmup skips compile attribution for that batch
 # (counted as unattributed, never as a steady-state breach).
-_warm_lock = threading.Lock()
+_warm_lock = sanitize.lockdep_lock("serve.engine.warm")
 _warm_active = 0
 _warm_epoch = 0  # bumped at every warmup start AND end
 
@@ -258,7 +258,7 @@ class ModelServer:
         self._state = WARMING
         self._busy_since: Optional[float] = None
         self._drain_begun = False
-        self._health_lock = threading.Lock()
+        self._health_lock = sanitize.lockdep_lock("serve.engine.health")
         # srml-shield supervisor state: restart budget spent so far, the
         # CURRENT worker generation (a wedge recovery SUPERSEDES the stuck
         # worker by bumping the generation — when its blocked dispatch

@@ -12,9 +12,9 @@
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
 
+from .. import sanitize
 from .engine import SEVERITY, STATE_CODES, WARMING, ModelServer
 
 
@@ -30,7 +30,7 @@ class ModelRegistry:
 
     def __init__(self, **server_kwargs: Any):
         self._defaults = dict(server_kwargs)
-        self._lock = threading.Lock()
+        self._lock = sanitize.lockdep_lock("serve.registry.state")
         self._servers: Dict[str, ModelServer] = {}
         import weakref
 
@@ -246,7 +246,7 @@ class ModelRegistry:
 
 
 _default: Optional[ModelRegistry] = None
-_default_lock = threading.Lock()
+_default_lock = sanitize.lockdep_lock("serve.registry.default")
 
 
 def default_registry() -> ModelRegistry:

@@ -39,7 +39,7 @@ import logging
 import threading
 from typing import Any, Dict, List, Optional
 
-from .. import profiling, watch
+from .. import profiling, sanitize, watch
 from . import scheduler
 from .batcher import ServerDraining
 from .engine import (
@@ -115,7 +115,7 @@ class Router:
             ),
         )
         self._defaults = dict(server_kwargs)
-        self._lock = threading.Lock()
+        self._lock = sanitize.lockdep_lock("serve.router.state")
         self._sets: Dict[str, _ReplicaSet] = {}
         import weakref
 

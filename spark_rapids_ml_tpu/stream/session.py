@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Iterable, Optional
 
-from .. import profiling
+from .. import profiling, sanitize
 from .engines import StreamingEngine
 
 
@@ -48,6 +48,12 @@ class StreamingSession:
         self._chunks_at_refresh = 0
         self._last_refresh_t: Optional[float] = None
         self._model: Any = None
+        # refresh-under-load (graftlint R12): a staleness watcher calling
+        # refresh() concurrently with the ingest loop's refresh_every_rows
+        # trigger must not interleave two swaps — the staleness clock would
+        # be reset against a model that never reached the serving plane.
+        # One lock serializes snapshot+swap+bookkeeping as a unit.
+        self._refresh_lock = sanitize.lockdep_lock("stream.session.refresh")
 
     # -- ingest ------------------------------------------------------------
     @property
@@ -124,28 +130,31 @@ class StreamingSession:
         Router.serve), every later one rides the zero-downtime swap —
         the old generation drains while the new one, warmed from the
         retained AOT cache, takes the traffic.  Returns the snapshot."""
-        with profiling.span(
-            "stream.refresh",
-            engine=self._engine.kind,
-            rows=self._engine.rows_ingested,
-        ):
-            model = self.snapshot()
-            if self._registry is not None:
-                if self._name in self._registry:
-                    self._registry.swap(self._name, model)
-                else:
-                    self._registry.register(
-                        self._name, model, **self._serve_kwargs
-                    )
-            if self._router is not None:
-                if self._name in self._router:
-                    self._router.swap(self._name, model)
-                else:
-                    self._router.serve(self._name, model, **self._serve_kwargs)
-        self._model = model
-        self._refreshes += 1
-        self._rows_at_refresh = self._engine.rows_ingested
-        self._chunks_at_refresh = self._engine.chunks_ingested
-        self._last_refresh_t = profiling.now()
+        with self._refresh_lock:
+            with profiling.span(
+                "stream.refresh",
+                engine=self._engine.kind,
+                rows=self._engine.rows_ingested,
+            ):
+                model = self.snapshot()
+                if self._registry is not None:
+                    if self._name in self._registry:
+                        self._registry.swap(self._name, model)
+                    else:
+                        self._registry.register(
+                            self._name, model, **self._serve_kwargs
+                        )
+                if self._router is not None:
+                    if self._name in self._router:
+                        self._router.swap(self._name, model)
+                    else:
+                        self._router.serve(
+                            self._name, model, **self._serve_kwargs
+                        )
+            self._model = model
+            self._refreshes += 1
+            self._rows_at_refresh = self._engine.rows_ingested
+            self._chunks_at_refresh = self._engine.chunks_ingested
+            self._last_refresh_t = profiling.now()
         profiling.incr_counter("stream.refreshes")
         return model

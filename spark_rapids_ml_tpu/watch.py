@@ -56,7 +56,7 @@ import sys
 import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
-from . import profiling
+from . import profiling, sanitize
 
 _log = logging.getLogger("spark_rapids_ml_tpu.watch")
 
@@ -119,10 +119,10 @@ class FlightRecorder:
         self._ring: List[Optional[tuple]] = [None] * self.cap
         self._idx = 0
         self._total = 0
-        self._lock = threading.Lock()
+        self._lock = sanitize.lockdep_lock("watch.ring")
         # ident -> [thread_obj, open_stack(list of (name, t_open)), closes]
         self._threads: Dict[int, list] = {}
-        self._mem_lock = threading.Lock()
+        self._mem_lock = sanitize.lockdep_lock("watch.mem")
         self._phase_mem: Dict[str, list] = {}  # name -> [count, peak, sum_delta]
         self._mem_sampler: Optional[Callable[[], Optional[Tuple[float, float]]]] = None
         self._mem_probed = False
@@ -140,12 +140,17 @@ class FlightRecorder:
         _wtls.slot = slot
         _wtls.rec = self
         _wtls.err_span = None
-        self._threads[th.ident] = slot
-        if len(self._threads) > 256:  # prune dead threads, bounded
-            for ident in [
-                i for i, s in self._threads.items() if not s[0].is_alive()
-            ]:
-                del self._threads[ident]
+        # registration + prune under the ring lock: every instrumented
+        # thread passes through here, and a concurrent insert during the
+        # prune's items() scan would raise (dict changed size) — caught by
+        # graftlint R12; the TLS fast path above keeps this once-per-thread
+        with self._lock:
+            self._threads[th.ident] = slot
+            if len(self._threads) > 256:  # prune dead threads, bounded
+                for ident in [
+                    i for i, s in self._threads.items() if not s[0].is_alive()
+                ]:
+                    del self._threads[ident]
         return slot
 
     # -- event intake (called from profiling hooks) --------------------------
@@ -359,7 +364,7 @@ def _host_mem() -> Optional[Tuple[float, float]]:
 # -- module-level recorder + install ------------------------------------------
 
 _recorder: Optional[FlightRecorder] = None
-_install_lock = threading.Lock()
+_install_lock = sanitize.lockdep_lock("watch.install")
 
 
 def recorder() -> Optional[FlightRecorder]:
@@ -476,7 +481,7 @@ def health_gauges(
 
 # -- flight dump --------------------------------------------------------------
 
-_dump_lock = threading.Lock()
+_dump_lock = sanitize.lockdep_lock("watch.dump")
 _dump_seq = 0
 
 

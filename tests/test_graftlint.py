@@ -13,6 +13,7 @@ from tools.graftlint import (
     collect_pragmas,
     lint_paths,
     lint_source,
+    load_baseline,
     write_baseline,
 )
 
@@ -452,14 +453,28 @@ def test_baseline_demotes_then_catches_new(tmp_path):
     findings = _lint(R1_BAD_LOOP, path="pkg/mod.py")
     assert findings
     baseline_file = tmp_path / "baseline.json"
-    counts = write_baseline(str(baseline_file), findings)
-    assert sum(counts.values()) == len(findings)
+    ids = write_baseline(str(baseline_file), findings)
+    assert len(ids) == len(findings)
+    baseline = load_baseline(str(baseline_file))
+    assert isinstance(baseline, set) and baseline == set(ids)
+    errors, warnings = apply_baseline(findings, baseline)
+    assert errors == [] and len(warnings) == len(findings)
+    # a second occurrence of the same fingerprint gets a `~1` id the
+    # baseline has never seen — an error again
+    doubled = findings + findings
+    errors, warnings = apply_baseline(doubled, baseline)
+    assert len(errors) == len(findings) and len(warnings) == len(findings)
+
+
+def test_baseline_v1_counts_still_apply():
+    # legacy count-budget baselines (pre-v2 checkouts) keep working
+    findings = _lint(R1_BAD_LOOP, path="pkg/mod.py")
+    counts = {f"{f.path}::{f.rule}": len(findings) for f in findings}
     errors, warnings = apply_baseline(findings, counts)
     assert errors == [] and len(warnings) == len(findings)
-    # one NEW finding beyond the baselined count becomes an error again
     doubled = findings + findings
     errors, warnings = apply_baseline(doubled, counts)
-    assert len(errors) == len(findings) and len(warnings) == len(findings)
+    assert len(errors) == len(findings)
 
 
 # -- R6: raw wall clocks outside srml-scope -----------------------------------

@@ -1,0 +1,436 @@
+# graftlint concurrency pass (R11/R12): the lock-order graph must catch a
+# crafted inversion both directly nested and through a same-module call,
+# every blocking-op class must fire under a held lock, the sanctioned
+# condition-wait idiom must stay exempt, and the shared-state rule must
+# separate guarded from unguarded writes — including the `_locked` helper
+# convention.  Stable finding ids must survive line shifts (the property
+# the v2 baseline depends on).
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.graftlint import assign_ids, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fixture path inside the thread-spawning scope: both R11 and R12 apply
+SERVE = "spark_rapids_ml_tpu/serving/fixture.py"
+
+
+def _lint(src: str, path: str = SERVE, rules=None):
+    return lint_source(textwrap.dedent(src), path=path, rules=rules)
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- R11(a): lock-order inversions --------------------------------------------
+
+R11_DIRECT_INVERSION = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def rev(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_r11_direct_nesting_inversion():
+    findings = _lint(R11_DIRECT_INVERSION, rules=["R11"])
+    assert len(findings) == 2  # each order is a witness on the cycle
+    for f in findings:
+        assert f.rule == "R11"
+        assert "lock-order inversion" in f.message
+    # each message names the counter-witness site of the OTHER order
+    assert {f.func for f in findings} == {"S.fwd", "S.rev"}
+
+
+R11_INTERPROCEDURAL = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                self._grab_b()
+
+        def _grab_b(self):
+            with self._b:
+                pass
+
+        def rev(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_r11_one_call_interprocedural_inversion():
+    findings = _lint(R11_INTERPROCEDURAL, rules=["R11"])
+    assert findings and all(f.rule == "R11" for f in findings)
+    via = [f for f in findings if f.func == "S.fwd"]
+    assert via and "via call to S._grab_b()" in via[0].message
+
+
+R11_CLEAN_DAG = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._a:
+                self._grab_b()
+
+        def _grab_b(self):
+            with self._b:
+                pass
+"""
+
+
+def test_r11_consistent_order_is_silent():
+    assert _lint(R11_CLEAN_DAG, rules=["R11"]) == []
+
+
+def test_r11_scoped_to_package_paths():
+    assert _lint(R11_DIRECT_INVERSION, path="tests/x.py", rules=["R11"]) == []
+
+
+# -- R11(b): blocking ops under a held lock -----------------------------------
+
+def _blocking_fixture(call_line: str, prelude: str = "") -> str:
+    return f"""
+        import threading
+        {prelude}
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def work(self, x):
+                with self._lock:
+                    {call_line}
+    """
+
+
+@pytest.mark.parametrize(
+    "prelude,call,kind",
+    [
+        ("import time", "time.sleep(0.1)", "time.sleep()"),
+        ("import jax", "y = jax.device_get(x)", "device->host sync"),
+        ("import subprocess", "subprocess.run([x])", "subprocess"),
+        ("", "y = cached_call(x)", "AOT compile wait"),
+        ("", "y = x.block_until_ready()", "device sync"),
+        ("", "y = x.result()", "Future wait"),
+        ("", "y = x.recv(4)", "socket wait"),
+        ("", "y = x.accept()", "socket wait"),
+    ],
+)
+def test_r11_blocking_classes_under_lock(prelude, call, kind):
+    findings = _lint(_blocking_fixture(call, prelude), rules=["R11"])
+    assert len(findings) == 1
+    assert findings[0].rule == "R11"
+    assert "blocking" in findings[0].message
+    assert kind in findings[0].message
+
+
+def test_r11_blocking_without_lock_is_silent():
+    src = """
+        import time
+
+        class S:
+            def work(self):
+                time.sleep(0.1)
+    """
+    assert _lint(src, rules=["R11"]) == []
+
+
+def test_r11_blocking_reached_through_call():
+    src = """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def work(self):
+                with self._lock:
+                    self._settle()
+
+            def _settle(self):
+                time.sleep(0.1)
+    """
+    findings = _lint(src, rules=["R11"])
+    assert len(findings) == 1
+    assert "reaches a blocking time.sleep()" in findings[0].message
+    assert findings[0].func == "S.work"
+
+
+def test_r11_condition_wait_on_own_lock_is_exempt():
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ready = threading.Condition(self._lock)
+
+            def take(self):
+                with self._lock:
+                    while not self._have():
+                        self._ready.wait(timeout=1.0)
+
+            def _have(self):
+                return True
+    """
+    assert _lint(src, rules=["R11"]) == []
+
+
+def test_r11_foreign_condition_wait_fires():
+    # waiting on a condition bound to lock B while ALSO holding lock A
+    # does NOT release A — the exemption must not cover it
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._ready = threading.Condition(self._b)
+
+            def take(self):
+                with self._a:
+                    with self._b:
+                        self._ready.wait(timeout=1.0)
+    """
+    findings = _lint(src, rules=["R11"])
+    assert any("blocking .wait()" in f.message for f in findings)
+
+
+def test_r11_pragma_suppresses_with_reason():
+    src = R11_DIRECT_INVERSION.replace(
+        "with self._b:\n                with self._a:",
+        "with self._b:\n                # graftlint: disable=R11 (crafted)\n"
+        "                with self._a:",
+    )
+    findings = _lint(src, rules=["R11"])
+    # the suppressed witness is gone; the forward witness remains
+    assert all(f.func != "S.rev" for f in findings)
+
+
+# -- R12: shared-state write discipline ---------------------------------------
+
+R12_MIXED = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def hit(self):
+            with self._lock:
+                self._n += 1
+
+        def reset(self):
+            self._n = 0
+"""
+
+
+def test_r12_mixed_guarded_unguarded_write_fires():
+    findings = _lint(R12_MIXED, rules=["R12"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "R12" and f.func == "S.reset"
+    assert "written under a lock" in f.message
+    assert "no lock held" in f.message
+
+
+def test_r12_ctor_only_writes_are_silent():
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._items = []
+
+            def read(self):
+                return self._n
+    """
+    assert _lint(src, rules=["R12"]) == []
+
+
+def test_r12_container_mutation_on_lock_free_attr():
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                self._items.append(x)
+    """
+    findings = _lint(src, rules=["R12"])
+    assert len(findings) == 1
+    assert "non-atomic .append() mutation" in findings[0].message
+
+
+def test_r12_locked_helper_convention_is_silent():
+    # a helper whose EVERY same-module call site holds the lock is
+    # analyzed as running under it — no unguarded-write finding
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def hit(self):
+                with self._lock:
+                    self._bump()
+
+            def also_hit(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):
+                self._n += 1
+    """
+    assert _lint(src, rules=["R12"]) == []
+
+
+def test_r12_reference_swap_stays_legal():
+    # the lock-free discipline: plain rebinds with no guarded sibling
+    # site are NOT flagged (atomic reference swap is the sanctioned
+    # pattern — only container mutation needs a guard)
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._index = None
+
+            def swap(self, new):
+                self._index = new
+    """
+    assert _lint(src, rules=["R12"]) == []
+
+
+def test_r12_scoped_to_thread_spawning_modules():
+    assert _lint(
+        R12_MIXED, path="spark_rapids_ml_tpu/ops/x.py", rules=["R12"]
+    ) == []
+
+
+# -- stable ids + baseline ----------------------------------------------------
+
+def test_finding_ids_survive_line_shifts():
+    before = _lint(R11_DIRECT_INVERSION, rules=["R11"])
+    shifted = _lint(
+        "\n\n# moved\n\n" + textwrap.dedent(R11_DIRECT_INVERSION),
+        rules=["R11"],
+    )
+    ids_before = [fid for fid, _ in assign_ids(before)]
+    ids_after = [fid for fid, _ in assign_ids(shifted)]
+    assert ids_before == ids_after
+    assert [f.line for f in before] != [f.line for f in shifted]
+
+
+def test_finding_ids_disambiguate_duplicates():
+    findings = _lint(R12_MIXED, rules=["R12"])
+    ids = [fid for fid, _ in assign_ids(findings + findings)]
+    assert len(ids) == len(set(ids))
+    assert any(fid.endswith("~2") for fid in ids)
+
+
+def test_cli_fail_on_new_gates_fresh_findings(tmp_path, capsys):
+    from tools.graftlint.__main__ import main
+
+    bad = tmp_path / "spark_rapids_ml_tpu" / "serving"
+    bad.mkdir(parents=True)
+    (bad / "mod.py").write_text(textwrap.dedent(R11_DIRECT_INVERSION))
+    baseline = tmp_path / "baseline.json"
+
+    # write the baseline: current findings become audited debt
+    rc = main([str(bad), "--write-baseline", str(baseline)])
+    assert rc == 0
+    data = json.loads(baseline.read_text())
+    assert data["version"] == 2 and len(data["ids"]) == 2
+    capsys.readouterr()
+
+    # same tree vs the baseline: warnings only, exit 0
+    rc = main([str(bad), "--baseline", str(baseline), "--fail-on-new"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "baselined warning" in out
+
+    # a NEW finding (blocking sleep under lock) fails the build
+    (bad / "mod2.py").write_text(
+        textwrap.dedent(_blocking_fixture("time.sleep(1)", "import time"))
+    )
+    rc = main([str(bad), "--baseline", str(baseline), "--fail-on-new"])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_cli_fail_on_new_rejects_v1_baseline(tmp_path, capsys):
+    from tools.graftlint.__main__ import main
+
+    bad = tmp_path / "spark_rapids_ml_tpu" / "serving"
+    bad.mkdir(parents=True)
+    (bad / "mod.py").write_text(textwrap.dedent(R11_DIRECT_INVERSION))
+    baseline = tmp_path / "v1.json"
+    baseline.write_text(json.dumps({"whatever::R11": 2}))
+    rc = main([str(bad), "--baseline", str(baseline), "--fail-on-new"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "v2" in err
+
+
+def test_cli_json_format(tmp_path, capsys):
+    from tools.graftlint.__main__ import main
+
+    bad = tmp_path / "spark_rapids_ml_tpu" / "serving"
+    bad.mkdir(parents=True)
+    (bad / "mod.py").write_text(textwrap.dedent(R12_MIXED))
+    rc = main([str(bad), "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["summary"]["per_rule"]["R12"] == 1
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "R12"
+    assert finding["name"] == "shared-state"
+    assert finding["baselined"] is False
+    assert finding["id"].startswith("R12:")
+    assert "~" not in finding["id"]

@@ -51,6 +51,18 @@
 #                    un-fault-injectable), and recv/accept inside
 #                    netplane without a preceding settimeout in the same
 #                    function body (the socket analog of R9).
+#   R11 lock-order   whole-program concurrency pass (concurrency.py):
+#                    cycles in the package-wide held->acquired lock graph
+#                    (lock-order inversions, incl. interprocedural edges
+#                    through same-module calls), and blocking operations
+#                    performed while a lock is held (socket waits,
+#                    Future.result, foreign Condition.wait, compile
+#                    waits, device syncs, subprocess/sleep).
+#   R12 shared-state instance attributes written both under a lock and
+#                    with no lock held, and in-place container mutation
+#                    of lock-free attributes, in the thread-spawning
+#                    modules (serving/, parallel/, ann/mutable.py,
+#                    stream/session.py, watch.py).
 #
 # Suppression: `# graftlint: disable=R1 (reason)` on the finding line or the
 # line directly above.  Granted pragmas are audited in NOTES.md.
@@ -61,13 +73,15 @@
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple, Union
 
-from .rules import RULES, ModuleIndex, lint_tree
+from .concurrency import ParsedModule, lint_concurrency
+from .rules import CONCURRENCY_RULES, RULES, ModuleIndex, lint_tree
 
 __all__ = [
     "Finding",
@@ -76,6 +90,7 @@ __all__ = [
     "load_baseline",
     "write_baseline",
     "apply_baseline",
+    "assign_ids",
     "RULE_NAMES",
 ]
 
@@ -90,6 +105,8 @@ RULE_NAMES = {
     "R8": "remote-dma",
     "R9": "unbounded-wait",
     "R10": "raw-socket",
+    "R11": "lock-order",
+    "R12": "shared-state",
 }
 
 # Findings sanctioned by construction, not by pragma.  Entries are
@@ -164,20 +181,44 @@ def _allowlisted(f: Finding) -> bool:
     return False
 
 
+def _parse_module(source: str, path: str) -> ParsedModule:
+    import ast
+
+    tree = ast.parse(source, filename=path)
+    return ParsedModule(path=path, tree=tree, index=ModuleIndex(tree, path))
+
+
+def _per_module_findings(
+    pm: ParsedModule, selected: Set[str]
+) -> List[Finding]:
+    return [
+        Finding(rule=r, path=pm.path, line=line, message=msg, func=func)
+        for (r, line, msg, func) in lint_tree(pm.tree, pm.index, selected)
+    ]
+
+
+def _concurrency_findings(
+    parsed: List[ParsedModule], selected: Set[str]
+) -> List[Finding]:
+    if not (selected & set(CONCURRENCY_RULES)):
+        return []
+    return [
+        Finding(rule=r, path=path, line=line, message=msg, func=func)
+        for (r, path, line, msg, func) in lint_concurrency(parsed, selected)
+    ]
+
+
 def lint_source(
     source: str, path: str = "<string>", rules: Optional[Iterable[str]] = None
 ) -> List[Finding]:
     """Lint one module's source; returns unsuppressed findings sorted by
-    line.  `rules` restricts to a subset (default: all)."""
-    import ast
-
-    tree = ast.parse(source, filename=path)
-    index = ModuleIndex(tree, path)
+    line.  `rules` restricts to a subset (default: all).  The concurrency
+    pass (R11/R12) runs over the single module — interprocedural edges
+    stay within it, exactly as in a whole-package run."""
+    pm = _parse_module(source, path)
     selected = set(rules) if rules is not None else set(RULES)
-    raw = [
-        Finding(rule=r, path=path, line=line, message=msg, func=func)
-        for (r, line, msg, func) in lint_tree(tree, index, selected)
-    ]
+    raw = _per_module_findings(pm, selected)
+    raw.extend(_concurrency_findings([pm], selected))
     pragmas = collect_pragmas(source)
     return sorted(
         (f for f in raw if not _suppressed(f, pragmas) and not _allowlisted(f)),
@@ -202,50 +243,105 @@ def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
 def lint_paths(
     paths: Iterable[str], rules: Optional[Iterable[str]] = None
 ) -> List[Finding]:
+    """Lint a set of files/packages as ONE program: per-module rules run
+    file by file, then the concurrency pass (R11/R12) runs once over every
+    parsed module so the lock graph is package-wide.  Pragmas and the
+    allowlist apply to both halves."""
+    selected = set(rules) if rules is not None else set(RULES)
+    parsed: List[ParsedModule] = []
+    pragmas_of: Dict[str, Dict[int, set]] = {}
     findings: List[Finding] = []
     for path in iter_python_files(paths):
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
-        findings.extend(lint_source(source, path=os.path.normpath(path), rules=rules))
-    return findings
+        norm = os.path.normpath(path)
+        pm = _parse_module(source, norm)
+        parsed.append(pm)
+        pragmas_of[norm] = collect_pragmas(source)
+        findings.extend(_per_module_findings(pm, selected))
+    findings.extend(_concurrency_findings(parsed, selected))
+    return sorted(
+        (
+            f
+            for f in findings
+            if not _suppressed(f, pragmas_of.get(f.path, {}))
+            and not _allowlisted(f)
+        ),
+        key=lambda f: (f.path, f.line, f.rule),
+    )
 
 
-# -- baseline: land a new rule warn-only, promote to error later -------------
+# -- stable finding ids -------------------------------------------------------
+# A finding's identity is (rule, path, symbol, fingerprint-of-message) — NO
+# line numbers, so a baseline survives unrelated edits that shift code up
+# or down.  Identical findings in the same symbol (two copies of the same
+# bad call) get an occurrence suffix in first-seen order.
 
-def baseline_key(f: Finding) -> str:
-    return f"{f.path}::{f.rule}"
+def _fingerprint(f: Finding) -> str:
+    h = hashlib.sha1(
+        f"{f.rule}|{f.path}|{f.func}|{f.message}".encode("utf-8")
+    )
+    return h.hexdigest()[:10]
 
 
-def load_baseline(path: str) -> Dict[str, int]:
+def assign_ids(findings: List[Finding]) -> List[Tuple[str, Finding]]:
+    """[(stable id, finding)] in (path, line, rule) order."""
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    seen: Dict[str, int] = {}
+    out: List[Tuple[str, Finding]] = []
+    for f in ordered:
+        base = f"{f.rule}:{f.path}::{f.func or '<module>'}@{_fingerprint(f)}"
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        out.append((base if n == 0 else f"{base}~{n + 1}", f))
+    return out
+
+
+# -- baseline: ratchet the whole-package gate --------------------------------
+# v2 (written by --write-baseline, consumed by --fail-on-new): a list of
+# stable finding ids — audited debt.  Findings whose id is recorded demote
+# to warnings; any NEW id is an error, so the gate only ever ratchets down.
+# v1 (legacy): {"<path>::<rule>": count} — per-(file, rule) count budgets.
+
+Baseline = Union[Dict[str, int], Set[str]]
+
+
+def load_baseline(path: str) -> Baseline:
     with open(path, "r", encoding="utf-8") as fh:
         data = json.load(fh)
+    if isinstance(data, dict) and data.get("version") == 2:
+        ids = data.get("ids")
+        if not isinstance(ids, list):
+            raise ValueError(f"baseline {path}: v2 needs an 'ids' list")
+        return {str(i) for i in ids}
     if not isinstance(data, dict):
         raise ValueError(f"baseline {path} must be a JSON object")
     return {str(k): int(v) for k, v in data.items()}
 
 
-def write_baseline(path: str, findings: List[Finding]) -> Dict[str, int]:
-    counts: Dict[str, int] = {}
-    for f in findings:
-        counts[baseline_key(f)] = counts.get(baseline_key(f), 0) + 1
+def write_baseline(path: str, findings: List[Finding]) -> List[str]:
+    ids = [i for i, _f in assign_ids(findings)]
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump(counts, fh, indent=2, sort_keys=True)
+        json.dump({"version": 2, "ids": sorted(ids)}, fh, indent=2)
         fh.write("\n")
-    return counts
+    return ids
 
 
 def apply_baseline(
-    findings: List[Finding], baseline: Dict[str, int]
+    findings: List[Finding], baseline: Baseline
 ) -> Tuple[List[Finding], List[Finding]]:
-    """Split findings into (errors, warnings): per (path, rule), up to the
-    baselined count are warnings (pre-existing debt), the rest are errors.
-    Counts (not line numbers) key the match so unrelated edits don't churn
-    the baseline file."""
-    budget = dict(baseline)
+    """Split findings into (errors, warnings).  v2 baselines match by
+    stable id (line-number independent); v1 baselines match per (path,
+    rule) up to the recorded count."""
     errors: List[Finding] = []
     warnings: List[Finding] = []
+    if isinstance(baseline, set):
+        for fid, f in assign_ids(findings):
+            (warnings if fid in baseline else errors).append(f)
+        return errors, warnings
+    budget = dict(baseline)
     for f in findings:
-        k = baseline_key(f)
+        k = f"{f.path}::{f.rule}"
         if budget.get(k, 0) > 0:
             budget[k] -= 1
             warnings.append(f)

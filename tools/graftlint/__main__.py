@@ -5,16 +5,23 @@
 # findings, 2 on usage errors.  Always prints the per-rule finding count so
 # CI logs show coverage even on green runs (ci/test.sh step 1).
 #
+# The CI gate is `--baseline ci/graftlint-baseline.json --fail-on-new`:
+# findings whose stable id (rule + path + symbol + message fingerprint —
+# NO line numbers, so unrelated edits don't churn it) is recorded in the
+# baseline demote to warnings; any NEW finding fails the build.
+#
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import List
 
 from . import (
     RULE_NAMES,
     apply_baseline,
+    assign_ids,
     lint_paths,
     load_baseline,
     write_baseline,
@@ -24,7 +31,7 @@ from . import (
 def main(argv: List[str] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="JAX/TPU invariant checks (R1-R10) — see docs/graftlint.md",
+        description="JAX/TPU invariant checks (R1-R12) — see docs/graftlint.md",
     )
     parser.add_argument("paths", nargs="+", help="files or package dirs to lint")
     parser.add_argument(
@@ -36,15 +43,31 @@ def main(argv: List[str] = None) -> int:
         "--baseline",
         default=None,
         metavar="FILE",
-        help="JSON baseline: findings up to the recorded per-(file, rule) "
-        "counts are demoted to warnings, so a new rule can land warn-only "
-        "before being promoted to an error",
+        help="JSON baseline: v2 ({version: 2, ids: [...]}) matches findings "
+        "by stable id; legacy v1 ({'<path>::<rule>': count}) matches per-"
+        "(file, rule) counts.  Matched findings demote to warnings",
+    )
+    parser.add_argument(
+        "--fail-on-new",
+        action="store_true",
+        help="CI mode: require a v2 (id-keyed) --baseline and fail only on "
+        "findings whose id is not recorded — the gate that makes every "
+        "NEW finding a build error while the audited debt stays visible "
+        "as warnings",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format; json emits {findings: [{id, rule, name, path, "
+        "line, func, message, baselined}], summary: {...}}",
     )
     parser.add_argument(
         "--write-baseline",
         default=None,
         metavar="FILE",
-        help="write the current findings as a baseline file and exit 0",
+        help="write the current findings as a v2 (id-keyed) baseline file "
+        "and exit 0",
     )
     args = parser.parse_args(argv)
 
@@ -54,6 +77,8 @@ def main(argv: List[str] = None) -> int:
         unknown = [r for r in rules if r not in RULE_NAMES]
         if unknown:
             parser.error(f"unknown rule(s): {', '.join(unknown)}")
+    if args.fail_on_new and not args.baseline:
+        parser.error("--fail-on-new requires --baseline")
 
     try:
         findings = lint_paths(args.paths, rules=rules)
@@ -62,10 +87,10 @@ def main(argv: List[str] = None) -> int:
         return 2
 
     if args.write_baseline:
-        counts = write_baseline(args.write_baseline, findings)
+        ids = write_baseline(args.write_baseline, findings)
         print(
-            f"graftlint: wrote baseline of {len(findings)} finding(s) "
-            f"across {len(counts)} (file, rule) key(s) to {args.write_baseline}"
+            f"graftlint: wrote baseline of {len(ids)} finding id(s) "
+            f"to {args.write_baseline}"
         )
         return 0
 
@@ -77,18 +102,51 @@ def main(argv: List[str] = None) -> int:
         except (OSError, ValueError) as e:
             print(f"graftlint: bad baseline: {e}", file=sys.stderr)
             return 2
+        if args.fail_on_new and not isinstance(baseline, set):
+            print(
+                "graftlint: --fail-on-new needs a v2 (id-keyed) baseline; "
+                "regenerate it with --write-baseline",
+                file=sys.stderr,
+            )
+            return 2
         errors, warnings = apply_baseline(findings, baseline)
+
+    per_rule = {r: 0 for r in RULE_NAMES}
+    for f in findings:
+        per_rule[f.rule] += 1
+
+    if args.format == "json":
+        warning_set = {id(w) for w in warnings}
+        payload = {
+            "findings": [
+                {
+                    "id": fid,
+                    "rule": f.rule,
+                    "name": f.name,
+                    "path": f.path,
+                    "line": f.line,
+                    "func": f.func,
+                    "message": f.message,
+                    "baselined": id(f) in warning_set,
+                }
+                for fid, f in assign_ids(findings)
+            ],
+            "summary": {
+                "per_rule": per_rule,
+                "errors": len(errors),
+                "warnings": len(warnings),
+            },
+        }
+        print(json.dumps(payload, indent=2))
+        return 1 if errors else 0
 
     for f in warnings:
         print(f"warning: {f.render()}")
     for f in errors:
         print(f.render())
-
-    per_rule = {r: 0 for r in RULE_NAMES}
-    for f in findings:
-        per_rule[f.rule] += 1
     summary = "  ".join(
-        f"{r}[{RULE_NAMES[r]}]={per_rule[r]}" for r in sorted(per_rule)
+        f"{r}[{RULE_NAMES[r]}]={per_rule[r]}"
+        for r in sorted(per_rule, key=lambda r: int(r[1:]))
     )
     status = "clean" if not errors else f"{len(errors)} error finding(s)"
     baselined = f", {len(warnings)} baselined warning(s)" if warnings else ""

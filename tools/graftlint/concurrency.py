@@ -1,0 +1,732 @@
+#
+# graftlint concurrency pass (R11/R12): whole-program lock-order and
+# shared-state analysis over the stdlib ast.
+#
+# Every rule before this one checks a single statement (or a single
+# function body).  Concurrency bugs do not live in single statements: a
+# lock-order inversion needs TWO nesting sites, usually in different
+# functions, and PR 13/15's review rounds found exactly those by hand
+# (the partial-sendall stream desync; the probe path stalled behind the
+# repack lock).  This pass is the lockdep/ThreadSanitizer move, ported to
+# review time:
+#
+#   R11 lock-order   (a) build the package-wide held->acquired graph from
+#                    `with self._lock:` blocks, explicit .acquire()/
+#                    .release() pairs, and interprocedural edges through
+#                    same-module calls, then flag every edge that sits on
+#                    a cycle — two threads driving the two nesting orders
+#                    deadlock.  (b) flag blocking operations performed
+#                    while a lock is held (socket recv/accept,
+#                    Future.result, foreign Condition.wait, cached_call/
+#                    AOT-compile waits, device_get/block_until_ready,
+#                    subprocess/sleep): every thread contending for that
+#                    lock stalls behind a wait that has nothing to do
+#                    with the state the lock guards — the exact shape of
+#                    PR 15's probe-stall finding.
+#   R12 shared-state an instance attribute written both under a lock and
+#                    with no lock held is a race against the guarded
+#                    readers; container mutation (append/pop/[k]=/update)
+#                    on an attribute whose writes are never guarded is
+#                    non-atomic even on CPython (the lock-free discipline
+#                    only covers atomic reference swaps).  Scoped to the
+#                    thread-spawning modules (serving/, parallel/,
+#                    ann/mutable.py, stream/session.py, watch.py).
+#
+# Honest limitations (documented in docs/graftlint.md#r11):
+#   - NO cross-module call edges: a lock graph edge forms only when both
+#     acquisitions are reachable inside one module.  Lock identities are
+#     module+class scoped, so a cross-module cycle is invisible — the
+#     runtime lockdep sanitizer (sanitize.lockdep_lock) covers that half.
+#   - NO alias analysis: a lock reaching a function as a parameter
+#     (netplane's _send_to(conn, lock, ...)) is untracked; `self._X` and
+#     module-level names are the only resolvable lock references.
+#   - Guardedness is lexical plus one interprocedural refinement: a
+#     helper whose every same-module call site holds lock L is analyzed
+#     as running under L (the `_locked` helper convention).
+#
+# Like every graftlint rule: deliberately under-approximate — a rule that
+# cries wolf gets pragma'd into noise.
+#
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .rules import ModuleIndex
+
+# (rule, path, line, message, func-qualname) — the cross-module pass must
+# carry the path per finding, unlike rules.FindingTuple.
+CCFinding = Tuple[str, str, int, str, str]
+
+_LOCK_CONSTRUCTORS = {"threading.Lock", "threading.RLock"}
+_CONDITION_CONSTRUCTOR = "threading.Condition"
+
+# methods that mutate a container in place — not an atomic reference swap
+_CONTAINER_MUTATORS = {
+    "append", "extend", "insert", "add", "remove", "discard", "pop",
+    "popleft", "popitem", "appendleft", "clear", "update", "setdefault",
+    "sort", "reverse",
+}
+
+# construction-time methods: single-threaded by contract, writes exempt
+_CTOR_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def r11_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "spark_rapids_ml_tpu/" in norm
+
+
+def r12_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return (
+        "spark_rapids_ml_tpu/serving/" in norm
+        or "spark_rapids_ml_tpu/parallel/" in norm
+        or norm.endswith("ann/mutable.py")
+        or norm.endswith("stream/session.py")
+        or norm.endswith("spark_rapids_ml_tpu/watch.py")
+    )
+
+
+# -- lock inventory -----------------------------------------------------------
+
+@dataclass
+class _LockDef:
+    key: str         # globally unique node: "<path>:<Class>.<attr>" / "<path>:<name>"
+    display: str     # what the message shows: "self._lock (MicroBatcher)" etc.
+
+
+@dataclass
+class _ClassLocks:
+    locks: Dict[str, _LockDef] = field(default_factory=dict)       # attr -> lock
+    conditions: Dict[str, str] = field(default_factory=dict)       # attr -> bound lock attr
+
+
+def _is_lock_call(call: ast.Call, index: ModuleIndex) -> bool:
+    name = index.dotted(call.func)
+    if name in _LOCK_CONSTRUCTORS:
+        return True
+    # the runtime sanitizer's named wrapper constructs (and is) the lock
+    return bool(name) and (name == "lockdep_lock" or name.endswith(".lockdep_lock"))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ModuleCC:
+    """Per-module lock inventory + per-function event summaries."""
+
+    def __init__(self, tree: ast.Module, index: ModuleIndex, path: str):
+        self.tree = tree
+        self.index = index
+        self.path = path
+        self.module_locks: Dict[str, _LockDef] = {}
+        self.module_conditions: Dict[str, str] = {}  # name -> bound module lock name
+        self.class_locks: Dict[str, _ClassLocks] = {}
+        self.functions: Dict[str, "_FuncSummary"] = {}
+        self._collect_locks()
+        self._collect_functions()
+
+    # lock definitions --------------------------------------------------
+    def _collect_locks(self) -> None:
+        # module-level: NAME = threading.Lock() / Condition(NAME)
+        for stmt in self.tree.body:
+            if not (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)):
+                continue
+            for t in stmt.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if _is_lock_call(stmt.value, self.index):
+                    self.module_locks[t.id] = _LockDef(
+                        key=f"{self.path}:{t.id}", display=t.id
+                    )
+                elif self.index.dotted(stmt.value.func) == _CONDITION_CONSTRUCTOR:
+                    if stmt.value.args and isinstance(stmt.value.args[0], ast.Name):
+                        self.module_conditions[t.id] = stmt.value.args[0].id
+                    else:
+                        # condition over its own implicit lock
+                        self.module_locks[t.id] = _LockDef(
+                            key=f"{self.path}:{t.id}", display=t.id
+                        )
+        # class-level: self._x = threading.Lock() anywhere in the class body
+        for cls_qual, cls in self._iter_classes(self.tree.body, ""):
+            cl = _ClassLocks()
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                    continue
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if _is_lock_call(node.value, self.index):
+                        cl.locks[attr] = _LockDef(
+                            key=f"{self.path}:{cls_qual}.{attr}",
+                            display=f"self.{attr} ({cls_qual})",
+                        )
+                    elif self.index.dotted(node.value.func) == _CONDITION_CONSTRUCTOR:
+                        bound = (
+                            _self_attr(node.value.args[0])
+                            if node.value.args
+                            else None
+                        )
+                        if bound is not None:
+                            cl.conditions[attr] = bound
+                        else:
+                            cl.locks[attr] = _LockDef(
+                                key=f"{self.path}:{cls_qual}.{attr}",
+                                display=f"self.{attr} ({cls_qual})",
+                            )
+            if cl.locks or cl.conditions:
+                self.class_locks[cls_qual] = cl
+
+    def _iter_classes(self, body, prefix: str):
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                qual = f"{prefix}{stmt.name}"
+                yield qual, stmt
+                yield from self._iter_classes(stmt.body, f"{qual}.")
+
+    # lock reference resolution -----------------------------------------
+    def resolve_lock(self, node: ast.AST, cls_qual: str) -> Optional[_LockDef]:
+        """LockDef a `with X:` / `X.acquire()` expression refers to, following
+        condition->lock binding; None when unresolvable (no alias analysis)."""
+        attr = _self_attr(node)
+        if attr is not None and cls_qual:
+            cl = self.class_locks.get(cls_qual)
+            if cl is None:
+                return None
+            if attr in cl.conditions:
+                attr = cl.conditions[attr]
+            return cl.locks.get(attr)
+        if isinstance(node, ast.Name):
+            name = node.id
+            if name in self.module_conditions:
+                name = self.module_conditions[name]
+            return self.module_locks.get(name)
+        return None
+
+    def condition_bound_lock(self, node: ast.AST, cls_qual: str) -> Optional[_LockDef]:
+        """LockDef a condition attribute is bound to, or None when `node` is
+        not a known condition."""
+        attr = _self_attr(node)
+        if attr is not None and cls_qual:
+            cl = self.class_locks.get(cls_qual)
+            if cl and attr in cl.conditions:
+                return cl.locks.get(cl.conditions[attr])
+            return None
+        if isinstance(node, ast.Name) and node.id in self.module_conditions:
+            return self.module_locks.get(self.module_conditions[node.id])
+        return None
+
+    # function summaries ------------------------------------------------
+    def _collect_functions(self) -> None:
+        # two phases: register every qualname FIRST so calls to methods
+        # defined later in the class body still resolve, then walk bodies
+        defs: List[Tuple[ast.AST, str, str]] = []
+
+        def visit(body, prefix: str, cls_qual: str) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{stmt.name}"
+                    defs.append((stmt, qual, cls_qual))
+                    # nested defs are separate threads of control: analyzed
+                    # with an empty held set of their own
+                    visit(stmt.body, f"{qual}.", cls_qual)
+                elif isinstance(stmt, ast.ClassDef):
+                    visit(stmt.body, f"{prefix}{stmt.name}.", f"{prefix}{stmt.name}")
+
+        visit(self.tree.body, "", "")
+        self._known_quals = {qual for _stmt, qual, _cls in defs}
+        for stmt, qual, cls_qual in defs:
+            self.functions[qual] = _FuncSummary(self, stmt, qual, cls_qual)
+
+    def resolve_callee(self, call: ast.Call, cls_qual: str) -> Optional[str]:
+        """Same-module callee qualname for `self.m(...)` / `f(...)`, else None."""
+        known = getattr(self, "_known_quals", set())
+        attr = _self_attr(call.func)
+        if attr is not None and cls_qual:
+            qual = f"{cls_qual}.{attr}"
+            return qual if qual in known else None
+        if isinstance(call.func, ast.Name) and call.func.id in known:
+            return call.func.id
+        return None
+
+
+@dataclass
+class _Block:
+    kind: str              # human label of the blocking class
+    held: Tuple[str, ...]  # held lock keys at the site ("" when from summary)
+    line: int
+
+
+class _FuncSummary:
+    """One pass over a function's own body (nested defs excluded), tracking
+    the lexically-held lock set."""
+
+    def __init__(self, mod: _ModuleCC, fn, qual: str, cls_qual: str):
+        self.mod = mod
+        self.fn = fn
+        self.qual = qual
+        self.cls_qual = cls_qual
+        # (acquired lock key, held keys at acquisition, line)
+        self.acquires: List[Tuple[str, Tuple[str, ...], int]] = []
+        # (callee qual, held keys, line)
+        self.calls: List[Tuple[str, Tuple[str, ...], int]] = []
+        # direct blocking ops (held may be empty: feeds the may-block summary)
+        self.blocks: List[_Block] = []
+        # (attr, kind 'rebind'|'container', op, held keys, line)
+        self.writes: List[Tuple[str, str, str, Tuple[str, ...], int]] = []
+        self._held: List[str] = []
+        self._explicit: List[str] = []
+        self._walk_stmts(fn.body)
+
+    # held-set helpers ---------------------------------------------------
+    def _held_keys(self) -> Tuple[str, ...]:
+        seen: List[str] = []
+        for k in self._held:
+            if k not in seen:
+                seen.append(k)
+        return tuple(seen)
+
+    def _acquire(self, lock: _LockDef, line: int) -> None:
+        if lock.key not in self._held:
+            self.acquires.append((lock.key, self._held_keys(), line))
+        self._held.append(lock.key)
+
+    # statement walk -----------------------------------------------------
+    def _walk_stmts(self, stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # separate thread of control
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr)
+                    lock = self.mod.resolve_lock(item.context_expr, self.cls_qual)
+                    if lock is not None:
+                        self._acquire(lock, item.context_expr.lineno)
+                        pushed += 1
+                self._walk_stmts(stmt.body)
+                for _ in range(pushed):
+                    self._held.pop()
+                continue
+            # explicit acquire()/release(): linear hold tracked to the
+            # matching release (or function end) — under-approximate on
+            # branches, exact on the straight-line idiom
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute) and call.func.attr in (
+                    "acquire",
+                    "release",
+                ):
+                    lock = self.mod.resolve_lock(call.func.value, self.cls_qual)
+                    if lock is not None:
+                        if call.func.attr == "acquire":
+                            self._acquire(lock, call.lineno)
+                            self._explicit.append(lock.key)
+                        elif lock.key in self._explicit:
+                            self._explicit.remove(lock.key)
+                            # drop the innermost matching hold
+                            for i in range(len(self._held) - 1, -1, -1):
+                                if self._held[i] == lock.key:
+                                    del self._held[i]
+                                    break
+                        continue
+            # compound statements: recurse into bodies with the same held set
+            for name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, name, None)
+                if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+                    self._walk_stmts(sub)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._walk_stmts(h.body)
+            # expressions hanging off this statement (tests, iterables,
+            # values of simple statements) — but not nested suites
+            for node in ast.iter_child_nodes(stmt):
+                if isinstance(node, (ast.stmt, ast.ExceptHandler)):
+                    continue
+                self._scan_expr(node)
+            if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                self._scan_write(stmt)
+            if isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr is not None:
+                            self.writes.append(
+                                (attr, "container", "del [k]",
+                                 self._held_keys(), stmt.lineno)
+                            )
+
+    # write classification (R12) ----------------------------------------
+    def _scan_write(self, stmt) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                op = "=" if isinstance(stmt, ast.Assign) else "aug-assign"
+                self.writes.append(
+                    (attr, "rebind", op, self._held_keys(), stmt.lineno)
+                )
+                continue
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr is not None:
+                    self.writes.append(
+                        (attr, "container", "[k] =",
+                         self._held_keys(), stmt.lineno)
+                    )
+            if isinstance(t, ast.Tuple):
+                for el in t.elts:
+                    a = _self_attr(el)
+                    if a is not None:
+                        self.writes.append(
+                            (a, "rebind", "=", self._held_keys(), stmt.lineno)
+                        )
+
+    # expression scan: calls (edges, blocking, container mutators) ------
+    def _scan_expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                continue  # deferred body: not executed at this point
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.mod.resolve_callee(node, self.cls_qual)
+            if callee is not None:
+                self.calls.append((callee, self._held_keys(), node.lineno))
+            if isinstance(node.func, ast.Attribute):
+                attr_name = node.func.attr
+                recv_attr = _self_attr(node.func.value)
+                if (
+                    attr_name in _CONTAINER_MUTATORS
+                    and recv_attr is not None
+                ):
+                    self.writes.append(
+                        (recv_attr, "container", f".{attr_name}()",
+                         self._held_keys(), node.lineno)
+                    )
+            blocked = self._classify_blocking(node)
+            if blocked is not None:
+                self.blocks.append(
+                    _Block(kind=blocked, held=self._held_keys(), line=node.lineno)
+                )
+
+    def _classify_blocking(self, call: ast.Call) -> Optional[str]:
+        """Label of the blocking-op class this call belongs to, or None.
+        A .wait() on a condition bound to the ONLY held lock is the
+        sanctioned wait-releases-the-lock idiom and is exempt."""
+        name = self.mod.index.dotted(call.func)
+        if name == "time.sleep":
+            return "time.sleep()"
+        if name == "jax.device_get":
+            return "jax.device_get() (device->host sync)"
+        if name and (name.startswith("subprocess.") or name == "subprocess"):
+            return f"{name}() (subprocess)"
+        if name and (name == "cached_call" or name.endswith(".cached_call")):
+            return "cached_call() (AOT compile wait)"
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        if attr == "block_until_ready":
+            return ".block_until_ready() (device sync)"
+        if attr == "result":
+            return ".result() (Future wait)"
+        if attr in ("recv", "recv_into", "accept"):
+            return f".{attr}() (socket wait)"
+        if attr == "wait":
+            bound = self.mod.condition_bound_lock(call.func.value, self.cls_qual)
+            held = self._held_keys()
+            if bound is not None and held == (bound.key,):
+                return None  # cond.wait() releases the one lock it guards
+            return ".wait() (blocking wait)"
+        return None
+
+
+# -- the package-wide pass ----------------------------------------------------
+
+@dataclass
+class ParsedModule:
+    path: str
+    tree: ast.Module
+    index: ModuleIndex
+
+
+def _display_of(mods: List[_ModuleCC]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for m in mods:
+        for d in m.module_locks.values():
+            out[d.key] = d.display
+        for cl in m.class_locks.values():
+            for d in cl.locks.values():
+                out[d.key] = d.display
+    return out
+
+
+def _fixpoint_sets(
+    functions: Dict[str, _FuncSummary],
+    seed: Dict[str, Set],
+) -> Dict[str, Set]:
+    """Transitive closure of per-function sets through same-module calls."""
+    result = {q: set(s) for q, s in seed.items()}
+    changed = True
+    while changed:
+        changed = False
+        for qual, fn in functions.items():
+            acc = result[qual]
+            before = len(acc)
+            for callee, _held, _line in fn.calls:
+                if callee != qual:
+                    acc |= result.get(callee, set())
+            if len(acc) != before:
+                changed = True
+    return result
+
+
+def _context_held(functions: Dict[str, _FuncSummary]) -> Dict[str, Set[str]]:
+    """Locks PROVABLY held at every same-module call site of a function
+    (the `_locked` helper convention): meet-over-call-sites fixpoint with
+    optimistic top; functions with no in-module callers get the empty set."""
+    callers: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {q: [] for q in functions}
+    for qual, fn in functions.items():
+        for callee, held, _line in fn.calls:
+            if callee in callers and callee != qual:
+                callers[callee].append((qual, held))
+    TOP = None  # lattice top: "every lock" (unknown yet)
+    ctx: Dict[str, Optional[Set[str]]] = {
+        q: (set() if not callers[q] else TOP) for q in functions
+    }
+    for _ in range(len(functions) + 1):
+        changed = False
+        for qual in functions:
+            if not callers[qual]:
+                continue
+            met: Optional[Set[str]] = TOP
+            for caller, held in callers[qual]:
+                caller_ctx = ctx.get(caller) or set()
+                site = set(held) | caller_ctx
+                met = site if met is None else (met & site)
+            if met is None:
+                met = set()
+            if ctx[qual] is None or met != ctx[qual]:
+                ctx[qual] = met
+                changed = True
+        if not changed:
+            break
+    return {q: (s or set()) for q, s in ctx.items()}
+
+
+def lint_concurrency(
+    modules: Iterable[ParsedModule], selected: Set[str]
+) -> List[CCFinding]:
+    """Run R11/R12 over a set of parsed modules as ONE program: lock nodes
+    are module+class scoped, edges merge into a single held->acquired graph,
+    and every edge on a cycle is reported at each witness site."""
+    findings: List[CCFinding] = []
+    mods = [
+        _ModuleCC(pm.tree, pm.index, pm.path)
+        for pm in modules
+        if r11_applies(pm.path) or r12_applies(pm.path)
+    ]
+    if not mods:
+        return findings
+    display = _display_of(mods)
+
+    def show(key: str) -> str:
+        return display.get(key, key)
+
+    # -- R11(a): the held->acquired graph --------------------------------
+    # edge (held, acquired) -> witness sites (path, line, func, via)
+    edges: Dict[Tuple[str, str], List[Tuple[str, int, str, str]]] = {}
+    if "R11" in selected:
+        for m in mods:
+            if not r11_applies(m.path):
+                continue
+            may_acquire = _fixpoint_sets(
+                m.functions,
+                {q: {a for a, _h, _l in fn.acquires}
+                 for q, fn in m.functions.items()},
+            )
+            for qual, fn in m.functions.items():
+                for lock, held, line in fn.acquires:
+                    for h in held:
+                        if h != lock:
+                            edges.setdefault((h, lock), []).append(
+                                (m.path, line, qual, "")
+                            )
+                for callee, held, line in fn.calls:
+                    if not held:
+                        continue
+                    for lock in may_acquire.get(callee, ()):
+                        for h in held:
+                            if h != lock:
+                                edges.setdefault((h, lock), []).append(
+                                    (m.path, line, qual, callee)
+                                )
+        # cycle detection: an edge is an inversion witness when the
+        # acquired lock can reach the held lock through other edges
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            stack, seen = [src], {src}
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                for nxt in adj.get(n, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            return False
+
+        for (a, b), sites in sorted(edges.items()):
+            if not reaches(b, a):
+                continue
+            # name one counter-witness so the message shows both orders
+            counter = None
+            for (c, d), csites in edges.items():
+                if c == b and reaches(d, a):
+                    counter = (c, d, csites[0])
+                    break
+            for path, line, qual, via in sites:
+                how = f" (via call to {via}())" if via else ""
+                if counter:
+                    # name the counter-witness by FUNCTION, not line: the
+                    # message feeds the stable finding id, which must
+                    # survive unrelated edits shifting code up or down
+                    cpath, cqual = counter[2][0], counter[2][2]
+                    other = (
+                        f"{show(counter[0])} -> {show(counter[1])} in "
+                        f"{cpath}::{cqual or '<module>'}"
+                    )
+                else:  # pragma: no cover - counter edge always exists on a cycle
+                    other = "the reverse order elsewhere"
+                findings.append((
+                    "R11",
+                    path,
+                    line,
+                    f"lock-order inversion: {show(a)} is held while "
+                    f"acquiring {show(b)} here{how}, but {other} closes a "
+                    "cycle — two threads driving both orders deadlock; "
+                    "pick ONE nesting order and document it "
+                    "(docs/graftlint.md#r11)",
+                    qual,
+                ))
+
+    # -- R11(b): blocking ops under a held lock --------------------------
+    if "R11" in selected:
+        for m in mods:
+            if not r11_applies(m.path):
+                continue
+            may_block = _fixpoint_sets(
+                m.functions,
+                {q: {b.kind for b in fn.blocks}
+                 for q, fn in m.functions.items()},
+            )
+            for qual, fn in m.functions.items():
+                for b in fn.blocks:
+                    if not b.held:
+                        continue
+                    locks = ", ".join(show(k) for k in b.held)
+                    findings.append((
+                        "R11",
+                        m.path,
+                        b.line,
+                        f"blocking {b.kind} while holding {locks}: every "
+                        "thread contending for the lock stalls behind a "
+                        "wait unrelated to the state it guards — move the "
+                        "wait outside the critical section "
+                        "(docs/graftlint.md#r11)",
+                        qual,
+                    ))
+                for callee, held, line in fn.calls:
+                    if not held:
+                        continue
+                    kinds = may_block.get(callee, set())
+                    if not kinds:
+                        continue
+                    locks = ", ".join(show(k) for k in held)
+                    findings.append((
+                        "R11",
+                        m.path,
+                        line,
+                        f"call to {callee}() while holding {locks} reaches "
+                        f"a blocking {sorted(kinds)[0]} — every thread "
+                        "contending for the lock stalls behind it; move "
+                        "the wait outside the critical section "
+                        "(docs/graftlint.md#r11)",
+                        qual,
+                    ))
+
+    # -- R12: shared-state write discipline ------------------------------
+    if "R12" in selected:
+        for m in mods:
+            if not r12_applies(m.path):
+                continue
+            ctx = _context_held(m.functions)
+            # group writes per class attr
+            per_class: Dict[str, Dict[str, List[Tuple[str, str, Tuple[str, ...], int, str, bool]]]] = {}
+            for qual, fn in m.functions.items():
+                if not fn.cls_qual or fn.cls_qual not in m.class_locks:
+                    continue  # no lock in the class: nothing claims guarding
+                if not m.class_locks[fn.cls_qual].locks:
+                    continue
+                method = qual.rsplit(".", 1)[-1]
+                if method in _CTOR_METHODS:
+                    continue  # construction is single-threaded by contract
+                for attr, kind, op, held, line in fn.writes:
+                    guarded = bool(held) or bool(ctx.get(qual))
+                    per_class.setdefault(fn.cls_qual, {}).setdefault(
+                        attr, []
+                    ).append((kind, op, held, line, qual, guarded))
+            for cls_qual, attrs in sorted(per_class.items()):
+                lock_names = ", ".join(
+                    f"self.{a}" for a in sorted(m.class_locks[cls_qual].locks)
+                )
+                for attr, writes in sorted(attrs.items()):
+                    if attr in m.class_locks[cls_qual].locks:
+                        continue  # rebinding the lock itself: not state
+                    guarded_sites = [w for w in writes if w[5]]
+                    unguarded = [w for w in writes if not w[5]]
+                    if guarded_sites and unguarded:
+                        g = guarded_sites[0]
+                        for kind, op, _held, line, qual, _ in unguarded:
+                            findings.append((
+                                "R12",
+                                m.path,
+                                line,
+                                f"self.{attr} is written under a lock at "
+                                f"{m.path}:{g[3]} but written here with no "
+                                "lock held — the unguarded write races "
+                                "every reader that trusts the lock "
+                                "(docs/graftlint.md#r12)",
+                                qual,
+                            ))
+                    elif unguarded and not guarded_sites:
+                        for kind, op, _held, line, qual, _ in unguarded:
+                            if kind != "container":
+                                continue
+                            findings.append((
+                                "R12",
+                                m.path,
+                                line,
+                                f"non-atomic {op} mutation of lock-free "
+                                f"attribute self.{attr} (class {cls_qual} "
+                                f"owns {lock_names}): in-place container "
+                                "mutation is not an atomic reference swap "
+                                "— guard it, or build a fresh container "
+                                "and swap the reference "
+                                "(docs/graftlint.md#r12)",
+                                qual,
+                            ))
+    findings.sort(key=lambda f: (f[1], f[2], f[0]))
+    return findings

@@ -23,7 +23,13 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10")
+# R11/R12 (the concurrency pass) live in concurrency.py: they analyze a SET
+# of modules as one program, unlike the per-module rules in this file.
+RULES = (
+    "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10", "R11", "R12",
+)
+PER_MODULE_RULES = RULES[:10]
+CONCURRENCY_RULES = ("R11", "R12")
 
 FindingTuple = Tuple[str, int, str, str]  # (rule, line, message, func-qualname)
 
