@@ -711,6 +711,12 @@ def run_arm(algo: str, overrides, repeats: int):
         "times_sec": [round(t, 3) for t in times],
         "cold_sec": round(cold, 3),
         "repeats": repeats,  # can exceed the global knob (ARM_MIN_REPEATS)
+        # backend tag (standings.py): a builder round that fell back to the
+        # CPU backend measures different shapes on different silicon — it
+        # must never be scored against the accelerator floor or diffed
+        # against an accelerator round (r06_builder_cycle.json is the
+        # motivating capture)
+        "backend": __import__("jax").devices()[0].platform,
     }
     # per-arm exchange byte totals (parallel/exchange section counters):
     # host sections count per call, device sections per compiled geometry

@@ -1,0 +1,385 @@
+#
+# srml-lanes multiplex benchmark: sustained QPS at a fixed p99 SLO as the
+# number of co-served model variants K grows (docs/serving.md §multiplex).
+#
+# The claim under test: because K same-shape variants share ONE lane-batched
+# kernel per micro-batch (requests routed model_id -> lane through the shared
+# micro-batcher), serving K tenants costs one dispatch plane, not K — so the
+# sustained-QPS-at-SLO curve over K = 1, 8, 64, 512 should be flat-ish where
+# K dedicated servers would pay K dispatch workers and K warmed parameter
+# buffers.  The headline search is the same bracket-double + binary-search
+# discipline as bench_serving --headline, scored CLIENT-side (submit wall
+# clock to future resolution) on a mixed-tenant open-loop stream.
+#
+#   --headline     max sustained QPS at --slo_ms for each --ks entry
+#   --paging       registered >> resident: a zipf-skewed tenant stream over
+#                  --registered variants on a --resident lane budget,
+#                  reporting page-in latency percentiles, lane hit rate,
+#                  and achieved throughput (the HBM paging price, measured)
+#
+# Records append to --report_path (benchmark/results/*.jsonl) with the
+# `backend` tag standings.py keys on — a CPU smoke round must never be
+# read as an accelerator number.
+#
+# CPU smoke (the ci/test.sh step-3r shape):
+#   python -m benchmark.bench_multiplex --headline --ks 1,8 \
+#       --duration 0.5 --slo_ms 200 --report_path /tmp/mux.jsonl
+#
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from spark_rapids_ml_tpu import profiling
+from spark_rapids_ml_tpu.serving import MultiplexServer, ServerOverloaded
+
+from .bench_serving import _pctile_ms
+from .utils import append_report
+
+
+def _backend() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def build_variants(k: int, n_cols: int, seed: int = 7) -> Dict[str, Any]:
+    """K same-shape linear models straight from synthetic coefficients —
+    the serving path is what this benchmark measures, and constructing
+    512 fitted-model objects beats fitting 512 times."""
+    from spark_rapids_ml_tpu.models.linear_regression import (
+        LinearRegressionModel,
+    )
+
+    rng = np.random.default_rng(seed)
+    return {
+        f"m{i:04d}": LinearRegressionModel(
+            coef_=rng.standard_normal(n_cols).astype(np.float64),
+            intercept_=float(rng.standard_normal()),
+            n_cols=n_cols,
+            dtype="float32",
+        )
+        for i in range(k)
+    }
+
+
+class _MuxClient:
+    """Client-side latency recorder over one MultiplexServer: submit wall
+    clock to future RESOLUTION, so micro-batch coalescing and the lane
+    page-in wait are inside the measurement (the tenant's truth)."""
+
+    def __init__(self, server: MultiplexServer):
+        self.server = server
+        self.latencies: List[float] = []
+        self.errors = 0
+        self.shed = 0
+        self._lock = threading.Lock()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.latencies, self.errors, self.shed = [], 0, 0
+
+    def submit(self, features: np.ndarray, model_id: str,
+               timeout_ms: float) -> bool:
+        t0 = time.perf_counter()
+        try:
+            fut = self.server.submit(
+                features, timeout_ms=timeout_ms or None, model_id=model_id
+            )
+        except ServerOverloaded:
+            with self._lock:
+                self.shed += 1
+            return False
+
+        def _done(f, t0=t0):
+            t1 = time.perf_counter()
+            with self._lock:
+                if f.cancelled() or f.exception() is not None:
+                    self.errors += 1
+                else:
+                    self.latencies.append(t1 - t0)
+
+        fut.add_done_callback(_done)
+        return True
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lats = sorted(self.latencies)
+            errors, shed = self.errors, self.shed
+        return {
+            "completed": len(lats),
+            "errors": errors,
+            "shed": shed,
+            "p50_ms": _pctile_ms(lats, 0.50),
+            "p99_ms": _pctile_ms(lats, 0.99),
+            "max_ms": round((lats[-1] if lats else 0.0) * 1e3, 3),
+        }
+
+
+def _open_loop(client: _MuxClient, X: np.ndarray, tenant_ids: np.ndarray,
+               rate: float, duration_s: float, rows_per_request: int,
+               timeout_ms: float) -> Dict[str, Any]:
+    """One open-loop window: arrivals on a fixed schedule, each request
+    routed to its pre-drawn tenant; waits for every admitted request."""
+    client.reset()
+    n_requests = max(1, int(rate * duration_s))
+    interarrival = 1.0 / rate
+    rng = np.random.default_rng(17)
+    idx = rng.integers(0, X.shape[0] - rows_per_request + 1, size=n_requests)
+    late = 0
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        target = t0 + i * interarrival
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        elif now - target > interarrival:
+            late += 1
+        client.submit(
+            X[idx[i] : idx[i] + rows_per_request],
+            str(tenant_ids[i % len(tenant_ids)]),
+            timeout_ms,
+        )
+    deadline = time.perf_counter() + 30.0
+    while time.perf_counter() < deadline:
+        snap = client.snapshot()
+        if snap["completed"] + snap["errors"] + snap["shed"] >= n_requests:
+            break
+        time.sleep(0.01)
+    elapsed = time.perf_counter() - t0
+    rec = client.snapshot()
+    rec.update(
+        offered_rps=round(rate, 1),
+        requests=n_requests,
+        duration_sec=round(elapsed, 3),
+        late_arrivals=late,
+        throughput_rps=round(rec["completed"] / elapsed, 1),
+    )
+    return rec
+
+
+def find_max_qps(client: _MuxClient, X: np.ndarray, tenant_ids: np.ndarray,
+                 slo_ms: float, duration_s: float, rows_per_request: int,
+                 timeout_ms: float, start_rate: float = 32.0,
+                 max_rate: float = 100_000.0,
+                 search_iters: int = 5) -> Dict[str, Any]:
+    """Max sustained QPS at the p99 SLO over the mixed-tenant stream —
+    bracket-double until a probe fails, then binary-search; "sustained"
+    is the strict reading (p99 <= SLO, zero sheds/errors, every request
+    completed), same as the bench_serving headline."""
+    def probe(rate: float) -> Dict[str, Any]:
+        rec = _open_loop(client, X, tenant_ids, rate, duration_s,
+                         rows_per_request, timeout_ms)
+        rec["sustained"] = bool(
+            rec["p99_ms"] <= slo_ms
+            and rec["shed"] == 0
+            and rec["errors"] == 0
+            and rec["completed"] == rec["requests"]
+        )
+        return rec
+
+    probes = [probe(start_rate)]
+    if not probes[0]["sustained"]:
+        return {
+            "max_sustained_qps": 0.0, "slo_ms": slo_ms,
+            "probes": len(probes), "floor_rate_failed": start_rate,
+            "floor_p99_ms": probes[0]["p99_ms"],
+        }
+    lo, hi, rate = start_rate, None, start_rate
+    while hi is None and rate < max_rate:
+        rate *= 2.0
+        rec = probe(rate)
+        probes.append(rec)
+        if rec["sustained"]:
+            lo = rate
+        else:
+            hi = rate
+    if hi is None:
+        hi = rate
+    for _ in range(search_iters):
+        if hi / lo <= 1.1:
+            break
+        mid = (lo * hi) ** 0.5
+        rec = probe(mid)
+        probes.append(rec)
+        if rec["sustained"]:
+            lo = mid
+        else:
+            hi = mid
+    best = max((p for p in probes if p["sustained"]),
+               key=lambda p: p["offered_rps"])
+    return {
+        "max_sustained_qps": best["offered_rps"],
+        "slo_ms": slo_ms,
+        "p99_ms_at_max": best["p99_ms"],
+        "p50_ms_at_max": best["p50_ms"],
+        "throughput_rps_at_max": best["throughput_rps"],
+        "probes": len(probes),
+    }
+
+
+def run_headline(args) -> None:
+    """Sustained QPS at the p99 SLO vs K co-served variants: the
+    multiplex scaling curve, one record per K."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((4096, args.num_cols)).astype(np.float32)
+    backend = _backend()
+    curve: Dict[int, float] = {}
+    for k in [int(s) for s in args.ks.split(",") if s]:
+        models = build_variants(k, args.num_cols)
+        tenant_ids = np.array(sorted(models))
+        t0 = time.perf_counter()
+        server = MultiplexServer(
+            f"mux_k{k}", models,
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth,
+        )
+        warm_sec = time.perf_counter() - t0
+        try:
+            client = _MuxClient(server)
+            # rinse window (unscored): thread spin-up + first page touches
+            _open_loop(client, X, tenant_ids, 32.0, min(0.5, args.duration),
+                       args.rows_per_request, args.timeout_ms)
+            rec = find_max_qps(
+                client, X, tenant_ids, args.slo_ms, args.duration,
+                args.rows_per_request, args.timeout_ms,
+            )
+            if not args.no_assert_steady:
+                server.drain()
+                server.assert_steady_state()
+            snap = server.lanes()
+        finally:
+            server.shutdown()
+        rec.update(
+            metric="multiplex_max_sustained_qps_at_p99_slo",
+            mode="multiplex",
+            backend=backend,
+            k_variants=k,
+            n_lanes=snap["n_lanes"],
+            warmup_sec=round(warm_sec, 2),
+            rows_per_request=args.rows_per_request,
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+        )
+        curve[k] = rec["max_sustained_qps"]
+        print(
+            f"== multiplex headline K={k}: max sustained "
+            f"{rec['max_sustained_qps']} req/s at p99<={args.slo_ms}ms "
+            f"(p99 {rec.get('p99_ms_at_max')}ms, {rec['probes']} probes, "
+            f"{snap['n_lanes']} lanes, warm {warm_sec:.1f}s)"
+        )
+        append_report(args.report_path, rec)
+    ks = sorted(curve)
+    if len(ks) >= 2 and curve[ks[0]]:
+        k0, kN = ks[0], ks[-1]
+        print(
+            f"== scaling: K={kN} sustains {curve[kN]} vs K={k0} "
+            f"{curve[k0]} req/s at equal SLO "
+            f"({curve[kN] / curve[k0]:.2f}x of the K={k0} rate for "
+            f"{kN // max(1, k0)}x the tenants)"
+        )
+
+
+def run_paging(args) -> None:
+    """registered >> resident: a zipf-skewed tenant stream forces steady
+    page-in/eviction churn; the record carries page-in latency
+    percentiles, the lane hit rate, and delivered throughput."""
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((4096, args.num_cols)).astype(np.float32)
+    models = build_variants(args.registered, args.num_cols)
+    ids = np.array(sorted(models))
+    # zipf-skew the tenant draw (bounded to the registered set): real
+    # multi-tenant traffic is head-heavy, which is exactly what an LRU
+    # lane budget exploits — the hit rate IS the locality captured
+    draw = np.minimum(
+        rng.zipf(1.3, size=max(4096, int(args.rate * args.duration))) - 1,
+        len(ids) - 1,
+    )
+    tenant_ids = ids[draw]
+    server = MultiplexServer(
+        "mux_paged", models,
+        resident_lanes=args.resident,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+    )
+    try:
+        client = _MuxClient(server)
+        rec = _open_loop(client, X, tenant_ids, args.rate, args.duration,
+                         args.rows_per_request, args.timeout_ms)
+        server.drain()
+        if not args.no_assert_steady:
+            server.assert_steady_state()  # page-ins are zero new compiles
+        snap = server.lanes()
+    finally:
+        server.shutdown()
+    touched = snap["hits"] + snap["page_in"]
+    page_lat = snap["page_in_latency"]
+    rec.update(
+        metric="multiplex_paging",
+        mode="multiplex",
+        backend=_backend(),
+        registered=args.registered,
+        resident_lanes=snap["n_lanes"],
+        lane_hit_rate=round(snap["hits"] / touched, 4) if touched else 1.0,
+        page_ins=snap["page_in"],
+        evictions=snap["evictions"],
+        page_in_p50_ms=round(page_lat.get("p50", 0.0) * 1e3, 3),
+        page_in_p99_ms=round(page_lat.get("p99", 0.0) * 1e3, 3),
+        page_in_max_ms=round(page_lat.get("max", 0.0) * 1e3, 3),
+    )
+    print(
+        f"== paging {args.registered} variants on {snap['n_lanes']} lanes "
+        f"at {args.rate} req/s: hit rate {rec['lane_hit_rate']:.1%}, "
+        f"{rec['page_ins']} page-ins (p50 {rec['page_in_p50_ms']}ms, "
+        f"p99 {rec['page_in_p99_ms']}ms), "
+        f"throughput {rec['throughput_rps']} req/s, p99 {rec['p99_ms']}ms"
+    )
+    append_report(args.report_path, rec)
+
+
+def main(argv: List[str] = None) -> None:
+    p = argparse.ArgumentParser(
+        description="srml-lanes multiplexed-serving benchmark"
+    )
+    p.add_argument("--headline", action="store_true",
+                   help="sustained QPS at the p99 SLO for each --ks entry")
+    p.add_argument("--paging", action="store_true",
+                   help="registered >> resident paging run (page-in latency "
+                        "+ hit rate)")
+    p.add_argument("--ks", type=str, default="1,8,64,512",
+                   help="variant counts the --headline curve sweeps")
+    p.add_argument("--slo_ms", type=float, default=50.0)
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="seconds per probe window")
+    p.add_argument("--rate", type=float, default=200.0,
+                   help="offered req/s for --paging")
+    p.add_argument("--registered", type=int, default=64,
+                   help="registered variants for --paging")
+    p.add_argument("--resident", type=int, default=4,
+                   help="resident lane budget for --paging")
+    p.add_argument("--num_cols", type=int, default=16)
+    p.add_argument("--rows_per_request", type=int, default=1)
+    p.add_argument("--max_batch", type=int, default=256)
+    p.add_argument("--max_wait_ms", type=float, default=5.0)
+    p.add_argument("--queue_depth", type=int, default=4096)
+    p.add_argument("--timeout_ms", type=float, default=0.0)
+    p.add_argument("--report_path", type=str, default="")
+    p.add_argument("--no_assert_steady", action="store_true")
+    args = p.parse_args(argv)
+    if not args.headline and not args.paging:
+        args.headline = True
+    if args.headline:
+        run_headline(args)
+    if args.paging:
+        run_paging(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,11 @@ SPREAD_BUDGET_PCT = 15.0
 # observable — a silent 10% slide per round compounds into a halved system)
 REGRESSION_BUDGET_PCT = 10.0
 
+# bench.py's CPU-fallback column count: legacy artifacts predate the
+# explicit per-arm `backend` tag, and the shape label is the only trace of
+# the backend they ran on (accelerator defaults are d3000)
+CPU_DEFAULT_SHAPE = "_d256"
+
 
 def newest_artifact() -> str:
     paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
@@ -89,23 +94,55 @@ def load_arms(path: str):
     return doc, arms
 
 
-def _prev_pointer(path: str, doc: dict) -> str:
+def backend_of(arms: dict) -> str:
+    """Backend tag of a captured round: the explicit per-arm `backend`
+    field (bench.py stamps jax's platform on every arm record), with the
+    documented legacy fallback — rounds that predate the field are typed
+    by their shape label, since bench.py's CPU-default shapes carry d256
+    where accelerator defaults carry d3000 (r06_builder_cycle.json is the
+    CPU capture this distinguishes)."""
+    for a in arms.values():
+        if isinstance(a, dict) and a.get("backend"):
+            return str(a["backend"])
+    metric = arms.get("kmeans", {}).get("metric", "")
+    if CPU_DEFAULT_SHAPE in metric:
+        return "cpu"
+    return "tpu"
+
+
+def _prev_pointer(path: str, doc: dict, backend: str = "") -> str:
     """Basename of the round this artifact should be diffed against:
     the `prev_round` pointer bench.py embeds (read from the already-loaded
     `doc`), falling back — for older or tail-truncated artifacts (the
     pointer rides the headline prefix the tail capture loses) — to the
-    file immediately before `path` in sort order."""
+    file immediately before `path` in sort order.  When `backend` is
+    given, rounds captured on a DIFFERENT backend are skipped (walking
+    further back as needed): a CPU builder round diffed against an
+    accelerator round compares silicon, not code."""
     parsed = doc.get("parsed", doc) or {}
-    prev = parsed.get("prev_round")
-    if prev and os.path.exists(os.path.join(REPO, prev)):
-        return prev
     paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
     names = [os.path.basename(p) for p in paths]
     base = os.path.basename(path)
+    candidates = []
+    prev = parsed.get("prev_round")
+    if prev and os.path.exists(os.path.join(REPO, prev)):
+        candidates.append(prev)
     if base in names:
         i = names.index(base)
-        if i > 0:
-            return names[i - 1]
+        candidates.extend(reversed(names[:i]))
+    seen = set()
+    for name in candidates:
+        if name in seen:
+            continue
+        seen.add(name)
+        if not backend:
+            return name
+        try:
+            prev_arms = load_arms(os.path.join(REPO, name))[1]
+        except (OSError, ValueError, SystemExit):
+            continue
+        if backend_of(prev_arms) == backend:
+            return name
     return ""
 
 
@@ -155,7 +192,9 @@ def _shape_note(metric: str) -> str:
 
 def render(path: str) -> str:
     doc, arms = load_arms(path)
-    prev_name = _prev_pointer(path, doc)
+    backend = backend_of(arms)
+    on_accel = backend != "cpu"
+    prev_name = _prev_pointer(path, doc, backend)
     prev_arms: dict = {}
     if prev_name:
         try:
@@ -178,7 +217,7 @@ def render(path: str) -> str:
         f"Generated by `python -m benchmark.standings` from "
         f"`{os.path.basename(path)}` "
         f"({n_driver} captured run(s); arm medians of "
-        f"{n_timed} timed calls each"
+        f"{n_timed} timed calls each; backend `{backend}`"
         f"). Do not edit the table by hand.",
         "",
         f"| arm | shape | rows/s (median) | vs reference GPU cluster | {vs_prev} | spread | bytes moved | cold first call |",
@@ -204,9 +243,15 @@ def render(path: str) -> str:
             flagged.append(name)
         cold = f"{a['cold_sec']:.1f} s" if "cold_sec" in a else "—"
         moved = _bytes_cell(a)
+        # a CPU-backend round is EXCLUDED from the accelerator-floor
+        # comparison: the vs_baseline multiple normalizes against the
+        # reference's GPU-cluster times, and a CPU fallback run (different
+        # shapes, different silicon) scored against it reads as a
+        # regression that never happened (r06_builder_cycle.json)
+        vs_cell = f"**{vsb:.2f}×**{floor}" if on_accel else "— (cpu round)"
         lines.append(
             f"| {name} | {_shape_note(a['metric'])} | {val} "
-            f"| **{vsb:.2f}×**{floor} | {delta} | {spread} | {moved} | {cold} |"
+            f"| {vs_cell} | {delta} | {spread} | {moved} | {cold} |"
         )
     if regressed:
         lines += [
@@ -245,6 +290,15 @@ def render(path: str) -> str:
     ]
     if notes:
         lines += ["", "Measurement assumptions carried by the artifact:", *notes]
+    if not on_accel:
+        lines += [
+            "",
+            "⚠ this round ran on the CPU backend (accelerator "
+            "unreachable from the builder): `vs reference GPU cluster` "
+            "is not scored, and `Δ vs prev` only compares against other "
+            "CPU-backend rounds — accelerator-floor standings resume at "
+            "the next driver round on accelerator hardware.",
+        ]
     lines += [
         "",
         "`bytes moved` totals the arm's `exchange.<section>.bytes` "
@@ -255,8 +309,11 @@ def render(path: str) -> str:
         "exchange's ~n_dev× reduction is visible round over round.",
         "",
         "`Δ vs prev` compares each arm's rows/s against the previous "
-        "captured round (the artifact's `prev_round` pointer, emitted by "
-        "bench.py; older artifacts fall back to file order) — positive is "
+        "captured round ON THE SAME BACKEND (the artifact's `prev_round` "
+        "pointer, emitted by bench.py; older artifacts fall back to file "
+        "order, and rounds whose `backend` tag differs are skipped — a "
+        "CPU builder fallback never diffs against an accelerator round) "
+        "— positive is "
         f"faster, and more than {REGRESSION_BUDGET_PCT:.0f}% slower earns "
         "the regression flag, so the bench trajectory is itself "
         "observable.",

@@ -588,6 +588,89 @@ SRML_SANITIZE=lockdep XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_serving.py -q \
     -k "shield or worker_death or wedge_then or drain_during or budget or rolls_up"
 
+# 3q. srml-lanes gates (also inside the full suite; re-asserted by name
+#     so marker drift can never silently drop them — docs/serving.md
+#     §multiplex):
+#     - lane engine: pow2 bucket edges (K=1, non-pow2 K), duplicate-lane
+#       padding, and the compile-count gate — growing K across a pow2
+#       bucket boundary compiles exactly once, zero within a bucket
+#     - multiplex: per-tenant outputs bitwise-equal to dedicated servers
+#       for every lane-served model family, paging parity with zero new
+#       compiles across page-in/eviction churn, per-tenant counters
+#     then the fast multiplex smoke: 8 linreg variants on a 2-LANE HBM
+#     budget under a mixed-tenant stream — per-tenant outputs must be
+#     BITWISE-equal to 8 dedicated servers (integer-exact data) while
+#     every variant pages through the 2 resident lanes, with zero
+#     steady-state compiles; plus a bench_multiplex --headline smoke
+#     (K=1,8 QPS-at-SLO curve + paging record, backend-tagged).
+#     (graftlint re-check rides the step-1 whole-package gate.)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_lanes.py tests/test_multiplex.py -q
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_lanes.py tests/test_multiplex.py -q \
+    -k "growing_k or bitwise or paging_parity or interleaved or per_tenant"
+python - <<'EOF'
+import numpy as np
+from spark_rapids_ml_tpu import profiling
+from spark_rapids_ml_tpu.models.linear_regression import LinearRegressionModel
+from spark_rapids_ml_tpu.serving import ModelServer, MultiplexServer
+
+rng = np.random.RandomState(0)
+D = 8
+models = {
+    f"m{i}": LinearRegressionModel(
+        coef_=rng.randint(-3, 4, size=D).astype(np.float64),
+        intercept_=float(i % 3), n_cols=D, dtype="float32",
+    )
+    for i in range(8)
+}
+X = rng.randint(-4, 5, size=(6, D)).astype(np.float32)
+expected = {}
+for mid, m in models.items():
+    with ModelServer(f"ci-ded-{mid}", m) as srv:
+        expected[mid] = srv.predict(X)["prediction"]
+with MultiplexServer("ci_mux", models, resident_lanes=2,
+                     max_batch=64, max_wait_ms=5) as mux:
+    assert mux.lanes()["n_lanes"] == 2
+    before = profiling.counters("precompile.")
+    futs = [(mid, mux.submit(X, model_id=mid))
+            for _ in range(3) for mid in models]  # mixed-tenant stream
+    for mid, f in futs:
+        got = f.result(timeout=60)["prediction"]
+        assert np.array_equal(got, expected[mid]), mid  # bitwise per tenant
+    delta = profiling.counter_deltas(before, "precompile.")
+    assert delta.get("precompile.compile", 0) == 0, delta
+    assert delta.get("precompile.fallback", 0) == 0, delta
+    snap = mux.lanes()
+    assert snap["page_in"] > 0 and snap["evictions"] > 0, snap
+    mux.drain()
+    mux.assert_steady_state()   # zero steady-state compiles
+print("multiplex smoke: 8 tenants on 2 lanes, bitwise parity, "
+      f"{snap['page_in']} page-ins, zero new compiles")
+EOF
+MUX_SMOKE=$(mktemp -d)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.bench_multiplex --headline --ks 1,8 \
+    --duration 0.4 --slo_ms 500 --report_path "$MUX_SMOKE/mux.jsonl"
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.bench_multiplex --paging --registered 16 \
+    --resident 2 --rate 100 --duration 1 \
+    --report_path "$MUX_SMOKE/mux.jsonl"
+python - "$MUX_SMOKE/mux.jsonl" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1])]
+heads = [r for r in recs if r["metric"] == "multiplex_max_sustained_qps_at_p99_slo"]
+assert {r["k_variants"] for r in heads} == {1, 8}, heads
+for r in heads:
+    assert r["max_sustained_qps"] > 0 and r["backend"], r
+page = [r for r in recs if r["metric"] == "multiplex_paging"]
+assert len(page) == 1, recs
+p = page[0]
+assert p["errors"] == 0 and p["page_ins"] > 0, p
+assert 0.0 <= p["lane_hit_rate"] <= 1.0 and p["page_in_p99_ms"] > 0, p
+EOF
+rm -rf "$MUX_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

@@ -296,3 +296,28 @@ class KMeansModel(_KMeansParams, _TpuModelWithPredictionCol):
             out_cols=[pred_col],
             info={"k": len(self.cluster_centers_)},
         )
+
+    def _lane_entry(self, mesh: Any = None):
+        """Multiplexed serving hook (serving/multiplex): this model's
+        centers as ONE lane of the lane-stacked nearest-center kernel —
+        variants must share k (the leaf-shape check in lane_signature
+        enforces it)."""
+        from ..ops.kmeans import lane_kmeans_predict_kernel
+        from ..serving.multiplex import LaneEntry
+
+        np_dtype = self._transform_dtype(self.dtype)
+        centers = np.ascontiguousarray(
+            np.asarray(self.cluster_centers_, dtype=np_dtype)
+        )
+        pred_col = self.getOrDefault("predictionCol")
+        return LaneEntry(
+            name="lanes.kmeans",
+            n_cols=self.n_cols,
+            dtype=np_dtype,
+            out_cols=[pred_col],
+            leaves=(centers,),
+            kernel=lane_kmeans_predict_kernel,
+            statics={},
+            postprocess=lambda labels: {pred_col: np.asarray(labels)},
+            info={"k": len(self.cluster_centers_)},
+        )

@@ -472,10 +472,7 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                     "tuning.sweep.solve", candidates=len(cand), folds=n_folds
                 ):
                     if closed:
-                        bucket = sweep_ops.candidate_bucket(len(closed))
-                        alphas = jax.numpy.asarray(
-                            sweep_ops.pad_lanes([cand[i][0] for i in closed], bucket)
-                        )
+                        _, (alphas,) = sweep_ops.pack_lane_subset(cand, closed)
                         coef, _ = sweep_ops.dispatch(
                             "sweep.linreg.solve",
                             sweep_solve_linear,
@@ -486,12 +483,8 @@ class LinearRegression(_LinearRegressionParams, _TpuEstimatorSupervised):
                         )
                         _collect(closed, jax.device_get(coef))
                     if cd:
-                        bucket = sweep_ops.candidate_bucket(len(cd))
-                        alphas = jax.numpy.asarray(
-                            sweep_ops.pad_lanes([cand[i][0] for i in cd], bucket)
-                        )
-                        l1s = jax.numpy.asarray(
-                            sweep_ops.pad_lanes([cand[i][1] for i in cd], bucket)
+                        _, (alphas, l1s) = sweep_ops.pack_lane_subset(
+                            cand, cd, fields=(0, 1)
                         )
                         tol = jax.numpy.asarray(
                             np.float64(float(params["tol"]))
@@ -610,6 +603,31 @@ class LinearRegressionModel(
             dtype=np_dtype,
             n_cols=self.n_cols,
             out_cols=[pred_col],
+        )
+
+    def _lane_entry(self, mesh: Any = None):
+        """Multiplexed serving hook (serving/multiplex): this model's
+        (coef, intercept) as ONE lane of a lane-stacked GLM predict — K
+        same-shape variants share one lane_linear_predict_kernel dispatch
+        per micro-batch, bitwise-equal per tenant to the dedicated entry
+        above on integer-exact data."""
+        assert self._num_models == 1, "combined multi-models are not servable"
+        from ..ops.glm import lane_linear_predict_kernel
+        from ..serving.multiplex import LaneEntry
+
+        np_dtype = self._transform_dtype(self.dtype)
+        coef = np.ascontiguousarray(np.asarray(self.coef_, dtype=np_dtype))
+        intercept = np.asarray(np_dtype.type(self.intercept_))
+        pred_col = self.getOrDefault("predictionCol")
+        return LaneEntry(
+            name="lanes.linreg",
+            n_cols=self.n_cols,
+            dtype=np_dtype,
+            out_cols=[pred_col],
+            leaves=(coef, intercept),
+            kernel=lane_linear_predict_kernel,
+            statics={},
+            postprocess=lambda preds: {pred_col: np.asarray(preds, dtype=np.float64)},
         )
 
     def _get_eval_predict_func(self) -> Callable[[np.ndarray], np.ndarray]:

@@ -425,12 +425,8 @@ class LogisticRegression(_LogisticRegressionParams, _TpuEstimatorSupervised):
                 idxs = [i for i, c in enumerate(cand) if c[2] == owlqn]
                 if not idxs:
                     continue
-                bucket = sweep_ops.candidate_bucket(len(idxs))
-                regs = jnp.asarray(
-                    sweep_ops.pad_lanes([cand[i][0] for i in idxs], bucket)
-                )
-                l1s = jnp.asarray(
-                    sweep_ops.pad_lanes([cand[i][1] for i in idxs], bucket)
+                _, (regs, l1s) = sweep_ops.pack_lane_subset(
+                    cand, idxs, fields=(0, 1)
                 )
                 families.append((owlqn, idxs, regs, l1s))
             # warm BOTH penalty families' sweep kernels at entry (concrete
@@ -693,6 +689,50 @@ class LogisticRegressionModel(
             dtype=np_dtype,
             n_cols=self.n_cols,
             out_cols=[pred_col, prob_col, raw_col],
+            info={"num_classes": num_classes},
+        )
+
+    def _lane_entry(self, mesh: Any = None):
+        """Multiplexed serving hook (serving/multiplex): (W, b) as ONE lane
+        of the lane-stacked fused decision/probability/label kernel.  The
+        class labels ride `meta`: variants sharing a lane buffer must agree
+        on them, because the shared postprocess maps label indices through
+        variant 0's classes_."""
+        assert self._num_models == 1, "combined multi-models are not servable"
+        from ..ops.logistic import lane_logistic_predict_kernel
+        from ..serving.multiplex import LaneEntry
+
+        np_dtype = self._transform_dtype(self.dtype)
+        W = np.ascontiguousarray(self.coef_.astype(np_dtype))
+        b = np.ascontiguousarray(self.intercept_.astype(np_dtype))
+        classes = self.classes_
+        num_classes = self._num_classes
+        pred_col = self.getOrDefault("predictionCol")
+        prob_col = self.getOrDefault("probabilityCol")
+        raw_col = self.getOrDefault("rawPredictionCol")
+
+        def _post(out) -> Dict[str, Any]:
+            scores, probs, labels = out
+            raw = np.asarray(scores, np.float64)
+            if num_classes == 2 and raw.shape[1] == 1:
+                raw = np.concatenate([-raw, raw], axis=1)
+            idx = np.asarray(labels, np.int64)
+            return {
+                pred_col: classes[idx].astype(np.float64),
+                prob_col: np.asarray(probs, np.float64),
+                raw_col: raw,
+            }
+
+        return LaneEntry(
+            name="lanes.logreg",
+            n_cols=self.n_cols,
+            dtype=np_dtype,
+            out_cols=[pred_col, prob_col, raw_col],
+            leaves=(W, b),
+            kernel=lane_logistic_predict_kernel,
+            statics={"num_classes": num_classes},
+            postprocess=_post,
+            meta=(str(np.asarray(classes).dtype), np.asarray(classes).tobytes()),
             info={"num_classes": num_classes},
         )
 

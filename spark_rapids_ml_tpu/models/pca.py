@@ -238,3 +238,32 @@ class PCAModel(_PCAParams, _TpuModel):
             out_cols=[out_col],
             info={"k": len(self.components_)},
         )
+
+    def _lane_entry(self, mesh: Any = None):
+        """Multiplexed serving hook (serving/multiplex): the
+        (whiten-scaled) component matrix as ONE lane of the lane-stacked
+        projection kernel — the whiten scale is folded host-side exactly
+        as in the dedicated entry, so the lane kernel stays a pure
+        gathered matmul."""
+        from ..ops.linalg import lane_pca_transform_kernel
+        from ..serving.multiplex import LaneEntry
+
+        np_dtype = self._transform_dtype(self.dtype)
+        comps = np.asarray(self.components_, dtype=np_dtype)
+        if self._tpu_params.get("whiten"):
+            scale = 1.0 / np.sqrt(
+                np.maximum(self.explained_variance_, 1e-12)
+            ).astype(np_dtype)
+            comps = comps * scale[:, None]
+        out_col = self.getOrDefault("outputCol")
+        return LaneEntry(
+            name="lanes.pca",
+            n_cols=self.n_cols,
+            dtype=np_dtype,
+            out_cols=[out_col],
+            leaves=(np.ascontiguousarray(comps),),
+            kernel=lane_pca_transform_kernel,
+            statics={},
+            postprocess=lambda proj: {out_col: np.asarray(proj)},
+            info={"k": len(self.components_)},
+        )

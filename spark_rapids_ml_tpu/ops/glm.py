@@ -367,3 +367,20 @@ def multi_linear_predict_kernel(
 ) -> jax.Array:
     """(N, D) x (M, D) -> (M, N): one pass predicting for M combined models."""
     return exact_matmul(coefs, X.T) + intercepts[:, None]
+
+
+@jax.jit
+def lane_linear_predict_kernel(
+    X: jax.Array, lanes: jax.Array, coefs: jax.Array, intercepts: jax.Array
+) -> jax.Array:
+    """Multiplexed linear_predict_kernel (srml-lanes): coefs (L, D) and
+    intercepts (L,) are lane-stacked variant parameters, and row r predicts
+    with lane lanes[r] — one kernel per micro-batch across K served model
+    variants.  Lane VALUES (and the lane ids) are traced, so paging a new
+    variant into a lane is zero new compiles; the per-row dot is the exact
+    contraction of the dedicated kernel (SOLVER_PRECISION), so on
+    integer-exact data the two are bitwise equal."""
+    from .linalg import exact_gather_matmul
+
+    preds = exact_gather_matmul(X, coefs[:, None, :], lanes)[:, 0]
+    return preds + jnp.take(intercepts, lanes, axis=0)

@@ -267,3 +267,29 @@ def kmeans_predict_kernel(X: jax.Array, centers: jax.Array) -> jax.Array:
 
     _, assign = min_dist_argmin(X, centers)
     return assign
+
+
+@jax.jit
+def lane_kmeans_predict_kernel(
+    X: jax.Array, lanes: jax.Array, centers: jax.Array
+) -> jax.Array:
+    """Multiplexed nearest-center assignment (srml-lanes): centers is the
+    lane-stacked (L, k, D) buffer and row r is assigned against lane
+    lanes[r]'s centers.  Identical math to the exact-f32 XLA formulation
+    of pallas_tpu.min_dist_argmin (norms in f32, HIGHEST-precision cross
+    term, first-index argmin) — the fused Pallas route reads ONE shared
+    center set per program so the lane-gathered path always takes the XLA
+    program, and on integer-exact data the two formulations are bitwise
+    equal anyway."""
+    cg = jnp.take(centers, lanes, axis=0)  # (N, k, D)
+    x_norm = (X.astype(jnp.float32) ** 2).sum(axis=1)
+    c_norm = (cg.astype(jnp.float32) ** 2).sum(axis=2)
+    cross = jnp.einsum(
+        "nd,nkd->nk",
+        X,
+        cg,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    d2 = x_norm[:, None] - 2.0 * cross + c_norm
+    return jnp.argmin(d2, axis=1).astype(jnp.int32)

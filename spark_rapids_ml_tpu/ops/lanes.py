@@ -1,0 +1,129 @@
+#
+# srml-lanes: the shared candidate/variant lane engine.
+#
+# PR 12 proved that same-architecture solves batch over a pow2 lane axis
+# behind ONE executable: the lane VALUES are traced (runtime data), only the
+# lane-bucket SIZE keys the AOT executable cache, so a new grid — or a new
+# model variant paged into a lane — at the same shapes is zero new compiles.
+# That machinery used to live inside ops/sweep.py; this module hoists it so
+# every lane rider shares one implementation:
+#
+#   - sweep (tuning): candidates -> lanes of traced hyperparameter values
+#     (lane_bucket / pad_lanes / pack_lane_subset),
+#   - serving (multiplex): K model variants -> lanes of a stacked parameter
+#     buffer, one kernel per micro-batch across tenants (stack_lanes),
+#   - paging: an LRU'd lane slot is repopulated by ONE H2D slice write with
+#     a TRACED lane index (write_lane) — never a recompile, which is what
+#     lets thousands of registered variants share a few dozen resident
+#     lanes (serving/multiplex.py).
+#
+# ops/sweep.py re-exports lane_bucket as `candidate_bucket` (and pad_lanes)
+# for its existing call sites and docs.
+#
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def lane_bucket(m: int) -> int:
+    """Power-of-two lane bucket (floor 1).  The bucket — not the raw lane
+    count — rides the executable-cache key, so grids of 5, 6 and 8 lanes at
+    one data shape share one compiled kernel.  Lanes are independent, so
+    the padded lanes change no real lane's result; they are sliced off (or
+    never routed to) after the fetch."""
+    b = 1
+    while b < m:
+        b *= 2
+    return b
+
+
+def pad_lanes(values: Sequence[float], bucket: int) -> np.ndarray:
+    """(m,) lane values -> (bucket,) float64 lane vector, padding with the
+    first value (a duplicate lane converges like its original; its output
+    is discarded).  float64 here so an x64-scope (float64) fit sees
+    full-precision values; outside x64 jax canonicalizes to the same f32
+    values the sequential path's weakly-typed python floats trace to."""
+    out = np.full(bucket, values[0], dtype=np.float64)  # graftlint: disable=R5 (host-side lane vector; jnp.asarray canonicalizes to the compute dtype)
+    out[: len(values)] = np.asarray(values, dtype=np.float64)  # graftlint: disable=R5 (host-side lane vector)
+    return out
+
+
+def pack_lane_subset(
+    candidates: Sequence[tuple], idxs: Sequence[int], fields: Tuple[int, ...] = (0,)
+) -> Tuple[int, Tuple[jax.Array, ...]]:
+    """The ONE packing step every sweep dispatch site used to hand-roll:
+    select `idxs` out of the candidate grid, bucket them, and stage one
+    padded device lane vector per requested tuple field.  Returns
+    (bucket, (lane vector per field, in `fields` order)); the vectors are
+    traced kernel arguments, so only the bucket touches the cache key."""
+    bucket = lane_bucket(len(idxs))
+    vecs = tuple(
+        jnp.asarray(pad_lanes([candidates[i][f] for i in idxs], bucket))
+        for f in fields
+    )
+    return bucket, vecs
+
+
+# -- serving-side lane stacking / paging -------------------------------------
+
+
+def stack_lanes(leaves_list: Sequence[tuple], bucket: int) -> tuple:
+    """K variants' host parameter leaves -> one lane-stacked device buffer
+    per leaf position: leaves_list[k] is variant k's tuple of np leaves
+    (every variant the same shapes/dtypes — the multiplex signature check
+    enforces it), and the result's leaf i has shape (bucket,) + leaf
+    shape.  Pad lanes duplicate variant 0, the same rule as pad_lanes: a
+    duplicate lane computes a real lane's math and nothing routes to it."""
+    if not leaves_list:
+        raise ValueError("stack_lanes: at least one variant is required")
+    if bucket < len(leaves_list):
+        raise ValueError(
+            f"stack_lanes: bucket {bucket} < {len(leaves_list)} variants"
+        )
+    stacked = []
+    for i in range(len(leaves_list[0])):
+        rows = [np.asarray(v[i]) for v in leaves_list]
+        rows += [rows[0]] * (bucket - len(rows))
+        stacked.append(jax.device_put(np.stack(rows, axis=0)))
+    return tuple(stacked)
+
+
+@jax.jit
+def lane_write_kernel(buf: jax.Array, val: jax.Array, lane: jax.Array) -> jax.Array:
+    """One lane page-in: buf with buf[lane] <- val, the lane index TRACED
+    (int32 scalar), so every lane slot of a given buffer shape shares ONE
+    executable — paging a new variant in is an H2D slice write, never a
+    recompile."""
+    return jax.lax.dynamic_update_index_in_dim(buf, val, lane, 0)
+
+
+def write_lane(stacked: tuple, lane: int, leaves: tuple, *, name: str) -> tuple:
+    """Page one variant's host leaves into lane slot `lane` of the stacked
+    device buffers; returns the NEW stacked tuple (the old one is immutable
+    — an in-flight dispatch holding it keeps consistent values).  Routed
+    through the AOT executable cache under `<name>.write<i>` per leaf, with
+    the lane index a traced argument: after the first write per leaf shape,
+    every subsequent page-in is zero new compiles (gated)."""
+    from .precompile import cached_kernel
+
+    lane_arr = jnp.asarray(np.int32(lane))
+    out = []
+    for i, (buf, val) in enumerate(zip(stacked, leaves)):
+        # .reshape(np.shape(val)): ascontiguousarray promotes 0-d values to
+        # shape (1,), which dynamic_update_index_in_dim rejects against a
+        # 1-D lane buffer — preserve the leaf's declared shape exactly
+        vald = jax.device_put(
+            np.ascontiguousarray(
+                np.asarray(val), dtype=np.dtype(buf.dtype)
+            ).reshape(np.shape(val))
+        )
+        out.append(
+            cached_kernel(f"{name}.write{i}", lane_write_kernel, buf, vald, lane_arr)
+        )
+    return tuple(out)

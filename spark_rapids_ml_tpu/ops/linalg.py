@@ -40,6 +40,21 @@ def exact_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.matmul(a, b, precision=SOLVER_PRECISION, preferred_element_type=pet)
 
 
+def exact_gather_matmul(X: jax.Array, stacked: jax.Array, lanes: jax.Array) -> jax.Array:
+    """The lane-gathered form of exact_matmul for multiplexed predict
+    kernels (srml-lanes): out[r] = X[r] @ stacked[lanes[r]].T, i.e. each
+    row contracts against ITS lane's (K, D) parameter slab.  (N, D) x
+    (L, K, D) gathered by (N,) int32 -> (N, K), with the same precision
+    discipline as exact_matmul so a lane-batched score is the exact same
+    contraction the dedicated per-model kernel runs."""
+    g = jnp.take(stacked, lanes, axis=0)  # (N, K, D)
+    out_dtype = jnp.promote_types(X.dtype, stacked.dtype)
+    pet = jnp.float32 if out_dtype == jnp.dtype(jnp.bfloat16) else None
+    return jnp.einsum(
+        "nd,nkd->nk", X, g, precision=SOLVER_PRECISION, preferred_element_type=pet
+    )
+
+
 def sign_flip(components: jax.Array) -> jax.Array:
     """Deterministic eigenvector signs: flip each row so its largest-|.|
     element is positive (semantics of the reference's thrust signFlip kernel,
@@ -470,5 +485,16 @@ def pca_transform_kernel(X: jax.Array, components: jax.Array) -> jax.Array:
     cuML's centered output to match, feature.py:419-431 — we simply never
     subtract it)."""
     return exact_matmul(X, components.T)
+
+
+@jax.jit
+def lane_pca_transform_kernel(
+    X: jax.Array, lanes: jax.Array, components: jax.Array
+) -> jax.Array:
+    """Multiplexed pca_transform_kernel (srml-lanes): components is the
+    lane-stacked (L, K, D) buffer and row r projects against lane
+    lanes[r]'s components — the exact contraction of the dedicated kernel,
+    so on integer-exact data the two are bitwise equal."""
+    return exact_gather_matmul(X, components, lanes)
 
 

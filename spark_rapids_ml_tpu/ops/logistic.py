@@ -304,3 +304,25 @@ def scores_to_labels(scores: jnp.ndarray, num_classes: int) -> jnp.ndarray:
     if num_classes == 2 and scores.shape[1] == 1:
         return (scores[:, 0] > 0).astype(jnp.float32)
     return jnp.argmax(scores, axis=1).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def lane_logistic_predict_kernel(
+    X: jax.Array, lanes: jax.Array, Ws: jax.Array, bs: jax.Array, *, num_classes: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Multiplexed fused serve kernel (srml-lanes): Ws (L, k, D) and
+    bs (L, k) are lane-stacked variant parameters, row r scores against
+    lane lanes[r], and decision scores, probabilities and label indices
+    come out of ONE dispatch — the lane-batched form of the per-model
+    _serve_kernel.  The per-row contraction is exact_gather_matmul
+    (SOLVER_PRECISION), so lane-batched scores are bitwise-equal to the
+    dedicated path on integer-exact data, and sigmoid/softmax/argmax on
+    bitwise-identical scores are bitwise-identical outputs."""
+    from .linalg import exact_gather_matmul
+
+    scores = exact_gather_matmul(X, Ws, lanes) + jnp.take(bs, lanes, axis=0)
+    return (
+        scores,
+        scores_to_probs(scores, num_classes),
+        scores_to_labels(scores, num_classes),
+    )

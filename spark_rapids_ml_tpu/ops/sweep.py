@@ -11,16 +11,17 @@
 # values are traced (a new grid at the same shapes is zero new compiles).
 #
 # This module owns the estimator-agnostic pieces: fold-id staging, the
-# pow2 candidate bucket that keys the AOT executable cache, lane padding,
-# and the warm hook that queues the sweep kernels on the precompile pool at
-# sweep entry.  The estimator-specific kernels live next to their solvers
-# (ops/glm.py, ops/logistic.py); the CrossValidator routing lives in
-# tuning.py.
+# warm hook that queues the sweep kernels on the precompile pool at sweep
+# entry, and the sweep-facing names of the shared lane engine (the pow2
+# candidate bucket and lane padding now live in ops/lanes.py — srml-lanes —
+# where serving's multiplexed lane buffers ride the same implementation).
+# The estimator-specific kernels live next to their solvers (ops/glm.py,
+# ops/logistic.py); the CrossValidator routing lives in tuning.py.
 #
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 import numpy as np
 
@@ -28,30 +29,13 @@ import jax
 
 from .. import profiling
 from ..parallel.mesh import data_sharding
+# sweep's historical names for the hoisted lane engine: candidate_bucket IS
+# lane_bucket (pow2 bucket that keys the executable cache) and pad_lanes is
+# shared verbatim — docs/tuning_engine.md and the model sweep sites keep
+# working against this module.
+from .lanes import lane_bucket as candidate_bucket  # noqa: F401
+from .lanes import pack_lane_subset, pad_lanes  # noqa: F401
 from .precompile import global_precompiler, kernel_cache_key
-
-
-def candidate_bucket(m: int) -> int:
-    """Power-of-two candidate-lane bucket (floor 1).  The bucket — not the
-    raw candidate count — rides the executable-cache key, so grids of 5, 6
-    and 8 candidates at one data shape share one compiled sweep kernel.
-    Gemm columns are independent per lane, so the padded lanes change no
-    real lane's result; they are sliced off after the fetch."""
-    b = 1
-    while b < m:
-        b *= 2
-    return b
-
-
-def pad_lanes(values: Sequence[float], bucket: int) -> np.ndarray:
-    """(m,) candidate values -> (bucket,) float64 lane vector, padding with
-    the first value (a duplicate lane converges like its original; its
-    output is discarded).  float64 here so an x64-scope (float64) fit sees
-    full-precision values; outside x64 jax canonicalizes to the same f32
-    values the sequential path's weakly-typed python floats trace to."""
-    out = np.full(bucket, values[0], dtype=np.float64)  # graftlint: disable=R5 (host-side lane vector; jnp.asarray canonicalizes to the compute dtype)
-    out[: len(values)] = np.asarray(values, dtype=np.float64)  # graftlint: disable=R5 (host-side lane vector)
-    return out
 
 
 def stage_fold_ids(

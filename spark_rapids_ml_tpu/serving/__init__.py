@@ -21,6 +21,9 @@
 #                policy (pure functions over replica state)
 #   router.py    srml-router: N replicas per model over disjoint mesh
 #                slices, health-aware routing, load shedding, rolling swap
+#   multiplex.py srml-lanes: K same-shape model variants stacked on a pow2
+#                lane axis behind ONE kernel per micro-batch, with LRU
+#                lane paging (host-RAM spill, zero-recompile page-in)
 #
 from .batcher import (
     MicroBatcher,
@@ -42,6 +45,7 @@ from .engine import (
     ServerUnhealthy,
 )
 from .entry import ServingEntry, bucket_rows, entry_for, kernel_entry, serve_buckets
+from .multiplex import LaneEntry, MultiplexServer, lane_entry_for, lane_signature
 from .registry import ModelRegistry, default_registry
 from .router import Router
 from .scheduler import (
@@ -55,9 +59,11 @@ __all__ = [
     "DEFAULT_CLASS",
     "DEGRADED",
     "DRAINING",
+    "LaneEntry",
     "MicroBatcher",
     "ModelRegistry",
     "ModelServer",
+    "MultiplexServer",
     "NoReplicaAvailable",
     "PRIORITY_CLASSES",
     "READY",
@@ -78,5 +84,7 @@ __all__ = [
     "default_registry",
     "entry_for",
     "kernel_entry",
+    "lane_entry_for",
+    "lane_signature",
     "serve_buckets",
 ]

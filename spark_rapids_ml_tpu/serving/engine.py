@@ -365,10 +365,7 @@ class ModelServer:
                 global_precompiler().wait(keys)
             with self._x64_scope():
                 for b in self.buckets:
-                    synth = np.zeros(
-                        (b, self._entry.n_cols), dtype=self._entry.dtype
-                    )
-                    out = self._entry.call(synth)
+                    out = self._entry.call(*self._synth_args(b))
                     missing = [c for c in self._entry.out_cols if c not in out]
                     assert not missing, (
                         f"serving entry {self._entry.name!r} returned columns "
@@ -390,9 +387,18 @@ class ModelServer:
         return contextlib.nullcontext()
 
     # -- client API ---------------------------------------------------------
-    def submit(self, features: np.ndarray, timeout_ms: Optional[float] = None):
+    def submit(
+        self,
+        features: np.ndarray,
+        timeout_ms: Optional[float] = None,
+        *,
+        lane: int = 0,
+    ):
         """Enqueue one request ((D,) row or (n, D) block, n <= max_batch);
         returns a Future resolving to {output column: np array of n rows}.
+        `lane` is the srml-lanes multiplex hook (which lane of a stacked
+        parameter buffer these rows score against — MultiplexServer resolves
+        it from a model_id; dedicated servers leave the default 0).
         Raises ServerOverloaded when the queue bound is hit, ServerRecovering
         (retryable: the supervisor is restarting the worker — retry HERE
         after the sub-second recovery window) while a restart is underway,
@@ -417,7 +423,7 @@ class ModelServer:
                 f"(> SRML_WATCH_STALL_S={watch.stall_threshold_s():g}) "
                 "with no restart budget left; fail over to another replica"
             )
-        return self._batcher.submit(features, timeout_ms=timeout_ms)
+        return self._batcher.submit(features, timeout_ms=timeout_ms, lane=lane)
 
     def _check_wedged(self) -> Optional[float]:
         """Seconds the in-flight dispatch has been wedged when the server
@@ -857,19 +863,26 @@ class ModelServer:
                 f"serve.{self.name}.rewarm", buckets=len(self.buckets)
             ):
                 for b in self.buckets:
-                    synth = np.zeros(
-                        (b, self._entry.n_cols), dtype=self._entry.dtype
-                    )
-                    self._entry.call(synth)
+                    self._entry.call(*self._synth_args(b))
         finally:
             with self._health_lock:
                 self._busy_since = None
+
+    def _synth_args(self, b: int) -> tuple:
+        """The synthetic warm/re-warm batch for one bucket, as the full
+        entry.call argument tuple.  Subclasses whose entries take extra
+        per-row arguments append them here (MultiplexServer adds the lane
+        id vector), so warmup dispatches the exact call geometry traffic
+        will."""
+        return (np.zeros((b, self._entry.n_cols), dtype=self._entry.dtype),)
 
     def _assemble(self, batch) -> Tuple[np.ndarray, int, int]:
         """Host-side batch assembly: zero-pad the coalesced requests to
         their pow2 row bucket.  Runs on the dispatch worker at depth 1 and
         on the assembly thread at depth > 1 — the work the pipeline
-        overlaps with device execution."""
+        overlaps with device execution.  Subclasses may return extra
+        per-row arrays after (padded, n_rows, b); _dispatch forwards them
+        to entry.call (the srml-lanes lane-id vector rides here)."""
         n_rows = sum(r.n_rows for r in batch)
         b = bucket_rows(n_rows, self._batcher.max_batch)
         # empty + tail-only zero fill, NOT np.zeros + overwrite: the bucket
@@ -892,9 +905,9 @@ class ModelServer:
         # InjectedWorkerDeath — a BaseException that escapes the per-batch
         # Exception guard and lands in _worker_main as a worker death.
         faults.site("serving.dispatch", tag=self.name)
-        padded, n_rows, b = (
-            assembled if assembled is not None else self._assemble(batch)
-        )
+        assembled = assembled if assembled is not None else self._assemble(batch)
+        padded, n_rows, b = assembled[0], assembled[1], assembled[2]
+        extras = tuple(assembled[3:])  # e.g. the multiplex lane-id vector
         # compile accounting brackets THIS dispatch: the watermark counters
         # are process-wide, so a baseline taken at warmup end would blame
         # this server for another server's later load-time compiles (any
@@ -910,7 +923,7 @@ class ModelServer:
                 f"serve.{self.name}.dispatch",
                 rows=n_rows, bucket=b, requests=len(batch),
             ):
-                out = self._entry.call(padded)
+                out = self._entry.call(padded, *extras)
         except BaseException as exc:  # noqa: BLE001 - relayed to every waiter
             profiling.incr_counter(f"{self.ns}.errors")
             rec = watch.recorder()

@@ -1,0 +1,448 @@
+#
+# srml-lanes serving: multiplexed multi-tenant model serving.
+#
+# A dedicated ModelServer pays one dispatch — and one resident parameter
+# buffer — per model variant.  MultiplexServer stacks K same-shape variants
+# onto the pow2 lane axis of ONE parameter buffer (ops/lanes.stack_lanes)
+# and dispatches one lane-batched kernel per micro-batch across different
+# tenants' models: requests are routed (model_id -> lane) through the
+# existing MicroBatcher (each request carries its lane id), the per-lane
+# output scatter rides the existing Future-scatter (the kernel gathers
+# parameters PER ROW, so the padded batch's output rows line up with the
+# dedicated path's), and per-tenant counters ride the existing
+# serving.<name>.* metric families under a .tenant.<model_id> suffix.
+#
+# HBM lane paging: variants beyond the resident lane budget live as host
+# numpy leaves in `_registered`; a request for a non-resident model pages
+# it into the least-recently-used idle lane with ONE H2D slice write per
+# parameter leaf (ops/lanes.write_lane — traced lane index, zero new
+# compiles; the PR 12 insight), so thousands of registered variants share
+# a few dozen resident lanes.  A lane is only evicted when no queued or
+# in-flight request rides it (`_lane_pending`); page-in replaces the
+# stacked buffer tuple immutably, so an in-flight dispatch keeps the
+# consistent values its rows were routed against.
+#
+# Exactness contract: the lane kernels run the exact per-row contraction
+# of the dedicated kernels (SOLVER_PRECISION — see exact_gather_matmul),
+# so on integer-exact data multiplexed outputs are bitwise-equal per
+# tenant to dedicated per-model serving; the CI multiplex gate holds this.
+#
+
+from __future__ import annotations
+
+import collections
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from .. import profiling, sanitize
+from ..ops.lanes import lane_bucket, stack_lanes, write_lane
+from .batcher import ServerOverloaded
+from .engine import ModelServer, _warm_scope
+from .entry import ServingEntry
+
+PAGE_WAIT_ENV = "SRML_SERVE_PAGE_WAIT_S"
+_DEFAULT_PAGE_WAIT_S = 5.0
+
+
+def _page_wait_s() -> float:
+    from ..utils import env_float
+
+    return env_float(PAGE_WAIT_ENV, _DEFAULT_PAGE_WAIT_S)
+
+
+@dataclass
+class LaneEntry:
+    """One model's MULTIPLEXED serving surface — what `_lane_entry` hooks
+    return.  Unlike ServingEntry (a closed call over this model's device
+    constants), a LaneEntry exposes the pieces the multiplex server needs
+    to stack K variants behind one kernel: the host parameter `leaves`
+    (stacked on a new leading lane axis), the lane-batched `kernel`
+    (X, lanes, *stacked, **statics) -> device outputs, and the shared
+    `postprocess` every variant's host-fetched output runs through.
+    `meta` carries variant identity that must MATCH for two models to
+    share a kernel and postprocess (e.g. logistic class labels); it rides
+    lane_signature next to the shape/dtype/out_cols checks."""
+
+    name: str                 # stable kernel-cache namespace, e.g. "lanes.linreg"
+    n_cols: int
+    dtype: np.dtype
+    out_cols: List[str]
+    leaves: tuple             # host np parameter leaves (this variant's values)
+    kernel: Any               # (X, lanes, *stacked, **statics) -> device out
+    statics: Dict[str, Any] = field(default_factory=dict)
+    postprocess: Callable[[Any], Dict[str, np.ndarray]] = None
+    meta: tuple = ()
+    info: Dict[str, Any] = field(default_factory=dict)
+
+
+def lane_signature(entry: "LaneEntry") -> tuple:
+    """Everything two variants must agree on to share one lane buffer:
+    kernel namespace, client contract (n_cols/dtype/out_cols), parameter
+    leaf geometry, statics, and the model-class meta."""
+    return (
+        entry.name,
+        int(entry.n_cols),
+        str(np.dtype(entry.dtype)),
+        tuple(sorted(entry.out_cols)),
+        tuple((tuple(np.asarray(l).shape), str(np.asarray(l).dtype)) for l in entry.leaves),
+        tuple(sorted(entry.statics.items())),
+        entry.meta,
+    )
+
+
+def lane_entry_for(model: Any, mesh: Any = None) -> LaneEntry:
+    """The model's multiplexed serving entry via its `_lane_entry` hook,
+    with a uniform error for models that have no lane-batched path."""
+    hook = getattr(model, "_lane_entry", None)
+    if hook is None:
+        raise TypeError(
+            f"{type(model).__name__} is not multiplexable (no _lane_entry "
+            "hook); serve it on a dedicated ModelServer instead"
+        )
+    entry = hook(mesh)
+    if not isinstance(entry, LaneEntry):
+        raise TypeError(
+            f"{type(model).__name__}._lane_entry returned "
+            f"{type(entry).__name__}, expected LaneEntry"
+        )
+    return entry
+
+
+class _LaneStackModel:
+    """Internal servable facade: hands ModelServer.__init__ the prebuilt
+    multiplex ServingEntry through the standard _serving_entry hook, so
+    the base engine (batcher, warmup, shield recovery, health) runs
+    unchanged on the lane-batched entry."""
+
+    def __init__(self, entry: ServingEntry):
+        self._entry = entry
+
+    def _serving_entry(self, mesh: Any = None) -> ServingEntry:
+        return self._entry
+
+
+class MultiplexServer(ModelServer):
+    """One lane-batched server for K same-shape model variants.
+
+    `models` is an ordered {model_id: fitted model}; every variant must
+    produce an equal lane_signature (same model class, feature width,
+    dtype, output columns, parameter geometry — a mismatch is a
+    register-on-a-dedicated-server event, not a lane).  `resident_lanes`
+    bounds the device lane budget: at most lane_bucket(resident_lanes)
+    lane slots are stacked in HBM, and variants beyond it page in through
+    the LRU (host-RAM spill is just `_registered` keeping every variant's
+    numpy leaves).  Clients pass model_id to submit()/predict(); the rest
+    of the ModelServer surface (health, stats, drain, shutdown, shield
+    recovery) is inherited."""
+
+    def __init__(
+        self,
+        name: str,
+        models: Dict[str, Any],
+        mesh: Any = None,
+        *,
+        resident_lanes: Optional[int] = None,
+        **kwargs: Any,
+    ):
+        if not models:
+            raise ValueError("MultiplexServer requires at least one model")
+        entries = {mid: lane_entry_for(m, mesh) for mid, m in models.items()}
+        ids = list(entries)
+        proto = entries[ids[0]]
+        sig0 = lane_signature(proto)
+        for mid in ids[1:]:
+            if lane_signature(entries[mid]) != sig0:
+                raise ValueError(
+                    f"multiplex({name!r}): variant {mid!r} is not "
+                    f"lane-compatible with {ids[0]!r} (lane_signature "
+                    "mismatch); same-shape variants only"
+                )
+        self._proto = proto
+        # every registered variant's host leaves, cast once to the buffer
+        # dtypes so a page-in is a pure H2D copy
+        self._registered: "collections.OrderedDict[str, tuple]" = (
+            collections.OrderedDict(
+                # .reshape(np.shape(l)): ascontiguousarray promotes 0-d
+                # leaves (scalar intercepts) to shape (1,), which would
+                # silently widen the stacked buffer and break the kernel's
+                # broadcast — preserve the declared leaf shape exactly
+                (
+                    mid,
+                    tuple(
+                        np.ascontiguousarray(np.asarray(l)).reshape(np.shape(l))
+                        for l in e.leaves
+                    ),
+                )
+                for mid, e in entries.items()
+            )
+        )
+        want = int(resident_lanes) if resident_lanes else len(ids)
+        want = max(1, min(want, len(ids)))
+        self._n_lanes = lane_bucket(want)
+        # lane state: model_id <-> lane maps, LRU order, per-lane pending
+        # request counts (a lane with pending > 0 is never an eviction
+        # victim — its queued/in-flight rows were routed against it)
+        self._lane_lock = sanitize.lockdep_lock("serve.multiplex.lanes")
+        self._lane_free = threading.Condition(self._lane_lock)
+        self._lane_of: Dict[str, int] = {}
+        self._lru: "collections.OrderedDict[str, int]" = collections.OrderedDict()
+        self._lane_pending = [0] * self._n_lanes
+        residents = ids[: min(self._n_lanes, len(ids))]
+        self._stacked = stack_lanes(
+            [self._registered[mid] for mid in residents], self._n_lanes
+        )
+        for i, mid in enumerate(residents):
+            self._lane_of[mid] = i
+            self._lru[mid] = i
+        self._free_lanes = list(range(len(residents), self._n_lanes))
+        # warm the per-leaf page-in write kernels before traffic by
+        # rewriting lane 0 with its own values (idempotent): after this,
+        # every page-in — any lane, any variant — is zero new compiles.
+        # _warm_scope keeps any compile out of concurrent servers' steady-
+        # state attribution windows.
+        with _warm_scope():
+            self._stacked = write_lane(
+                self._stacked, 0, self._registered[residents[0]],
+                name=proto.name,
+            )
+            jax.block_until_ready(self._stacked)
+        super().__init__(name, _LaneStackModel(self._build_entry()), mesh, **kwargs)
+
+    # -- the lane-batched ServingEntry ---------------------------------------
+    def _build_entry(self) -> ServingEntry:
+        from ..ops.precompile import (
+            aval,
+            cached_kernel,
+            global_precompiler,
+            kernel_cache_key,
+        )
+
+        proto = self._proto
+        np_dtype = np.dtype(proto.dtype)
+        n_cols = int(proto.n_cols)
+        statics = dict(proto.statics)
+        server = self  # the entry is owned by the server; plain closure is fine
+
+        def call(batch: np.ndarray, lanes: np.ndarray) -> Dict[str, np.ndarray]:
+            Xd = jax.device_put(np.ascontiguousarray(batch, dtype=np_dtype))
+            ld = jax.device_put(np.ascontiguousarray(lanes, dtype=np.int32))
+            # snapshot: page-in replaces the tuple immutably, and rows in
+            # THIS batch only reference lanes whose pending count pinned
+            # them — identical values in either snapshot
+            stacked = server._stacked
+            out = cached_kernel(proto.name, proto.kernel, Xd, ld, *stacked, **statics)
+            return proto.postprocess(jax.device_get(out))
+
+        def warm(buckets) -> list:
+            pc = global_precompiler()
+            stacked = server._stacked
+            keys = []
+            for b in buckets:
+                args = (
+                    aval((int(b), n_cols), np_dtype),
+                    aval((int(b),), np.int32),
+                ) + tuple(stacked)
+                key = kernel_cache_key(proto.name, args, None, statics)
+                pc.submit(key, proto.kernel, *args, **statics)
+                keys.append(key)
+            return keys
+
+        return ServingEntry(
+            name=proto.name,
+            n_cols=n_cols,
+            dtype=np_dtype,
+            out_cols=list(proto.out_cols),
+            call=call,
+            warm=warm,
+            info=dict(
+                proto.info,
+                lanes=self._n_lanes,
+                registered=len(self._registered),
+            ),
+        )
+
+    # -- lane paging ----------------------------------------------------------
+    def _find_slot_locked(self) -> Optional[int]:
+        """A lane to page into: a never-used free slot, else the least-
+        recently-used resident whose pending count is zero (evicted).
+        Returns None when every lane has in-flight traffic."""
+        if self._free_lanes:
+            return self._free_lanes.pop()
+        for mid, lane in self._lru.items():  # oldest first
+            if self._lane_pending[lane] == 0:
+                del self._lane_of[mid]
+                del self._lru[mid]
+                profiling.incr_counter(f"{self.ns}.lanes.evictions")
+                return lane
+        return None
+
+    def _lane_in(self, model_id: str) -> int:
+        """Resolve model_id -> resident lane, paging it in if spilled, and
+        pin the lane (pending += 1) until the request's future resolves."""
+        with self._lane_lock:
+            if model_id not in self._registered:
+                known = sorted(self._registered)
+                shown = known[:8] + ["..."] if len(known) > 8 else known
+                raise KeyError(
+                    f"{self.ns}: no registered variant {model_id!r} "
+                    f"(registered: {shown})"
+                )
+            lane = self._lane_of.get(model_id)
+            if lane is not None:
+                self._lru.move_to_end(model_id)
+                self._lane_pending[lane] += 1
+                profiling.incr_counter(f"{self.ns}.lanes.hits")
+                return lane
+            deadline = profiling.now() + _page_wait_s()
+            while True:
+                lane = self._find_slot_locked()
+                if lane is not None:
+                    break
+                remaining = deadline - profiling.now()
+                if remaining <= 0:
+                    raise ServerOverloaded(
+                        f"{self.ns}: all {self._n_lanes} resident lanes "
+                        "have in-flight traffic; retry with backoff "
+                        f"(registered variants: {len(self._registered)})"
+                    )
+                # bounded wait (graftlint R9): a lost notify or a wedged
+                # dispatch can never park a page-in forever — the deadline
+                # above converts it into the typed retryable overload
+                self._lane_free.wait(min(remaining, 1.0))
+            t0 = profiling.now()
+            stacked = write_lane(
+                self._stacked, lane, self._registered[model_id],
+                name=self._proto.name,
+            )
+            self._stacked = stacked
+            self._lane_of[model_id] = lane
+            self._lru[model_id] = lane
+            self._lane_pending[lane] += 1
+            profiling.incr_counter(f"{self.ns}.lanes.page_in")
+        # Device sync OUTSIDE the critical section (graftlint R11): the pin
+        # taken above keeps the lane resident, and any dispatch that snapshots
+        # the new `_stacked` orders after the H2D write through jax's async
+        # dispatch — blocking here only scores honest page-in wall time and
+        # backpressures the paging tenant, never the other lanes' traffic.
+        jax.block_until_ready(stacked)
+        profiling.record_duration(
+            f"serve.{self.name}.page_in", profiling.now() - t0
+        )
+        return lane
+
+    def _lane_release(self, lane: int) -> None:
+        with self._lane_lock:
+            self._lane_pending[lane] -= 1
+            if self._lane_pending[lane] == 0:
+                self._lane_free.notify_all()
+
+    # -- client API -----------------------------------------------------------
+    def submit(
+        self,
+        features: np.ndarray,
+        timeout_ms: Optional[float] = None,
+        *,
+        model_id: Optional[str] = None,
+    ):
+        """Enqueue one request for ONE tenant's model; returns a Future.
+        `model_id` is required when more than one variant is registered
+        (the single-variant case defaults to it, so a MultiplexServer of
+        one model is submit-compatible with a dedicated server)."""
+        if model_id is None:
+            if len(self._registered) == 1:
+                model_id = next(iter(self._registered))
+            else:
+                raise ValueError(
+                    f"{self.ns}: multiplexed submit requires model_id= "
+                    f"(one of {len(self._registered)} registered variants)"
+                )
+        resolved = self._lane_in(model_id)
+        t0 = profiling.now()
+        try:
+            fut = super().submit(features, timeout_ms=timeout_ms, lane=resolved)
+        except BaseException:
+            self._lane_release(resolved)
+            raise
+        feats = np.asarray(features)
+        n_rows = 1 if feats.ndim == 1 else int(feats.shape[0])
+        tns = f"{self.ns}.tenant.{model_id}"
+        profiling.incr_counter(f"{tns}.requests")
+        profiling.incr_counter(f"{tns}.rows", n_rows)
+
+        def _done(f) -> None:
+            # runs on the resolving thread (dispatch scatter / recovery
+            # shed): only counters + the pending decrement, never blocking
+            self._lane_release(resolved)
+            if not f.cancelled() and f.exception() is None:
+                profiling.record_duration(
+                    f"serve.{self.name}.tenant.{model_id}.latency",
+                    profiling.now() - t0,
+                )
+            else:
+                profiling.incr_counter(f"{tns}.errors")
+
+        fut.add_done_callback(_done)
+        return fut
+
+    def predict(
+        self,
+        features: np.ndarray,
+        timeout_ms: Optional[float] = None,
+        *,
+        model_id: Optional[str] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Blocking convenience around submit(), per tenant."""
+        fut = self.submit(features, timeout_ms=timeout_ms, model_id=model_id)
+        wait_s = None
+        if timeout_ms is not None and timeout_ms > 0:
+            wait_s = timeout_ms / 1000.0 + 60.0  # dispatch slack
+        return fut.result(timeout=wait_s)
+
+    # -- engine hooks ----------------------------------------------------------
+    def _synth_args(self, b: int) -> tuple:
+        return (
+            np.zeros((b, self._entry.n_cols), dtype=self._entry.dtype),
+            np.zeros(b, dtype=np.int32),
+        )
+
+    def _assemble(self, batch) -> Tuple[np.ndarray, int, int, np.ndarray]:
+        padded, n_rows, b = super()._assemble(batch)
+        lanes = np.empty(b, dtype=np.int32)
+        off = 0
+        for r in batch:
+            lanes[off : off + r.n_rows] = r.lane
+            off += r.n_rows
+        if b > n_rows:
+            lanes[n_rows:] = 0  # pad rows ride lane 0; their output is sliced off
+        return padded, n_rows, b, lanes
+
+    # -- observability ---------------------------------------------------------
+    def lanes(self) -> Dict[str, Any]:
+        """Lane-plane snapshot: budget, residency, paging counters."""
+        with self._lane_lock:
+            resident = dict(self._lane_of)
+            pending = list(self._lane_pending)
+        return {
+            "n_lanes": self._n_lanes,
+            "registered": len(self._registered),
+            "resident": len(resident),
+            "resident_models": sorted(resident),
+            "pending_by_lane": pending,
+            "hits": profiling.counter(f"{self.ns}.lanes.hits"),
+            "page_in": profiling.counter(f"{self.ns}.lanes.page_in"),
+            "evictions": profiling.counter(f"{self.ns}.lanes.evictions"),
+            "page_in_latency": profiling.percentiles(f"serve.{self.name}.page_in"),
+        }
+
+    def model_ids(self) -> list:
+        return sorted(self._registered)
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        out["lanes"] = self.lanes()
+        return out

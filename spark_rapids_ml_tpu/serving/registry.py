@@ -69,6 +69,43 @@ class ModelRegistry:
             self._servers[name] = server
         return server
 
+    def multiplex(
+        self,
+        name: str,
+        models: Dict[str, Any],
+        *,
+        resident_lanes: Optional[int] = None,
+        **overrides: Any,
+    ) -> "ModelServer":
+        """Serve K same-shape model variants behind ONE lane-batched server
+        (srml-lanes): every micro-batch dispatches one kernel across the
+        tenants' stacked parameters, and variants beyond `resident_lanes`
+        page into the LRU'd device lane buffer on demand — thousands of
+        registered variants on a fixed HBM budget.  The returned server is
+        a MultiplexServer (a ModelServer subclass: health/stats/telemetry/
+        swap-era lifecycle all apply); clients pass model_id to
+        submit()/predict().  Name reservation mirrors register()."""
+        from .multiplex import MultiplexServer
+
+        with self._lock:
+            if name in self._servers:
+                raise ValueError(f"model name {name!r} already registered")
+            self._servers[name] = None  # reservation; filled below
+        try:
+            server = MultiplexServer(
+                name,
+                models,
+                resident_lanes=resident_lanes,
+                **{**self._defaults, **overrides},
+            )
+        except BaseException:
+            with self._lock:
+                self._servers.pop(name, None)
+            raise
+        with self._lock:
+            self._servers[name] = server
+        return server
+
     def load(self, name: str, path: str, **overrides: Any) -> ModelServer:
         """Load a saved model from `path` via core persistence and serve it.
         Estimators (no transform surface) are rejected with a clear error."""

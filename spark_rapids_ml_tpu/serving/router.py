@@ -184,6 +184,66 @@ class Router:
         profiling.incr_counter(f"router.{name}.replicas_started", n)
         return built
 
+    def serve_multiplex(
+        self,
+        name: str,
+        models: Dict[str, Any],
+        replicas: Optional[int] = None,
+        priority: str = DEFAULT_CLASS,
+        *,
+        resident_lanes: Optional[int] = None,
+        **overrides: Any,
+    ) -> List[ModelServer]:
+        """Deploy K same-shape model variants as a replica set of
+        lane-batched MultiplexServers (srml-lanes): each replica stacks
+        every resident variant into ONE parameter buffer on ITS mesh
+        slice, and `submit(..., model_id=...)` routes tenants through the
+        same admission/failover plane as dedicated sets.  Rolling swap()
+        is a dedicated-server feature — upgrade a multiplexed set by
+        deploying a successor set under a new name."""
+        from .multiplex import MultiplexServer
+
+        scheduler.class_index(priority)
+        n = replicas or self._replicas_default
+        with self._lock:
+            if name in self._sets:
+                raise ValueError(f"model name {name!r} already routed")
+            self._sets[name] = None  # reservation; filled below
+        from ..parallel.mesh import slice_meshes
+
+        kwargs = {
+            "inflight_depth": self._inflight_depth,
+            **self._defaults,
+            **overrides,
+        }
+        built: List[ModelServer] = []
+        try:
+            slices = slice_meshes(n)
+            for i in range(n):
+                built.append(
+                    MultiplexServer(
+                        f"{name}-r{i}", models, mesh=slices[i],
+                        resident_lanes=resident_lanes, **kwargs,
+                    )
+                )
+        except BaseException:
+            for srv in built:
+                try:
+                    srv.shutdown(drain=False)
+                except Exception:  # noqa: BLE001 - teardown of a half-built set
+                    logger.warning(
+                        "router: teardown of half-built replica %r failed",
+                        srv.name,
+                    )
+            with self._lock:
+                self._sets.pop(name, None)
+            raise
+        rs = _ReplicaSet(name, priority, built, slices, kwargs)
+        with self._lock:
+            self._sets[name] = rs
+        profiling.incr_counter(f"router.{name}.replicas_started", n)
+        return built
+
     def _set(self, name: str) -> _ReplicaSet:
         with self._lock:
             rs = self._sets.get(name)
@@ -212,8 +272,11 @@ class Router:
         features: Any,
         timeout_ms: Optional[float] = None,
         priority: Optional[str] = None,
+        model_id: Optional[str] = None,
     ):
-        """Admit, pick, dispatch: returns a ROUTED Future.  Unlike a bare
+        """Admit, pick, dispatch: returns a ROUTED Future.  `model_id`
+        targets one tenant of a multiplexed set (serve_multiplex) and is
+        forwarded to the replica's submit.  Unlike a bare
         ModelServer future, a routed future absorbs replica failures: a
         replica that dies or is superseded after admitting the request
         resolves it with the typed retryable ServerRecovering/
@@ -274,8 +337,15 @@ class Router:
                     return
                 if mode == "degraded":
                     profiling.incr_counter(f"router.{name}.degraded_mode")
+                kw = {} if model_id is None else {"model_id": model_id}
                 try:
-                    fut = replica.submit(features, timeout_ms=timeout_ms)
+                    fut = replica.submit(features, timeout_ms=timeout_ms, **kw)
+                except (KeyError, ValueError) as exc:
+                    # unknown tenant / bad request: a CLIENT error identical
+                    # on every replica — resolve, never fail over (and never
+                    # raise out of a done-callback re-route)
+                    resolve_future(outer, exc=exc)
+                    return
                 except (
                     ServerDraining,  # racing a rolling-swap cut-over
                     ServerOverloaded,
@@ -335,11 +405,13 @@ class Router:
         features: Any,
         timeout_ms: Optional[float] = None,
         priority: Optional[str] = None,
+        model_id: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Blocking convenience around submit(), bounded like
         ModelServer.predict."""
         fut = self.submit(
-            name, features, timeout_ms=timeout_ms, priority=priority
+            name, features, timeout_ms=timeout_ms, priority=priority,
+            model_id=model_id,
         )
         wait_s = None
         if timeout_ms is not None and timeout_ms > 0:

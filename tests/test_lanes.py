@@ -1,0 +1,175 @@
+# srml-lanes: the shared candidate/variant lane engine (ops/lanes.py) —
+# lane-bucket edge cases, duplicate-lane padding correctness, the
+# pack_lane_subset packing helper every sweep site rides, serving-side
+# lane stacking / paging primitives, and the compile-count gate proving
+# that growing K across a pow2 bucket boundary triggers exactly ONE new
+# compile (and zero within a bucket) — the PR 12 insight the whole
+# multiplex subsystem is built on.
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu import profiling
+from spark_rapids_ml_tpu.ops import sweep as sweep_ops
+from spark_rapids_ml_tpu.ops.lanes import (
+    lane_bucket,
+    pack_lane_subset,
+    pad_lanes,
+    stack_lanes,
+    write_lane,
+)
+
+
+def test_lane_bucket_edges():
+    assert lane_bucket(1) == 1  # K=1: a single lane is its own bucket
+    assert lane_bucket(2) == 2
+    assert lane_bucket(3) == 4  # non-pow2 rounds up
+    assert lane_bucket(4) == 4
+    assert lane_bucket(5) == 8
+    assert lane_bucket(17) == 32
+    assert lane_bucket(512) == 512
+    assert lane_bucket(0) == 1  # floor 1: empty never keys a 0-wide kernel
+
+
+def test_sweep_reexports_are_the_lane_engine():
+    # ops/sweep re-exports the hoisted engine under its historical names;
+    # call sites and docs that say candidate_bucket must hit the SAME code
+    assert sweep_ops.candidate_bucket is lane_bucket
+    assert sweep_ops.pad_lanes is pad_lanes
+    assert sweep_ops.pack_lane_subset is pack_lane_subset
+
+
+def test_pad_lanes_duplicates_first_value():
+    out = pad_lanes([0.5, 0.25, 0.125], 4)
+    assert out.shape == (4,) and out.dtype == np.float64
+    np.testing.assert_array_equal(out[:3], [0.5, 0.25, 0.125])
+    assert out[3] == 0.5  # pad lane duplicates lane 0, never injects zeros
+
+
+def test_pad_lanes_exact_bucket_is_identity():
+    out = pad_lanes([1.0, 2.0], 2)
+    np.testing.assert_array_equal(out, [1.0, 2.0])
+
+
+def test_pack_lane_subset_single_field():
+    cand = [(0.1,), (0.2,), (0.3,), (0.4,), (0.5,)]
+    bucket, (vec,) = pack_lane_subset(cand, [1, 3, 4])
+    assert bucket == 4
+    got = np.asarray(vec)
+    np.testing.assert_allclose(got[:3], [0.2, 0.4, 0.5])
+    np.testing.assert_allclose(got[3], 0.2)  # duplicate-lane padding
+
+
+def test_pack_lane_subset_multi_field():
+    cand = [(0.1, 0.9), (0.2, 0.8), (0.3, 0.7)]
+    bucket, (a, b) = pack_lane_subset(cand, [0, 2], fields=(0, 1))
+    assert bucket == 2
+    np.testing.assert_allclose(np.asarray(a), [0.1, 0.3])
+    np.testing.assert_allclose(np.asarray(b), [0.9, 0.7])
+
+
+def test_pack_lane_subset_k1():
+    bucket, (vec,) = pack_lane_subset([(7.0,)], [0])
+    assert bucket == 1
+    np.testing.assert_allclose(np.asarray(vec), [7.0])
+
+
+# -- serving-side stacking / paging ------------------------------------------
+
+
+def test_stack_lanes_shapes_and_padding():
+    leaves = [
+        (np.full((3, 2), float(k), np.float32), np.float32(k)) for k in range(3)
+    ]
+    st = stack_lanes(leaves, 4)
+    assert [s.shape for s in st] == [(4, 3, 2), (4,)]
+    m, b = np.asarray(st[0]), np.asarray(st[1])
+    np.testing.assert_array_equal(b[:3], [0.0, 1.0, 2.0])
+    assert b[3] == 0.0  # pad lane duplicates variant 0
+    np.testing.assert_array_equal(m[3], m[0])
+
+
+def test_stack_lanes_validation():
+    with pytest.raises(ValueError, match="at least one"):
+        stack_lanes([], 2)
+    with pytest.raises(ValueError, match="bucket 1 < 2"):
+        stack_lanes([(np.zeros(2),), (np.ones(2),)], 1)
+
+
+def test_write_lane_is_immutable_page_in():
+    leaves = [(np.full(3, float(k), np.float32), np.float32(k)) for k in range(4)]
+    st = stack_lanes(leaves, 4)
+    # page a new variant into lane 2; 0-d scalar leaves must survive the
+    # round-trip exactly (ascontiguousarray's 0-d -> (1,) promotion is the
+    # classic way this breaks)
+    st2 = write_lane(st, 2, (np.full(3, 9.0, np.float32), np.float32(9.0)),
+                     name="lanes.test")
+    assert [s.shape for s in st2] == [(4, 3), (4,)]
+    np.testing.assert_array_equal(np.asarray(st2[0])[2], [9.0, 9.0, 9.0])
+    assert np.asarray(st2[1])[2] == 9.0
+    # the OLD tuple is untouched: an in-flight dispatch holding it keeps
+    # consistent values
+    np.testing.assert_array_equal(np.asarray(st[0])[2], [2.0, 2.0, 2.0])
+    assert np.asarray(st[1])[2] == 2.0
+    # untouched lanes carry over
+    for lane in (0, 1, 3):
+        np.testing.assert_array_equal(
+            np.asarray(st2[0])[lane], np.asarray(st[0])[lane]
+        )
+
+
+def test_write_lane_same_shape_is_zero_new_compiles():
+    leaves = [(np.full(2, float(k), np.float32),) for k in range(4)]
+    st = stack_lanes(leaves, 4)
+    st = write_lane(st, 0, (np.zeros(2, np.float32),), name="lanes.gate")
+    c0 = profiling.counters("precompile.")
+    # every lane slot of a given buffer shape shares ONE executable: the
+    # lane index is traced, so these three page-ins are all AOT hits
+    for lane in (1, 2, 3):
+        st = write_lane(
+            st, lane, (np.full(2, 5.0 + lane, np.float32),), name="lanes.gate"
+        )
+    delta = profiling.counter_deltas(c0, "precompile.")
+    assert delta.get("precompile.compile", 0) == 0, delta
+    assert delta.get("precompile.fallback", 0) == 0, delta
+    np.testing.assert_array_equal(
+        np.asarray(st[0]), [[0.0, 0.0], [6.0, 6.0], [7.0, 7.0], [8.0, 8.0]]
+    )
+
+
+# -- the compile-count gate ---------------------------------------------------
+
+
+@jax.jit
+def _toy_lane_kernel(X, lanes_vec):
+    # a representative lane kernel: per-row scale by its lane's value
+    return X.sum(axis=1)[None, :] * lanes_vec[:, None]
+
+
+def test_growing_k_compiles_once_per_pow2_boundary():
+    """The PR 12 insight, gated: lane VALUES are traced runtime data — only
+    the pow2 bucket SIZE keys the executable cache.  Growing K from 1..8
+    crosses bucket boundaries at K=2, 3 and 5; every K inside a bucket is
+    zero new compiles."""
+    from spark_rapids_ml_tpu.ops.precompile import cached_kernel
+
+    X = jnp.asarray(np.ones((4, 3), np.float32))
+    expected_new = {1: 1, 2: 1, 3: 1, 4: 0, 5: 1, 6: 0, 7: 0, 8: 0}
+    outs = {}
+    for k in range(1, 9):
+        bucket, (vec,) = pack_lane_subset(
+            [(float(i + 1),) for i in range(k)], list(range(k))
+        )
+        c0 = profiling.counters("precompile.")
+        out = cached_kernel(f"lanes.growK.b{bucket}", _toy_lane_kernel, X, vec)
+        delta = profiling.counter_deltas(c0, "precompile.")
+        assert delta.get("precompile.compile", 0) == expected_new[k], (k, delta)
+        assert delta.get("precompile.fallback", 0) == 0, (k, delta)
+        assert out.shape == (bucket, 4)
+        outs[k] = out
+    # ONE batched host fetch after the loop (graftlint R1), then check that
+    # lane values really are traced: lane i computes with value i+1
+    for k, got in jax.device_get(outs).items():
+        np.testing.assert_allclose(got[:k, 0], np.arange(1, k + 1) * 3.0)

@@ -1,0 +1,335 @@
+# srml-lanes multiplexed serving gates (docs/serving.md): K same-shape model
+# variants behind ONE lane-batched kernel per micro-batch, bitwise-equal per
+# tenant to dedicated per-model serving (the integer-exact-data discipline of
+# the sweep parity gates), HBM lane paging with zero-recompile page-in, LRU
+# eviction bounded by in-flight pins, per-tenant counters, and the registry/
+# router deployment surfaces.
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import profiling
+from spark_rapids_ml_tpu.models.kmeans import KMeansModel
+from spark_rapids_ml_tpu.models.linear_regression import LinearRegressionModel
+from spark_rapids_ml_tpu.models.logistic_regression import LogisticRegressionModel
+from spark_rapids_ml_tpu.models.pca import PCAModel
+from spark_rapids_ml_tpu.serving import (
+    ModelRegistry,
+    ModelServer,
+    MultiplexServer,
+    Router,
+    ServerOverloaded,
+    lane_entry_for,
+    lane_signature,
+)
+
+D = 5  # feature width shared by the variant zoo
+
+
+def _linreg(rng, i):
+    return LinearRegressionModel(
+        coef_=rng.randint(-3, 4, size=D).astype(np.float64),
+        intercept_=float(i % 3),
+        n_cols=D,
+        dtype="float32",
+    )
+
+
+def _logreg(rng, i):
+    return LogisticRegressionModel(
+        coef_=rng.randint(-2, 3, size=(3, D)).astype(np.float64),
+        intercept_=rng.randint(-2, 3, size=3).astype(np.float64),
+        classes_=np.array([0.0, 1.0, 2.0]),
+        n_cols=D,
+        dtype="float32",
+    )
+
+
+def _kmeans(rng, i):
+    return KMeansModel(
+        cluster_centers_=rng.randint(-5, 6, size=(4, D)).astype(np.float64),
+        n_cols=D,
+        dtype="float32",
+    )
+
+
+def _pca(rng, i):
+    return PCAModel(
+        mean_=np.zeros(D),
+        components_=rng.randint(-2, 3, size=(2, D)).astype(np.float64),
+        explained_variance_=np.array([4.0, 1.0]),
+        explained_variance_ratio_=np.array([0.8, 0.2]),
+        singular_values_=np.array([2.0, 1.0]),
+        n_cols=D,
+        dtype="float32",
+    )
+
+
+FAMILIES = {"linreg": _linreg, "logreg": _logreg, "kmeans": _kmeans, "pca": _pca}
+
+
+def _variants(family, k, seed=0):
+    rng = np.random.RandomState(seed)
+    return {f"m{i}": FAMILIES[family](rng, i) for i in range(k)}
+
+
+def _int_X(n, seed=1):
+    # integer-valued f32: exactly representable, every reduction order
+    # exact — the bitwise-parity basis the sweep gates established
+    return np.random.RandomState(seed).randint(-4, 5, size=(n, D)).astype(np.float32)
+
+
+def _dedicated_outputs(models, X):
+    out = {}
+    for mid, m in models.items():
+        with ModelServer(f"ded-{mid}-{id(m):x}", m) as srv:
+            out[mid] = {c: np.asarray(v) for c, v in srv.predict(X).items()}
+    return out
+
+
+# -- per-tenant bitwise parity ------------------------------------------------
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_multiplex_matches_dedicated_bitwise(family):
+    models = _variants(family, 4)
+    X = _int_X(7)
+    expected = _dedicated_outputs(models, X)
+    with MultiplexServer(f"mux_{family}", models) as mux:
+        for mid in models:
+            got = mux.predict(X, model_id=mid)
+            assert sorted(got) == sorted(expected[mid])
+            for c in got:
+                np.testing.assert_array_equal(
+                    np.asarray(got[c]), expected[mid][c], err_msg=f"{mid}/{c}"
+                )
+        mux.drain()
+        mux.assert_steady_state()
+
+
+def test_interleaved_tenants_share_one_dispatch_plane():
+    """A mixed-tenant stream: per-row lane routing through the shared
+    micro-batcher keeps every tenant's outputs bitwise-equal to its
+    dedicated server, and steady state stays zero new compiles."""
+    models = _variants("linreg", 4)
+    X = _int_X(3)
+    expected = _dedicated_outputs(models, X)
+    with MultiplexServer("mux_mixed", models, max_batch=64, max_wait_ms=5) as mux:
+        before = profiling.counters("precompile.")
+        futs = [
+            (mid, mux.submit(X, model_id=mid))
+            for _ in range(6)
+            for mid in models
+        ]
+        for mid, f in futs:
+            got = f.result(timeout=60)
+            np.testing.assert_array_equal(
+                np.asarray(got["prediction"]), expected[mid]["prediction"]
+            )
+        delta = profiling.counter_deltas(before, "precompile.")
+        assert delta.get("precompile.compile", 0) == 0, delta
+        assert delta.get("precompile.fallback", 0) == 0, delta
+        mux.drain()
+        mux.assert_steady_state()
+
+
+def test_single_variant_defaults_model_id():
+    models = _variants("linreg", 1)
+    X = _int_X(4)
+    expected = _dedicated_outputs(models, X)
+    with MultiplexServer("mux_one", models) as mux:
+        got = mux.predict(X)  # no model_id: the single variant is implied
+        np.testing.assert_array_equal(
+            np.asarray(got["prediction"]), expected["m0"]["prediction"]
+        )
+
+
+# -- HBM lane paging ----------------------------------------------------------
+
+
+def test_paging_parity_and_zero_new_compiles():
+    """8 registered variants on a 2-lane HBM budget: every request pages
+    its variant in on demand (LRU eviction of idle lanes), outputs stay
+    bitwise-equal to dedicated servers ACROSS page-in/eviction churn, and
+    the whole paged stream adds zero new compiles — the traced-lane-index
+    write kernel is the PR 12 insight made load-bearing."""
+    models = _variants("linreg", 8, seed=3)
+    X = _int_X(5, seed=4)
+    expected = _dedicated_outputs(models, X)
+    with MultiplexServer("mux_paged", models, resident_lanes=2) as mux:
+        assert mux.lanes()["n_lanes"] == 2
+        before = profiling.counters("precompile.")
+        for _ in range(2):  # two full walks: forces eviction + re-page-in
+            for mid in models:
+                got = mux.predict(X, model_id=mid)
+                np.testing.assert_array_equal(
+                    np.asarray(got["prediction"]),
+                    expected[mid]["prediction"],
+                    err_msg=mid,
+                )
+        delta = profiling.counter_deltas(before, "precompile.")
+        assert delta.get("precompile.compile", 0) == 0, delta
+        assert delta.get("precompile.fallback", 0) == 0, delta
+        snap = mux.lanes()
+        assert snap["registered"] == 8 and snap["resident"] == 2
+        # 16 requests on 2 lanes: at most 2 hits (the initial residents),
+        # every other access is a page-in over an eviction
+        assert snap["page_in"] >= 14, snap
+        assert snap["evictions"] >= 12, snap
+        assert snap["page_in_latency"]["count"] == snap["page_in"]
+        mux.drain()
+        mux.assert_steady_state()
+
+
+def test_page_wait_timeout_is_typed_overload(monkeypatch):
+    """Every lane pinned by in-flight traffic + a page-in request for a
+    spilled variant = the bounded wait converts to the typed retryable
+    ServerOverloaded instead of parking forever (graftlint R9)."""
+    monkeypatch.setenv("SRML_SERVE_PAGE_WAIT_S", "0.2")
+    models = _variants("linreg", 3)
+    X = _int_X(2)
+    with MultiplexServer("mux_pin", models, resident_lanes=1,
+                         max_batch=16, max_wait_ms=2000) as mux:
+        # hold m0's lane pinned: the request sits in the coalesce window
+        # (max_wait_ms) with pending > 0 on the only lane
+        fut = mux.submit(X, model_id="m0")
+        with pytest.raises(ServerOverloaded, match="resident lanes"):
+            mux.submit(X, model_id="m1")
+        fut.result(timeout=60)
+        mux.drain()
+
+
+# -- contract errors ----------------------------------------------------------
+
+
+def test_unknown_model_id_is_keyerror():
+    with MultiplexServer("mux_err", _variants("linreg", 2)) as mux:
+        with pytest.raises(KeyError, match="no registered variant"):
+            mux.submit(_int_X(1), model_id="nope")
+
+
+def test_missing_model_id_with_many_variants_is_valueerror():
+    with MultiplexServer("mux_noid", _variants("linreg", 2)) as mux:
+        with pytest.raises(ValueError, match="requires model_id"):
+            mux.submit(_int_X(1))
+
+
+def test_signature_mismatch_rejected():
+    rng = np.random.RandomState(0)
+    a = _linreg(rng, 0)
+    wide = LinearRegressionModel(
+        coef_=np.arange(D + 1, dtype=np.float64),
+        intercept_=0.0,
+        n_cols=D + 1,
+        dtype="float32",
+    )
+    with pytest.raises(ValueError, match="lane_signature"):
+        MultiplexServer("mux_sig", {"a": a, "b": wide})
+    # class mismatch is also a signature mismatch (different kernel ns)
+    with pytest.raises(ValueError, match="lane_signature"):
+        MultiplexServer("mux_cls", {"a": a, "b": _kmeans(rng, 0)})
+
+
+def test_unmultiplexable_model_gives_actionable_error():
+    class _NoLanes:
+        pass
+
+    with pytest.raises(TypeError, match="not multiplexable"):
+        lane_entry_for(_NoLanes())
+
+
+def test_lane_signature_distinguishes_logistic_classes():
+    rng = np.random.RandomState(0)
+    a = _logreg(rng, 0)
+    b = _logreg(rng, 1)
+    assert lane_signature(lane_entry_for(a)) == lane_signature(lane_entry_for(b))
+    c = LogisticRegressionModel(
+        coef_=np.asarray(a.coef_),
+        intercept_=np.asarray(a.intercept_),
+        classes_=np.array([10.0, 20.0, 30.0]),  # different label vocabulary
+        n_cols=D,
+        dtype="float32",
+    )
+    assert lane_signature(lane_entry_for(a)) != lane_signature(lane_entry_for(c))
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_per_tenant_counters_and_stats():
+    models = _variants("linreg", 2)
+    X = _int_X(3)
+    with MultiplexServer("mux_obs", models) as mux:
+        for _ in range(3):
+            mux.predict(X, model_id="m0")
+        mux.predict(X, model_id="m1")
+        stats = mux.stats()
+        assert stats["lanes"]["registered"] == 2
+        assert stats["lanes"]["resident"] == 2
+        assert mux.model_ids() == ["m0", "m1"]
+        ns = "serving.mux_obs"
+        assert profiling.counter(f"{ns}.tenant.m0.requests") == 3
+        assert profiling.counter(f"{ns}.tenant.m0.rows") == 9
+        assert profiling.counter(f"{ns}.tenant.m1.requests") == 1
+        lat = profiling.percentiles("serve.mux_obs.tenant.m0.latency")
+        assert lat["count"] == 3 and lat["p50"] > 0
+        mux.drain()
+
+
+# -- registry / router deployment ---------------------------------------------
+
+
+def test_registry_multiplex_lifecycle():
+    models = _variants("linreg", 3)
+    X = _int_X(4)
+    expected = _dedicated_outputs(models, X)
+    with ModelRegistry() as reg:
+        srv = reg.multiplex("fleet", models, resident_lanes=2)
+        assert isinstance(srv, MultiplexServer)
+        assert "fleet" in reg and reg.get("fleet") is srv
+        with pytest.raises(ValueError, match="already registered"):
+            reg.multiplex("fleet", models)
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register("fleet", models["m0"])
+        got = reg.get("fleet").predict(X, model_id="m2")
+        np.testing.assert_array_equal(
+            np.asarray(got["prediction"]), expected["m2"]["prediction"]
+        )
+        health = reg.health()
+        from spark_rapids_ml_tpu.serving import READY
+
+        assert health["models"]["fleet"]["state"] == READY
+        reg.unregister("fleet")
+        assert "fleet" not in reg
+
+
+def test_registry_multiplex_failed_init_releases_name():
+    rng = np.random.RandomState(0)
+    bad = {"a": _linreg(rng, 0), "b": _kmeans(rng, 0)}
+    with ModelRegistry() as reg:
+        with pytest.raises(ValueError, match="lane_signature"):
+            reg.multiplex("doomed", bad)
+        assert "doomed" not in reg
+        reg.multiplex("doomed", _variants("linreg", 2))  # name is free again
+
+
+def test_router_serves_multiplexed_set():
+    models = _variants("linreg", 3)
+    X = _int_X(4)
+    expected = _dedicated_outputs(models, X)
+    router = Router(replicas=1)
+    try:
+        router.serve_multiplex("tenants", models)
+        for mid in models:
+            got = router.predict("tenants", X, model_id=mid)
+            np.testing.assert_array_equal(
+                np.asarray(got["prediction"]), expected[mid]["prediction"]
+            )
+        # client errors resolve the routed future (typed, no failover loop)
+        fut = router.submit("tenants", X, model_id="nope")
+        with pytest.raises(KeyError, match="no registered variant"):
+            fut.result(timeout=60)
+        fut = router.submit("tenants", X)  # 3 variants, no model_id
+        with pytest.raises(ValueError, match="requires model_id"):
+            fut.result(timeout=60)
+    finally:
+        router.shutdown()
