@@ -50,7 +50,18 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
         )
         self._parser.add_argument(
             "--refine_ratio", type=int, default=0,
-            help="ivfpq f32 re-score factor (0 = the engine default, 4)",
+            help="ivfpq f32 re-score factor (0 = the engine default, 4; "
+            "1 = ADC only, no refine)",
+        )
+        self._parser.add_argument(
+            "--opq", action="store_true",
+            help="ivfpq: train the learned OPQ rotation before the "
+            "subspace split (recall at equal bytes)",
+        )
+        self._parser.add_argument(
+            "--hot_fraction", type=float, default=0.0,
+            help="tiered residency: fraction of lists pinned HBM-resident "
+            "(0 = unset, fully resident; ann/tier.py pages the rest)",
         )
 
     def run_once(
@@ -98,6 +109,8 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
         nprobe = self.args.nprobe or default_nprobe(nlist)
         algorithm = self.args.algorithm
         algo_params = {"nlist": int(nlist), "nprobe": int(nprobe)}
+        if self.args.hot_fraction:
+            algo_params["hot_fraction"] = float(self.args.hot_fraction)
         if algorithm == "ivfpq":
             if self.args.pq_m:
                 algo_params["M"] = int(self.args.pq_m)
@@ -105,6 +118,8 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
                 algo_params["n_bits"] = int(self.args.pq_bits)
             if self.args.refine_ratio:
                 algo_params["refine_ratio"] = int(self.args.refine_ratio)
+            if self.args.opq:
+                algo_params["opq"] = True
         # block-stashed frames: extract_partition_features returns the SAME
         # array object every call, so staged caches hit on repeats (the kNN
         # arm's spread countermeasure)
@@ -172,13 +187,24 @@ class BenchmarkApproximateNearestNeighbors(BenchmarkBase):
             "phase_times": phases,
             "precompile_counters": profiling.counters("precompile"),
         }
+        # the residency breakdown behind the headline: where each item's
+        # bytes live, and how many items one device's 16 GiB admits at
+        # this (n_bits, M, hot_fraction) operating point
+        residency = model.index_residency()
+        out["hbm_bytes_per_item"] = residency["hbm_bytes_per_item"]
+        out["host_bytes_per_item"] = residency["host_bytes_per_item"]
+        out["items_per_device"] = residency["items_per_device"]
+        if self.args.hot_fraction:
+            out["hot_fraction"] = float(self.args.hot_fraction)
+            out["tier_counters"] = profiling.counters("ann.tier")
         if algorithm == "ivfpq":
             from spark_rapids_ml_tpu.parallel.mesh import get_mesh
 
             idx = model._ensure_staged_pq(get_mesh(model.num_workers))
             out["pq_m"] = int(idx.m_sub)
             out["pq_bits"] = int(idx.n_bits)
-            _m, _b, ratio = model._resolved_pq_params(model.n_cols)
+            out["pq_opq"] = bool(self.args.opq)
+            _m, _b, ratio, _opq = model._resolved_pq_params(model.n_cols)
             out["refine_ratio"] = int(ratio)
         if not self.args.no_recall:
             # the exact reference rides the SAME model (exactSearch flips

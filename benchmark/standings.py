@@ -339,7 +339,16 @@ def render(path: str) -> str:
         "ratio (~32× at d=256 defaults, gated ≥ 8× in ci/test.sh step 3n) "
         "is a captured number, not a claim — q/s multiples for the PQ arm "
         "must always be read next to it and to the refined recall "
-        "reported by `bench_approximate_nn.py --algorithm ivfpq`.",
+        "reported by `bench_approximate_nn.py --algorithm ivfpq`. "
+        "The artifact also carries the residency breakdown "
+        "(`hbm_bytes_per_item` / `host_bytes_per_item` / "
+        "`items_per_device` at a 16 GiB HBM budget, "
+        "`ApproximateNearestNeighborsModel.index_residency`): with "
+        "`--pq_bits 4` (two codes per byte, fast-scan ADC), `--opq`, and "
+        "`--hot_fraction` (tiered HBM/host-RAM lists, ann/tier.py) the "
+        "capacity headline is items-per-device at a recall floor, and "
+        "those knobs move `hbm_bytes_per_item` without touching recall's "
+        "denominator — quote capacity and recall from the same record.",
     ]
     return "\n".join(lines)
 

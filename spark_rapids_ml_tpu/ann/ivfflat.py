@@ -273,6 +273,114 @@ def ivf_probe_kernel(
     )(list_data, list_norm, counts, centroids, c_norm, queries)
 
 
+@partial(jax.jit, static_argnames=("mesh", "nprobe"))
+def ivf_select_kernel(
+    centroids: jax.Array,  # (nlist_pad, D) replicated
+    c_norm: jax.Array,     # (nlist_pad,) replicated, +inf pad rows
+    queries: jax.Array,    # (Q, D) replicated
+    mesh: Mesh,            # unused in the math — cache-key rider only, so
+    #                        executables never cross mesh placements
+    nprobe: int,
+) -> jax.Array:
+    """Probe selection ALONE, for the tiered pager (flat and PQ): the host
+    needs each block's probed list ids BEFORE dispatch so cold lists can
+    page in.  Op-for-op the select_probes math (expanded-form distances at
+    HIGH matmul precision, lax.top_k over the same +inf-padded norms) on
+    the same replicated arrays — the probe kernels re-select identically
+    inside their shard_map, so the pager and the scan always agree on
+    which lists a query touches."""
+    qn = (queries * queries).sum(axis=1)
+    cross = jnp.matmul(
+        queries, centroids.T,
+        precision=jax.lax.Precision.HIGH,
+        preferred_element_type=jnp.float32,
+    )
+    d2c = qn[:, None] - 2.0 * cross + c_norm[None, :]
+    _neg_d2, probes = jax.lax.top_k(-d2c, nprobe)
+    return probes.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "chunk"))
+def ivf_probe_tiered_kernel(
+    list_data: jax.Array,  # (n_dev * slots_per_shard, L_pad, D) slot pool
+    list_norm: jax.Array,  # (n_dev * slots_per_shard, L_pad) slot pool
+    list_slot: jax.Array,  # (nlist_pad,) int32 list->local-slot, 0 sentinel
+    counts: jax.Array,     # (nlist_pad,) int32 list-sharded
+    centroids: jax.Array,  # (nlist_pad, D) replicated (pad rows zero)
+    c_norm: jax.Array,     # (nlist_pad,) replicated ||c||^2, +inf pad rows
+    queries: jax.Array,    # (Q, D) replicated
+    mesh: Mesh,
+    k: int,
+    nprobe: int,
+    chunk: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """ivf_probe_kernel's body plus ONE indirection: probed local list ids
+    map through list_slot into the shard's HBM slot pool before the
+    data/norm gathers (ann/tier.py pages the pool).  Positions stay GLOBAL
+    (probe * L_pad + slot) so ids/refine are untouched, and the gathered
+    tiles hold byte-for-byte the values the resident kernel gathers —
+    the tiered-vs-resident bitwise parity argument.  A probed list whose
+    slot is 0 reads the sentinel (+inf norms) and drops out: residency
+    bugs degrade recall, never corrupt."""
+    _rows, l_pad, _d = list_data.shape
+
+    def per_shard(ld_loc, ln_loc, slot_loc, cnt_loc, c, cn, q):
+        lps = cnt_loc.shape[0]
+        Q = q.shape[0]
+        qn, _d2p, probes, lp, is_local = select_probes(
+            q, c, cn, nprobe, lps, mesh
+        )
+        slot = jnp.arange(l_pad, dtype=jnp.int32)
+
+        def chunk_body(carry, i):
+            qs = jax.lax.dynamic_slice_in_dim(q, i * chunk, chunk)
+            qn_c = jax.lax.dynamic_slice_in_dim(qn, i * chunk, chunk)
+            lp_c = jax.lax.dynamic_slice_in_dim(lp, i * chunk, chunk)
+            loc_c = jax.lax.dynamic_slice_in_dim(is_local, i * chunk, chunk)
+            pr_c = jax.lax.dynamic_slice_in_dim(probes, i * chunk, chunk)
+            # THE tiered indirection: local list -> pool slot, then gather
+            # from the slot pool instead of the full list plane
+            ls_c = jnp.take(slot_loc, lp_c, axis=0)
+            tile = jnp.take(ld_loc, ls_c, axis=0)
+            xn = jnp.take(ln_loc, ls_c, axis=0)
+            cross = jnp.einsum(
+                "qd,qpld->qpl", qs, tile,
+                precision=jax.lax.Precision.HIGH,
+                preferred_element_type=jnp.float32,
+            )
+            d2 = qn_c[:, None, None] - 2.0 * cross + xn
+            valid = loc_c[:, :, None] & (
+                slot[None, None, :] < jnp.take(cnt_loc, lp_c, axis=0)[:, :, None]
+            )
+            d2 = jnp.where(valid, d2, jnp.inf)
+            pos = pr_c[:, :, None] * l_pad + slot[None, None, :]
+            pos = jnp.where(valid, pos, _POS_SENTINEL)
+            bd, bp = _lex_topk(
+                d2.reshape(chunk, -1), pos.reshape(chunk, -1), k
+            )
+            return carry, (bd, bp)
+
+        n_chunks = Q // chunk
+        _, (ds, ps) = jax.lax.scan(
+            chunk_body, 0, jnp.arange(n_chunks, dtype=jnp.int32)
+        )
+        best_d, best_p = merge_shard_topk(
+            ds.reshape(Q, k), ps.reshape(Q, k), mesh, k
+        )
+        return jnp.sqrt(jnp.maximum(best_d, 0.0)), best_p
+
+    return shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(), P(), P(),
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(list_data, list_norm, list_slot, counts, centroids, c_norm, queries)
+
+
 @jax.jit
 def _assign_block_kernel(X: jax.Array, centroids: jax.Array) -> jax.Array:
     """Fused distance+argmin list assignment for one pow2 row block
@@ -569,8 +677,175 @@ def index_from_packed(packed: PackedIVF, mesh: Mesh) -> IVFFlatIndex:
     )
 
 
+class TieredIVFFlatIndex:
+    """IVF-Flat index whose data/norm list planes live in a
+    TieredListPlanes HBM pool (hot lists pinned, cold lists LRU-paged from
+    the padded host layout).  Same search frame contract as IVFFlatIndex;
+    paging is a residency change, never a math change.  The tier's host
+    planes are VIEWS of the padded layout arrays, so a mutable holder that
+    edits its mirrors in place only has to tier.refresh() the touched
+    lists for resident copies to match (non-resident lists pick the edit
+    up at their next page-in — the tombstone-interaction contract)."""
+
+    __slots__ = (
+        "tier", "counts", "centroids", "c_norm", "ids", "n_items",
+        "n_lists", "nlist_pad", "l_pad", "dim", "hot_fraction",
+    )
+
+    def __init__(self, tier, counts, centroids, c_norm, ids, n_items,
+                 n_lists, nlist_pad, l_pad, dim, hot_fraction):
+        self.tier = tier            # TieredListPlanes over [data, norms]
+        self.counts = counts
+        self.centroids = centroids
+        self.c_norm = c_norm
+        self.ids = ids
+        self.n_items = n_items
+        self.n_lists = n_lists
+        self.nlist_pad = nlist_pad
+        self.l_pad = l_pad
+        self.dim = dim
+        self.hot_fraction = float(hot_fraction)
+
+    def device_bytes(self) -> int:
+        return int(
+            self.tier.device_bytes() + self.counts.nbytes
+            + self.centroids.nbytes + self.c_norm.nbytes
+        )
+
+    def host_bytes(self) -> int:
+        return self.tier.host_bytes()
+
+
+def tiered_stage_padded_layout(
+    data: np.ndarray,
+    x_norm: np.ndarray,
+    ids_pad: np.ndarray,
+    counts: np.ndarray,
+    cpad: np.ndarray,
+    c_norm: np.ndarray,
+    nlist_pad: int,
+    l_pad: int,
+    n_items: int,
+    n_lists: int,
+    mesh: Mesh,
+    hot_fraction: float,
+    pool_slots: int = None,
+) -> TieredIVFFlatIndex:
+    """Stage a padded host layout with only `hot_fraction` of each shard's
+    lists HBM-resident (stage_padded_layout's tiered twin).  The tier
+    planes are reshaped VIEWS of `data`/`x_norm` — zero host copies, and
+    in-place mutation of those arrays is visible to every later page-in."""
+    from .tier import TieredListPlanes
+
+    d = data.shape[1]
+    tier = TieredListPlanes(
+        planes=[
+            data.reshape(nlist_pad, l_pad, d),
+            x_norm.reshape(nlist_pad, l_pad),
+        ],
+        sentinels=[None, np.inf],
+        counts=counts,
+        mesh=mesh,
+        hot_fraction=hot_fraction,
+        pool_slots=pool_slots,
+        name="ann.tier",
+    )
+    with profiling.phase("ann.stage", bytes=tier.device_bytes()):
+        index = TieredIVFFlatIndex(
+            tier=tier,
+            counts=jax.device_put(counts.astype(np.int32), data_sharding(mesh)),
+            centroids=jax.device_put(cpad, replicated_sharding(mesh)),
+            c_norm=jax.device_put(c_norm, replicated_sharding(mesh)),
+            ids=ids_pad,
+            n_items=n_items,
+            n_lists=n_lists,
+            nlist_pad=nlist_pad,
+            l_pad=l_pad,
+            dim=d,
+            hot_fraction=hot_fraction,
+        )
+    return index
+
+
+def tiered_index_from_packed(
+    packed: PackedIVF,
+    mesh: Mesh,
+    hot_fraction: float,
+    pool_slots: int = None,
+) -> TieredIVFFlatIndex:
+    """index_from_packed's tiered twin: padded host layout + slot-pool
+    staging at the given hot fraction."""
+    data, x_norm, ids_pad, counts, cpad, c_norm, nlist_pad, l_pad = (
+        padded_host_layout(packed, mesh)
+    )
+    return tiered_stage_padded_layout(
+        data, x_norm, ids_pad, counts, cpad, c_norm, nlist_pad, l_pad,
+        packed.n_items, packed.n_lists, mesh, hot_fraction, pool_slots,
+    )
+
+
 def _effective_nprobe(index: IVFFlatIndex, nprobe: int) -> int:
     return int(max(1, min(nprobe, index.nlist_pad)))
+
+
+def _tiered_flat_probe_all(
+    index: TieredIVFFlatIndex,
+    q: np.ndarray,
+    k: int,
+    np_eff: int,
+    mesh: Mesh,
+    block: int,
+    chunk: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Tiered flat probe sweep — the PQ pager's exact shape: selection
+    kernel replays probe selection for the host, the planner splits each
+    block into groups whose cold lists fit the pool, each group dispatches
+    at the SAME block bucket with its queries at their ORIGINAL offsets
+    (zeros elsewhere; every op is row-independent, so group rows carry
+    bitwise the all-resident sweep's values).  One cached executable per
+    shape — zero new compiles at steady state."""
+    n = q.shape[0]
+    out_d = np.empty((n, k), np.float32)
+    out_p = np.empty((n, k), np.int32)
+    # Pass 1: dispatch every block's selection kernel, then ONE batched
+    # device_get — the planner needs host probes, but not one sync per block.
+    blocks = []
+    sel = []
+    for start in range(0, n, block):
+        n_q = min(block, n - start)
+        qb = np.zeros((block, index.dim), np.float32)
+        qb[:n_q] = q[start : start + n_q]
+        blocks.append((start, n_q, qb))
+        sel.append(
+            cached_kernel(
+                "ann_select", ivf_select_kernel,
+                index.centroids, index.c_norm, jnp.asarray(qb),
+                mesh=mesh, nprobe=np_eff,
+            )
+        )
+    # Pass 2: plan/page/dispatch per group, deferring the result fetch to
+    # ONE device_get — tier buffers are immutably replaced on slot writes,
+    # so earlier results stay valid on their old buffers.
+    spans = []
+    parts = []
+    for (start, n_q, qb), probes in zip(blocks, jax.device_get(sel)):
+        for s, e in index.tier.plan_groups(probes[:n_q]):
+            planes, slot_map = index.tier.acquire(probes[s:e].ravel())
+            gq = np.zeros((block, index.dim), np.float32)
+            gq[s:e] = qb[s:e]
+            spans.append((start, s, e))
+            parts.append(
+                cached_kernel(
+                    "ann_probe_tiered", ivf_probe_tiered_kernel,
+                    planes[0], planes[1], slot_map, index.counts,
+                    index.centroids, index.c_norm, jnp.asarray(gq),
+                    mesh=mesh, k=k, nprobe=np_eff, chunk=chunk,
+                )
+            )
+    for (start, s, e), (d_host, p_host) in zip(spans, jax.device_get(parts)):
+        out_d[start + s : start + e] = d_host[s:e]
+        out_p[start + s : start + e] = p_host[s:e]
+    return out_d, out_p
 
 
 def ivfflat_search_prepared(
@@ -607,6 +882,15 @@ def ivfflat_search_prepared(
     np_eff = _effective_nprobe(index, nprobe)
     block = _query_block_bucket(q.shape[0], query_block)
     chunk = _probe_chunk(block, np_eff, index.l_pad, index.dim)
+    if isinstance(index, TieredIVFFlatIndex):
+        d_all, p_all = _tiered_flat_probe_all(
+            index, np.asarray(q, dtype=dtype), k, np_eff, mesh, block, chunk
+        )
+        profiling.incr_counter("ann.searches")
+        with profiling.phase("ann.merge"):
+            ids_all = index.ids[np.minimum(p_all, index.ids.size - 1)]
+            ids_all[np.isinf(d_all)] = -1
+            return d_all[:, :k_eff], ids_all[:, :k_eff]
     starts = list(range(0, q.shape[0], block))
     pending: list = []
     out_d, out_i = [], []
@@ -680,11 +964,28 @@ def warm_probe_kernels(
     block = _query_block_bucket(n_queries or query_block, query_block)
     chunk = _probe_chunk(block, np_eff, index.l_pad, index.dim)
     q_aval = aval((block, index.dim), dtype)
+    statics = dict(k=k, nprobe=np_eff, chunk=chunk)
+    if isinstance(index, TieredIVFFlatIndex):
+        planes, slot_map = index.tier.snapshot()
+        args = (
+            planes[0], planes[1], slot_map, index.counts,
+            index.centroids, index.c_norm, q_aval,
+        )
+        key = kernel_cache_key("ann_probe_tiered", args, mesh, statics)
+        global_precompiler().submit(
+            key, ivf_probe_tiered_kernel, *args, mesh=mesh, **statics
+        )
+        sel_args = (index.centroids, index.c_norm, q_aval)
+        sel_statics = dict(nprobe=np_eff)
+        sel_key = kernel_cache_key("ann_select", sel_args, mesh, sel_statics)
+        global_precompiler().submit(
+            sel_key, ivf_select_kernel, *sel_args, mesh=mesh, **sel_statics
+        )
+        return [key, sel_key]
     args = (
         index.list_data, index.list_norm, index.counts,
         index.centroids, index.c_norm, q_aval,
     )
-    statics = dict(k=k, nprobe=np_eff, chunk=chunk)
     key = kernel_cache_key("ann_probe", args, mesh, statics)
     global_precompiler().submit(
         key, ivf_probe_kernel, *args, mesh=mesh, **statics

@@ -45,12 +45,14 @@ from ..ops.precompile import shape_bucket
 from .ivfflat import (
     IVFFlatIndex,
     PackedIVF,
+    TieredIVFFlatIndex,
     _MIN_LIST_SLOTS,
     assign_nearest,
     item_norms,
     ivfflat_search_prepared,
     padded_host_layout,
     stage_padded_layout,
+    tiered_stage_padded_layout,
     warm_probe_kernels,
 )
 
@@ -64,8 +66,21 @@ class MutableIVFIndex:
     never a compile), then swaps the staged IVFFlatIndex reference
     atomically.  `index` is the snapshot readers search."""
 
-    def __init__(self, packed: PackedIVF, mesh: Any):
+    def __init__(
+        self,
+        packed: PackedIVF,
+        mesh: Any,
+        hot_fraction: float = 1.0,
+        pool_slots: Optional[int] = None,
+    ):
         self._mesh = mesh
+        # hot_fraction < 1 opts into TIERED staging (ann/tier.py): the tier's
+        # host planes are views of this holder's mirrors, so in-place
+        # mutations are visible to every later page-in; deletes additionally
+        # refresh() the touched lists' RESIDENT copies so tombstones are
+        # honored device-side immediately (the tombstone-interaction gate)
+        self._hot_fraction = float(hot_fraction)
+        self._pool_slots = pool_slots
         self._lock = sanitize.lockdep_lock(
             "ann.mutable.mutator", factory=threading.RLock
         )
@@ -235,6 +250,7 @@ class MutableIVFIndex:
         ignored (idempotent deletes).  Only the small (nlist_pad, L_pad)
         norm plane restages — the data buffer is untouched."""
         removed = 0
+        touched: List[int] = []
         with self._lock:
             for i in np.asarray(ids, dtype=np.int64):
                 pos = self._pos_of_id.pop(int(i), None)
@@ -244,11 +260,12 @@ class MutableIVFIndex:
                 self._tombstones[lst, slot] = True
                 self._norms[pos] = np.inf
                 self._ids[pos] = -1
+                touched.append(int(lst))
                 removed += 1
             if removed:
                 self._live -= removed
                 self._dead += removed
-                self._index = self._swap_norms()
+                self._index = self._swap_norms(np.unique(touched))
                 profiling.incr_counter("ann.mutate.deletes", removed)
         return removed
 
@@ -339,6 +356,19 @@ class MutableIVFIndex:
         # would let a later in-place add/delete mutate an older snapshot
         # a concurrent search still holds (device buffers are immutable
         # uploads, so they need no copy)
+        if self._hot_fraction < 1.0:
+            # tiered restage: a NEW slot pool over the (possibly regrown)
+            # mirrors — device_puts plus cached slot writes, never a compile
+            idx = tiered_stage_padded_layout(
+                self._data, self._norms, self._ids.copy(), self._counts,
+                self._cpad, self._c_norm, self._nlist_pad, self._l_pad,
+                self._live, self._n_lists, self._mesh,
+                self._hot_fraction, self._pool_slots,
+            )
+            profiling.incr_counter(
+                "ann.mutate.bytes", int(idx.tier.device_bytes())
+            )
+            return idx
         idx = stage_padded_layout(
             self._data, self._norms, self._ids.copy(), self._counts,
             self._cpad, self._c_norm, self._nlist_pad, self._l_pad,
@@ -349,14 +379,32 @@ class MutableIVFIndex:
         )
         return idx
 
-    def _swap_norms(self) -> IVFFlatIndex:
+    def _swap_norms(self, touched_lists: np.ndarray) -> IVFFlatIndex:
         """Delete-path restage: only the (nlist_pad, L_pad) norm plane
-        re-uploads; the data/counts/centroid device buffers carry over."""
+        re-uploads; the data/counts/centroid device buffers carry over.
+        Tiered: the mirror edit is already visible to future page-ins
+        (views), so only the touched lists' RESIDENT slot copies re-page —
+        paged-in cold lists honor the tombstone bitmap either way."""
         import jax
 
         from ..parallel.mesh import axis_sharding
 
         old = self._index
+        if isinstance(old, TieredIVFFlatIndex):
+            old.tier.refresh(touched_lists)
+            return TieredIVFFlatIndex(
+                tier=old.tier,
+                counts=old.counts,
+                centroids=old.centroids,
+                c_norm=old.c_norm,
+                ids=self._ids.copy(),  # snapshot isolation (see _stage)
+                n_items=self._live,
+                n_lists=self._n_lists,
+                nlist_pad=self._nlist_pad,
+                l_pad=self._l_pad,
+                dim=old.dim,
+                hot_fraction=self._hot_fraction,
+            )
         norms_dev = jax.device_put(
             self._norms.reshape(self._nlist_pad, self._l_pad),
             axis_sharding(self._mesh, 0, 2),

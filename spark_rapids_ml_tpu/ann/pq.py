@@ -51,8 +51,9 @@
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -68,8 +69,9 @@ from ..parallel.mesh import (
     data_sharding,
     replicated_sharding,
 )
-from ..ops.pallas_pq import lut_accumulate
+from ..ops.pallas_pq import fastscan_lut_accumulate, lut_accumulate, pack_codes4
 from ..ops.precompile import cached_kernel, kernel_cache_key, shape_bucket
+from .tier import TieredListPlanes
 from .ivfflat import (
     _LIST_ALIGN,
     _MIN_LIST_SLOTS,
@@ -78,6 +80,7 @@ from .ivfflat import (
     _lex_topk,
     _probe_tile_budget,
     assign_nearest,
+    ivf_select_kernel,
     merge_shard_topk,
     select_probes,
     train_coarse_quantizer,
@@ -89,9 +92,32 @@ _REFINE_BUDGET = 256 << 20
 # subspace-seed stride: each codebook trains with its own deterministic
 # seed so subspaces do not share init draws
 _SUBSPACE_SEED_STRIDE = 0x51F1_5EED
+# OPQ training sample cap (FAISS-style) and alternation count: rotation
+# quality saturates after a handful of assign/encode/Procrustes rounds
+_OPQ_TRAIN_CAP = 65536
+_OPQ_ITERS = 4
+_OPQ_KMEANS_ITERS = 8
 
 DEFAULT_N_BITS = 8
 DEFAULT_REFINE_RATIO = 4
+
+
+def pq_fastscan(n_bits: int, m_sub: int) -> bool:
+    """ONE fast-scan route derivation shared by the build validator, the
+    stager, dispatch, and warm (the knn _fused_epilogue_route discipline:
+    the flag picks the staged code layout AND is a cache-key static, so
+    every consumer must derive it identically — here they all read the
+    staged index's `fastscan` attribute, which this function set).  n_bits=4
+    packs two codes per byte and scans through the 16-lane LUT kernel;
+    every other width stays on the one-byte-per-code scan.  An ODD m_sub
+    cannot pack two codes per byte, so it stays on the unpacked route too
+    (the ops-layer packer pack_codes4 raises the typed error on odd
+    widths — this derivation keeps such payloads from ever reaching it).
+    Escape hatch: SRML_PQ_FASTSCAN=0 keeps n_bits=4 on the unpacked route
+    (read at STAGING, like the fused-epilogue escape)."""
+    if int(n_bits) != 4 or int(m_sub) % 2:
+        return False
+    return os.environ.get("SRML_PQ_FASTSCAN", "1") != "0"
 
 
 def default_m_sub(dim: int) -> int:
@@ -136,11 +162,12 @@ class PackedPQ:
     __slots__ = (
         "codes", "scalars", "ids", "items", "counts", "centroids",
         "codebooks", "n_lists", "n_items", "dim", "m_sub", "n_bits",
+        "rotation",
     )
 
     def __init__(
         self, codes, scalars, ids, items, counts, centroids, codebooks,
-        n_lists, n_items, dim, m_sub, n_bits,
+        n_lists, n_items, dim, m_sub, n_bits, rotation=None,
     ):
         self.codes = codes          # (N, m_sub) uint8, list-sorted
         self.scalars = scalars      # (N,) f32 ADC item scalars, list-sorted
@@ -155,6 +182,10 @@ class PackedPQ:
         self.dim = int(dim)
         self.m_sub = int(m_sub)
         self.n_bits = int(n_bits)
+        # optional OPQ rotation (d_pad, d_pad) f32 orthogonal, applied to
+        # RESIDUALS (r^ = r @ R.T); None = identity (wire back-compat: the
+        # srml-pq payload simply omits the R entry)
+        self.rotation = rotation
 
 
 def reconstruct(packed: PackedPQ, rows: Optional[np.ndarray] = None) -> np.ndarray:
@@ -168,11 +199,72 @@ def reconstruct(packed: PackedPQ, rows: Optional[np.ndarray] = None) -> np.ndarr
     rec = np.zeros((codes.shape[0], d_pad), np.float32)
     for j in range(m_sub):
         rec[:, j * dsub : (j + 1) * dsub] = packed.codebooks[j][codes[:, j]]
+    if packed.rotation is not None:
+        # codewords live in ROTATED residual space: un-rotate (R orthogonal,
+        # so the inverse of r @ R.T is r^ @ R), host f64 once-rounded
+        rec = (
+            rec.astype(np.float64) @ packed.rotation.astype(np.float64)
+        ).astype(np.float32)
     row_list = np.repeat(
         np.arange(packed.counts.shape[0]), packed.counts
     )[rows]
     cpad = _pad_features(packed.centroids, d_pad)
     return (rec + cpad[row_list])[:, : packed.dim]
+
+
+def _train_opq_rotation(
+    res: np.ndarray,
+    dsub: int,
+    ksub: int,
+    seed: int,
+    max_train_rows: int = _OPQ_TRAIN_CAP,
+    opq_iters: int = _OPQ_ITERS,
+) -> np.ndarray:
+    """Learn the OPQ rotation R (d_pad x d_pad, orthogonal) over the coarse
+    residuals: alternate (train per-subspace codebooks on the rotated
+    sample with the SAME kmeans engine) / (encode with the SAME fused
+    assign kernel) / (orthogonal Procrustes update), Ge et al. 2014.
+
+    Procrustes step: minimizing ||X R^T - X^||_F over orthogonal R is
+    maximizing tr(R M) with M = X^T X^, so with the SVD M = U S V^T the
+    optimum is R = V U^T — host float64, deterministic (fixed sample, fixed
+    subspace seeds), mesh-independent like every other trained bit.  The
+    returned R is the ONE f32 rounding every consumer shares."""
+    n, d_pad = res.shape
+    m_sub = d_pad // dsub
+    seed = int(seed) & 0x7FFFFFFF
+    if n > max_train_rows:
+        # deterministic sorted sample — the coarse trainer's sampling rule
+        rng = np.random.default_rng(seed)
+        sel = np.sort(rng.choice(n, size=max_train_rows, replace=False))
+        res = res[sel]
+    X = res.astype(np.float64)
+    R = np.eye(d_pad)
+    for it in range(int(opq_iters)):
+        Xr = (X @ R.T).astype(np.float32)
+        rec = np.zeros_like(X)
+        for j in range(m_sub):
+            sl = slice(j * dsub, (j + 1) * dsub)
+            cb = train_coarse_quantizer(
+                Xr[:, sl],
+                ksub,
+                (seed + _SUBSPACE_SEED_STRIDE * (m_sub * it + j + 1))
+                & 0x7FFFFFFF,
+                max_train_rows,
+                _OPQ_KMEANS_ITERS,
+                1e-3,
+                phase="ann.opq_codebook",
+            )
+            cj = assign_nearest(
+                Xr[:, sl], cb,
+                phase="ann.opq_encode_block",
+                counter="ann.opq_encode_blocks",
+            )
+            rec[:, sl] = cb[cj]
+        M = X.T @ rec
+        U, _s, Vh = np.linalg.svd(M)
+        R = Vh.T @ U.T
+    return R.astype(np.float32)
 
 
 def build_ivfpq_packed(
@@ -185,6 +277,7 @@ def build_ivfpq_packed(
     max_train_rows: int = _TRAIN_CAP,
     max_iter: int = 25,
     tol: float = 1e-4,
+    opq: bool = False,
 ) -> PackedPQ:
     """Train the coarse quantizer + per-subspace codebooks and pack the
     code lists.  Mesh-independent by the same construction as the flat
@@ -213,6 +306,17 @@ def build_ivfpq_packed(
         # so codebook centroids stay exactly zero there (means of zeros)
         cpad = _pad_features(centroids, d_pad)
         res = _pad_features(items, d_pad) - cpad[assign]
+        rotation = None
+        if opq:
+            with profiling.phase("ann.opq_train"):
+                rotation = _train_opq_rotation(res, dsub, ksub, seed)
+            # codebooks/codes/scalars all live in ROTATED residual space
+            # from here on; the stager rotates centroids and the search
+            # path rotates queries to match
+            res = (
+                res.astype(np.float64)
+                @ rotation.astype(np.float64).T
+            ).astype(np.float32)
         codebooks = np.stack(
             [
                 train_coarse_quantizer(
@@ -242,14 +346,21 @@ def build_ivfpq_packed(
     with profiling.phase("ann.pq_scalars"):
         # s_item = ||r^||^2 + 2 centroid . r^  in float64, stored f32:
         # mesh-independent index DATA (the same once-rounded contract as
-        # the staged c_norm/x_norm)
+        # the staged c_norm/x_norm).  Under OPQ both factors live in
+        # rotated space: r^ is the rotated-residual reconstruction and the
+        # centroid term uses c~ = c @ R.T — exactly the centroids the
+        # stager puts on device, so the kernel's three ADC terms stay one
+        # consistent decomposition of ||q~ - c~ - r^||^2.
         rec = np.zeros((n, d_pad), np.float64)
         idx = codes.astype(np.int64)
         for j in range(m_sub):
             rec[:, j * dsub : (j + 1) * dsub] = codebooks[j][idx[:, j]]
+        cass = cpad[assign].astype(np.float64)
+        if rotation is not None:
+            cass = cass @ rotation.astype(np.float64).T
         scalars = (
             np.einsum("nd,nd->n", rec, rec)
-            + 2.0 * np.einsum("nd,nd->n", cpad[assign].astype(np.float64), rec)
+            + 2.0 * np.einsum("nd,nd->n", cass, rec)
         ).astype(np.float32)
 
     with profiling.phase("ann.layout"):
@@ -269,6 +380,7 @@ def build_ivfpq_packed(
         d,
         m_sub,
         n_bits,
+        rotation=rotation,
     )
 
 
@@ -280,15 +392,18 @@ class IVFPQIndex:
     __slots__ = (
         "codes", "scalars", "counts", "centroids", "c_norm", "codebooks",
         "ids", "rows", "n_items", "n_lists", "nlist_pad", "l_pad",
-        "dim", "d_pad", "m_sub", "dsub", "ksub", "n_bits",
+        "dim", "d_pad", "m_sub", "dsub", "ksub", "n_bits", "fastscan",
+        "rotation",
     )
 
     def __init__(
         self, codes, scalars, counts, centroids, c_norm, codebooks, ids,
         rows, n_items, n_lists, nlist_pad, l_pad, dim, d_pad, m_sub, dsub,
-        ksub, n_bits,
+        ksub, n_bits, fastscan=False, rotation=None,
     ):
-        self.codes = codes          # (nlist_pad, L_pad, m_sub) u8 sharded
+        self.codes = codes          # (nlist_pad, L_pad, m_bytes) u8 sharded
+        #                             m_bytes = m_sub//2 packed (fast-scan)
+        #                             or m_sub one-byte codes
         self.scalars = scalars      # (nlist_pad, L_pad) f32 sharded
         self.counts = counts        # (nlist_pad,) int32 sharded
         self.centroids = centroids  # (nlist_pad, d_pad) f32 replicated
@@ -307,6 +422,10 @@ class IVFPQIndex:
         self.dsub = dsub
         self.ksub = ksub
         self.n_bits = n_bits
+        self.fastscan = bool(fastscan)  # staged-layout route flag: the ONE
+        #                                 derivation dispatch/warm read
+        self.rotation = rotation        # HOST (d_pad, d_pad) f32 OPQ R or
+        #                                 None; queries rotate host-side
 
     def device_bytes(self) -> int:
         """Global device-resident footprint (logical bytes across shards;
@@ -318,14 +437,18 @@ class IVFPQIndex:
         )
 
 
-def index_from_packed_pq(packed: PackedPQ, mesh: Mesh) -> IVFPQIndex:
-    """Expand a PackedPQ into this mesh's device layout — the SAME pow2
-    bucket geometry as the flat index (L_pad = pow2 of the longest list,
-    nlist_pad a multiple of lcm(8, n_dev), int32 position overflow guard),
-    with (nlist_pad, L_pad, m_sub) uint8 codes + (nlist_pad, L_pad) f32 ADC
-    scalars row-sharded on the LIST axis instead of f32 vectors."""
+def _pq_host_layout(packed: PackedPQ, mesh: Mesh) -> dict:
+    """The mesh's padded HOST layout of a PackedPQ — the SAME pow2 bucket
+    geometry as the flat index (L_pad = pow2 of the longest list, nlist_pad
+    a multiple of lcm(8, n_dev), int32 position overflow guard) — shared by
+    the all-resident and tiered stagers.  Fast-scan (n_bits=4) packs two
+    codes per byte HERE, and OPQ rotates the coarse centroids HERE
+    (c~ = c @ R.T, host f64 once-rounded): downstream of this layout the
+    whole device side lives in rotated/packed space and the probe kernel's
+    gathers/einsums never know the difference."""
     m_sub, dsub, d_pad = pq_geometry(packed.dim, packed.m_sub)
     ksub = packed.codebooks.shape[1]
+    fastscan = pq_fastscan(packed.n_bits, m_sub)
     n_dev = mesh.shape[DATA_AXIS]
     mult = math.lcm(_LIST_ALIGN, n_dev)
     nlist_pad = -(-max(packed.n_lists, 1) // mult) * mult
@@ -343,8 +466,10 @@ def index_from_packed_pq(packed: PackedPQ, mesh: Mesh) -> IVFPQIndex:
     row_list = np.repeat(np.arange(nlist_pad, dtype=np.int64), counts)
     slot = np.arange(n, dtype=np.int64) - offs[row_list]
     flat = row_list * l_pad + slot
-    codes = np.zeros((nlist_pad * l_pad, m_sub), np.uint8)
-    codes[flat] = packed.codes
+    src = pack_codes4(packed.codes) if fastscan else packed.codes
+    m_bytes = src.shape[1]
+    codes = np.zeros((nlist_pad * l_pad, m_bytes), np.uint8)
+    codes[flat] = src
     scal = np.zeros(nlist_pad * l_pad, np.float32)
     scal[flat] = packed.scalars
     ids_pad = np.full(nlist_pad * l_pad, -1, np.int64)
@@ -353,41 +478,176 @@ def index_from_packed_pq(packed: PackedPQ, mesh: Mesh) -> IVFPQIndex:
     rows_pad[flat] = np.arange(n, dtype=np.int64)
     cpad = np.zeros((nlist_pad, d_pad), np.float32)
     cpad[: packed.n_lists] = _pad_features(packed.centroids, d_pad)
+    if packed.rotation is not None:
+        cpad = (
+            cpad.astype(np.float64)
+            @ packed.rotation.astype(np.float64).T
+        ).astype(np.float32)
     c_norm = np.einsum(
         "nd,nd->n", cpad.astype(np.float64), cpad.astype(np.float64)
     ).astype(np.float32)
     c_norm[packed.n_lists :] = np.inf  # pad lists never win a probe slot
-    stage_bytes = int(codes.nbytes + scal.nbytes)
+    return dict(
+        codes=codes.reshape(nlist_pad, l_pad, m_bytes),
+        scalars=scal.reshape(nlist_pad, l_pad),
+        counts=counts,
+        ids=ids_pad,
+        rows=rows_pad,
+        cpad=cpad,
+        c_norm=c_norm,
+        nlist_pad=nlist_pad,
+        l_pad=l_pad,
+        m_sub=m_sub,
+        dsub=dsub,
+        d_pad=d_pad,
+        ksub=ksub,
+        fastscan=fastscan,
+    )
+
+
+def index_from_packed_pq(packed: PackedPQ, mesh: Mesh) -> IVFPQIndex:
+    """Expand a PackedPQ into this mesh's ALL-RESIDENT device layout:
+    (nlist_pad, L_pad, m_bytes) uint8 codes + (nlist_pad, L_pad) f32 ADC
+    scalars row-sharded on the LIST axis instead of f32 vectors."""
+    lay = _pq_host_layout(packed, mesh)
+    stage_bytes = int(lay["codes"].nbytes + lay["scalars"].nbytes)
     with profiling.phase("ann.stage", bytes=stage_bytes):
         index = IVFPQIndex(
-            codes=jax.device_put(
-                codes.reshape(nlist_pad, l_pad, m_sub),
-                axis_sharding(mesh, 0, 3),
-            ),
+            codes=jax.device_put(lay["codes"], axis_sharding(mesh, 0, 3)),
             scalars=jax.device_put(
-                scal.reshape(nlist_pad, l_pad), axis_sharding(mesh, 0, 2)
+                lay["scalars"], axis_sharding(mesh, 0, 2)
             ),
-            counts=jax.device_put(counts.astype(np.int32), data_sharding(mesh)),
-            centroids=jax.device_put(cpad, replicated_sharding(mesh)),
-            c_norm=jax.device_put(c_norm, replicated_sharding(mesh)),
+            counts=jax.device_put(
+                lay["counts"].astype(np.int32), data_sharding(mesh)
+            ),
+            centroids=jax.device_put(lay["cpad"], replicated_sharding(mesh)),
+            c_norm=jax.device_put(lay["c_norm"], replicated_sharding(mesh)),
             codebooks=jax.device_put(
                 np.ascontiguousarray(packed.codebooks, np.float32),
                 replicated_sharding(mesh),
             ),
-            ids=ids_pad,
-            rows=rows_pad,
+            ids=lay["ids"],
+            rows=lay["rows"],
             n_items=packed.n_items,
             n_lists=packed.n_lists,
-            nlist_pad=nlist_pad,
-            l_pad=l_pad,
+            nlist_pad=lay["nlist_pad"],
+            l_pad=lay["l_pad"],
             dim=packed.dim,
-            d_pad=d_pad,
-            m_sub=m_sub,
-            dsub=dsub,
-            ksub=ksub,
+            d_pad=lay["d_pad"],
+            m_sub=lay["m_sub"],
+            dsub=lay["dsub"],
+            ksub=lay["ksub"],
             n_bits=packed.n_bits,
+            fastscan=lay["fastscan"],
+            rotation=packed.rotation,
         )
     profiling.incr_counter("ann.stage_bytes", stage_bytes)
+    return index
+
+
+class TieredIVFPQIndex:
+    """IVF-PQ index whose codes/scalars list planes live in a
+    TieredListPlanes HBM pool (hot lists pinned, cold lists LRU-paged from
+    host RAM) — the billion-scale capacity mode.  The small replicated
+    planes (centroids, c_norm, codebooks) and the sharded counts stay fully
+    resident; ids/rows/refine payload were host-side already.  Same search
+    frame contract as IVFPQIndex; paging is a residency change, never a
+    math change (the tiered-vs-resident bitwise gate)."""
+
+    __slots__ = (
+        "tier", "counts", "centroids", "c_norm", "codebooks", "ids",
+        "rows", "n_items", "n_lists", "nlist_pad", "l_pad", "dim",
+        "d_pad", "m_sub", "dsub", "ksub", "n_bits", "fastscan",
+        "rotation", "hot_fraction",
+    )
+
+    def __init__(self, tier, counts, centroids, c_norm, codebooks, ids,
+                 rows, n_items, n_lists, nlist_pad, l_pad, dim, d_pad,
+                 m_sub, dsub, ksub, n_bits, fastscan, rotation,
+                 hot_fraction):
+        self.tier = tier            # TieredListPlanes over [codes, scalars]
+        self.counts = counts
+        self.centroids = centroids
+        self.c_norm = c_norm
+        self.codebooks = codebooks
+        self.ids = ids
+        self.rows = rows
+        self.n_items = n_items
+        self.n_lists = n_lists
+        self.nlist_pad = nlist_pad
+        self.l_pad = l_pad
+        self.dim = dim
+        self.d_pad = d_pad
+        self.m_sub = m_sub
+        self.dsub = dsub
+        self.ksub = ksub
+        self.n_bits = n_bits
+        self.fastscan = bool(fastscan)
+        self.rotation = rotation
+        self.hot_fraction = float(hot_fraction)
+
+    def device_bytes(self) -> int:
+        return int(
+            self.tier.device_bytes() + self.counts.nbytes
+            + self.centroids.nbytes + self.c_norm.nbytes
+            + self.codebooks.nbytes
+        )
+
+    def host_bytes(self) -> int:
+        """Host-RAM side of the tier split (the warm list planes; the
+        refine f32 payload stays accounted with the model, as before)."""
+        return self.tier.host_bytes()
+
+
+def tiered_index_from_packed_pq(
+    packed: PackedPQ,
+    mesh: Mesh,
+    hot_fraction: float,
+    pool_slots: Optional[int] = None,
+) -> TieredIVFPQIndex:
+    """Stage a PackedPQ with only `hot_fraction` of each shard's lists
+    HBM-resident; the rest stay in the host padded layout and page in
+    on probe.  Scalars carry the +inf sentinel (slot 0), so a probed
+    list that somehow is not resident scores +inf and drops out instead
+    of corrupting results."""
+    lay = _pq_host_layout(packed, mesh)
+    tier = TieredListPlanes(
+        planes=[lay["codes"], lay["scalars"]],
+        sentinels=[None, np.inf],
+        counts=lay["counts"],
+        mesh=mesh,
+        hot_fraction=hot_fraction,
+        pool_slots=pool_slots,
+        name="ann.tier",
+    )
+    with profiling.phase("ann.stage", bytes=tier.device_bytes()):
+        index = TieredIVFPQIndex(
+            tier=tier,
+            counts=jax.device_put(
+                lay["counts"].astype(np.int32), data_sharding(mesh)
+            ),
+            centroids=jax.device_put(lay["cpad"], replicated_sharding(mesh)),
+            c_norm=jax.device_put(lay["c_norm"], replicated_sharding(mesh)),
+            codebooks=jax.device_put(
+                np.ascontiguousarray(packed.codebooks, np.float32),
+                replicated_sharding(mesh),
+            ),
+            ids=lay["ids"],
+            rows=lay["rows"],
+            n_items=packed.n_items,
+            n_lists=packed.n_lists,
+            nlist_pad=lay["nlist_pad"],
+            l_pad=lay["l_pad"],
+            dim=packed.dim,
+            d_pad=lay["d_pad"],
+            m_sub=lay["m_sub"],
+            dsub=lay["dsub"],
+            ksub=lay["ksub"],
+            n_bits=packed.n_bits,
+            fastscan=lay["fastscan"],
+            rotation=packed.rotation,
+            hot_fraction=hot_fraction,
+        )
     return index
 
 
@@ -402,9 +662,9 @@ def _pq_probe_chunk(block: int, nprobe: int, l_pad: int, m_sub: int) -> int:
     return min(c, block)
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "chunk"))
+@partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "chunk", "fastscan"))
 def ivfpq_probe_kernel(
-    codes: jax.Array,      # (nlist_pad, L_pad, m_sub) u8 list-sharded
+    codes: jax.Array,      # (nlist_pad, L_pad, m_bytes) u8 list-sharded
     scalars: jax.Array,    # (nlist_pad, L_pad) f32 list-sharded ADC scalars
     counts: jax.Array,     # (nlist_pad,) int32 list-sharded
     centroids: jax.Array,  # (nlist_pad, d_pad) replicated
@@ -415,6 +675,7 @@ def ivfpq_probe_kernel(
     k: int,
     nprobe: int,
     chunk: int,
+    fastscan: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Probed IVF-PQ ADC search: (euclidean ADC distances (Q, k) ascending,
     positions (Q, k) into the padded list layout — the flat kernel's exact
@@ -423,10 +684,16 @@ def ivfpq_probe_kernel(
     1-dev-vs-8-dev parity argument carries over verbatim: ADC terms reduce
     over fixed-shape tiles (m_sub-wide LUT rows, dsub-wide table einsum)
     identical on every mesh size, and every selection orders by the total
-    (d2, pos) key."""
-    _nlist_pad, l_pad, m_sub = codes.shape
+    (d2, pos) key.
+
+    `fastscan` (cache-key static, set from the staged index's route flag)
+    switches the LUT scan to the packed two-codes-per-byte kernel — the
+    code tile is (.., m_sub//2) bytes, everything else is unchanged."""
+    _nlist_pad, l_pad, m_bytes = codes.shape
+    m_sub = codebooks.shape[0]
     ksub = codebooks.shape[1]
     dsub = codebooks.shape[2]
+    scan = fastscan_lut_accumulate if fastscan else lut_accumulate
 
     def per_shard(cd_loc, sc_loc, cnt_loc, c, cn, cb, q):
         lps = cd_loc.shape[0]
@@ -452,12 +719,12 @@ def ivfpq_probe_kernel(
             pr_c = jax.lax.dynamic_slice_in_dim(probes, i * chunk, chunk)
             t_c = jax.lax.dynamic_slice_in_dim(tables, i * chunk, chunk)
             # gather the chunk's probed CODE lists from the resident shard:
-            # (chunk, nprobe, L_pad, m_sub) uint8 — m_sub bytes/item, the
-            # whole bandwidth story
+            # (chunk, nprobe, L_pad, m_bytes) uint8 — m_sub bytes/item
+            # (8-bit) or m_sub/2 (fast-scan), the whole bandwidth story
             ctile = jnp.take(cd_loc, lp_c, axis=0)
             stile = jnp.take(sc_loc, lp_c, axis=0)  # (chunk, nprobe, L_pad)
-            acc = lut_accumulate(
-                t_c, ctile.reshape(chunk, nprobe * l_pad, m_sub)
+            acc = scan(
+                t_c, ctile.reshape(chunk, nprobe * l_pad, m_bytes)
             ).reshape(chunk, nprobe, l_pad)
             # ADC distance: probe term + query-table term + item scalar,
             # fixed association order (parity: same shapes on every mesh)
@@ -491,6 +758,104 @@ def ivfpq_probe_kernel(
         out_specs=(P(), P()),
         check_vma=False,
     )(codes, scalars, counts, centroids, c_norm, codebooks, queries)
+
+
+# the tiered PQ pager reuses the flat engine's selection-only kernel (ONE
+# select_probes replica, stated once) under its own cache name
+ivfpq_select_kernel = ivf_select_kernel
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "chunk", "fastscan"))
+def ivfpq_probe_tiered_kernel(
+    codes: jax.Array,      # (n_dev * slots_per_shard, L_pad, m_bytes) u8
+    scalars: jax.Array,    # (n_dev * slots_per_shard, L_pad) f32
+    list_slot: jax.Array,  # (nlist_pad,) int32 list->local-slot, 0 sentinel
+    counts: jax.Array,     # (nlist_pad,) int32 list-sharded
+    centroids: jax.Array,  # (nlist_pad, d_pad) replicated
+    c_norm: jax.Array,     # (nlist_pad,) replicated, +inf pad rows
+    codebooks: jax.Array,  # (m_sub, ksub, dsub) replicated
+    queries: jax.Array,    # (Q, d_pad) replicated
+    mesh: Mesh,
+    k: int,
+    nprobe: int,
+    chunk: int,
+    fastscan: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """The resident probe kernel's body plus ONE indirection: probed local
+    list ids map through list_slot into the shard's slot pool before the
+    codes/scalars gathers.  Positions stay GLOBAL (probe * L_pad + slot) so
+    ids/rows/refine are untouched, and the gathered tiles hold byte-for-
+    byte the values the resident kernel gathers (paged copies of the same
+    host rows, same shapes, same reduction order) — which is the whole
+    tiered-vs-resident bitwise parity argument.  A probed list whose slot
+    is 0 reads the sentinel (+inf scalars) and drops out: residency bugs
+    degrade recall, never corrupt."""
+    _rows, l_pad, m_bytes = codes.shape
+    m_sub = codebooks.shape[0]
+    dsub = codebooks.shape[2]
+    scan = fastscan_lut_accumulate if fastscan else lut_accumulate
+
+    def per_shard(cd_loc, sc_loc, slot_loc, cnt_loc, c, cn, cb, q):
+        lps = cnt_loc.shape[0]
+        Q = q.shape[0]
+        _qn, d2p, probes, lp, is_local = select_probes(
+            q, c, cn, nprobe, lps, mesh
+        )
+        tables = -2.0 * jnp.einsum(
+            "qjd,jcd->qjc",
+            q.reshape(Q, m_sub, dsub),
+            cb,
+            precision=jax.lax.Precision.HIGH,
+            preferred_element_type=jnp.float32,
+        )
+        slot = jnp.arange(l_pad, dtype=jnp.int32)
+
+        def chunk_body(carry, i):
+            d2p_c = jax.lax.dynamic_slice_in_dim(d2p, i * chunk, chunk)
+            lp_c = jax.lax.dynamic_slice_in_dim(lp, i * chunk, chunk)
+            loc_c = jax.lax.dynamic_slice_in_dim(is_local, i * chunk, chunk)
+            pr_c = jax.lax.dynamic_slice_in_dim(probes, i * chunk, chunk)
+            t_c = jax.lax.dynamic_slice_in_dim(tables, i * chunk, chunk)
+            # THE tiered indirection: local list -> pool slot, then gather
+            # from the slot pool instead of the full list plane
+            ls_c = jnp.take(slot_loc, lp_c, axis=0)
+            ctile = jnp.take(cd_loc, ls_c, axis=0)
+            stile = jnp.take(sc_loc, ls_c, axis=0)
+            acc = scan(
+                t_c, ctile.reshape(chunk, nprobe * l_pad, m_bytes)
+            ).reshape(chunk, nprobe, l_pad)
+            d2 = d2p_c[:, :, None] + (acc + stile)
+            valid = loc_c[:, :, None] & (
+                slot[None, None, :] < jnp.take(cnt_loc, lp_c, axis=0)[:, :, None]
+            )
+            d2 = jnp.where(valid, d2, jnp.inf)
+            pos = pr_c[:, :, None] * l_pad + slot[None, None, :]
+            pos = jnp.where(valid, pos, _POS_SENTINEL)
+            bd, bp = _lex_topk(
+                d2.reshape(chunk, -1), pos.reshape(chunk, -1), k
+            )
+            return carry, (bd, bp)
+
+        n_chunks = Q // chunk
+        _, (ds, ps) = jax.lax.scan(
+            chunk_body, 0, jnp.arange(n_chunks, dtype=jnp.int32)
+        )
+        best_d, best_p = merge_shard_topk(
+            ds.reshape(Q, k), ps.reshape(Q, k), mesh, k
+        )
+        return jnp.sqrt(jnp.maximum(best_d, 0.0)), best_p
+
+    return shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(), P(), P(), P(),
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(codes, scalars, list_slot, counts, centroids, c_norm, codebooks,
+      queries)
 
 
 def _effective_nprobe(index: IVFPQIndex, nprobe: int) -> int:
@@ -531,7 +896,7 @@ def ivfpq_search_prepared(
     kernel dispatch rides the AOT executable cache: repeat same-shape
     searches perform zero new compilations (refine adds none — it is host
     numpy)."""
-    from ..ops.knn import _pipeline_window, _query_block_bucket, _run_block_pipeline
+    from ..ops.knn import _query_block_bucket
 
     q = np.asarray(queries, dtype=np.float32)
     if q.ndim != 2 or q.shape[1] != index.dim:
@@ -546,9 +911,50 @@ def ivfpq_search_prepared(
     kp = _probe_k(k_eff, int(refine_ratio) if refine else 1, index.n_items)
     np_eff = _effective_nprobe(index, nprobe)
     qp = _pad_features(q, index.d_pad)
+    if index.rotation is not None:
+        # OPQ: the device side lives in rotated space (rotated centroids,
+        # rotated-residual codebooks) — rotate queries to match, host f64
+        # once-rounded so every mesh sees the same f32 queries
+        qp = (
+            qp.astype(np.float64) @ index.rotation.astype(np.float64).T
+        ).astype(np.float32)
     block = _query_block_bucket(q.shape[0], query_block)
     chunk = _pq_probe_chunk(block, np_eff, index.l_pad, index.m_sub)
-    starts = list(range(0, q.shape[0], block))
+    if isinstance(index, TieredIVFPQIndex):
+        d_all, p_all = _tiered_probe_all(
+            index, qp, kp, np_eff, mesh, block, chunk
+        )
+    else:
+        d_all, p_all = _resident_probe_all(
+            index, qp, kp, np_eff, mesh, block, chunk
+        )
+    profiling.incr_counter("ann.searches")
+    if refine:
+        with profiling.phase("ann.refine"):
+            return _refine_host(
+                index, refine_items, q, d_all, p_all, k_eff
+            )
+    with profiling.phase("ann.merge"):
+        ids = index.ids[np.minimum(p_all, index.ids.size - 1)]
+        ids[np.isinf(d_all)] = -1
+        return d_all[:, :k_eff], ids[:, :k_eff]
+
+
+def _resident_probe_all(
+    index: IVFPQIndex,
+    qp: np.ndarray,
+    kp: int,
+    np_eff: int,
+    mesh: Mesh,
+    block: int,
+    chunk: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """All-resident probe sweep: query blocks ride the kNN engine's
+    dispatch/collect pipeline, every dispatch rides the AOT cache."""
+    from ..ops.knn import _pipeline_window, _run_block_pipeline
+
+    n = qp.shape[0]
+    starts = list(range(0, n, block))
     pending: list = []
     out_d, out_p = [], []
 
@@ -565,6 +971,7 @@ def ivfpq_search_prepared(
             index.codes, index.scalars, index.counts,
             index.centroids, index.c_norm, index.codebooks, jnp.asarray(qb),
             mesh=mesh, k=kp, nprobe=np_eff, chunk=chunk,
+            fastscan=index.fastscan,
         )
         for h in (d, pos):
             try:
@@ -582,18 +989,72 @@ def ivfpq_search_prepared(
         len(starts), _dispatch, _collect, _pipeline_window(2),
         phase_prefix="ann",
     )
-    profiling.incr_counter("ann.searches")
-    d_all = np.concatenate(out_d)
-    p_all = np.concatenate(out_p)
-    if refine:
-        with profiling.phase("ann.refine"):
-            return _refine_host(
-                index, refine_items, q, d_all, p_all, k_eff
+    return np.concatenate(out_d), np.concatenate(out_p)
+
+
+def _tiered_probe_all(
+    index: TieredIVFPQIndex,
+    qp: np.ndarray,
+    kp: int,
+    np_eff: int,
+    mesh: Mesh,
+    block: int,
+    chunk: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Tiered probe sweep: per block, (1) the selection kernel replays
+    probe selection so the host learns which lists each query touches,
+    (2) the planner splits the block into contiguous query groups whose
+    distinct cold lists fit the slot pool, (3) each group pages in and
+    dispatches the tiered kernel AT THE SAME BLOCK BUCKET with the group's
+    queries at their ORIGINAL row offsets (zeros elsewhere).  Every ADC/
+    selection op is row-independent, so a row's outputs are bitwise what
+    the one-dispatch all-resident sweep produces for that row — slicing
+    out the group rows is exact, and every dispatch reuses the same cached
+    executables (zero new compiles at steady state)."""
+    n = qp.shape[0]
+    out_d = np.empty((n, kp), np.float32)
+    out_p = np.empty((n, kp), np.int32)
+    # Pass 1: dispatch every block's selection kernel, then ONE batched
+    # device_get — the planner needs host probes, but not one sync per block.
+    blocks = []
+    sel = []
+    for start in range(0, n, block):
+        n_q = min(block, n - start)
+        qb = np.zeros((block, index.d_pad), np.float32)
+        qb[:n_q] = qp[start : start + n_q]
+        blocks.append((start, n_q, qb))
+        sel.append(
+            cached_kernel(
+                "ann_pq_select", ivfpq_select_kernel,
+                index.centroids, index.c_norm, jnp.asarray(qb),
+                mesh=mesh, nprobe=np_eff,
             )
-    with profiling.phase("ann.merge"):
-        ids = index.ids[np.minimum(p_all, index.ids.size - 1)]
-        ids[np.isinf(d_all)] = -1
-        return d_all[:, :k_eff], ids[:, :k_eff]
+        )
+    # Pass 2: plan/page/dispatch per group, deferring the result fetch to
+    # ONE device_get — tier buffers are immutably replaced on slot writes,
+    # so earlier results stay valid on their old buffers.
+    spans = []
+    parts = []
+    for (start, n_q, qb), probes in zip(blocks, jax.device_get(sel)):
+        for s, e in index.tier.plan_groups(probes[:n_q]):
+            planes, slot_map = index.tier.acquire(probes[s:e].ravel())
+            gq = np.zeros((block, index.d_pad), np.float32)
+            gq[s:e] = qb[s:e]
+            spans.append((start, s, e))
+            parts.append(
+                cached_kernel(
+                    "ann_pq_probe_tiered", ivfpq_probe_tiered_kernel,
+                    planes[0], planes[1], slot_map, index.counts,
+                    index.centroids, index.c_norm, index.codebooks,
+                    jnp.asarray(gq),
+                    mesh=mesh, k=kp, nprobe=np_eff, chunk=chunk,
+                    fastscan=index.fastscan,
+                )
+            )
+    for (start, s, e), (d_host, p_host) in zip(spans, jax.device_get(parts)):
+        out_d[start + s : start + e] = d_host[s:e]
+        out_p[start + s : start + e] = p_host[s:e]
+    return out_d, out_p
 
 
 def _refine_host(
@@ -658,11 +1119,34 @@ def warm_pq_probe_kernels(
     block = _query_block_bucket(n_queries or query_block, query_block)
     chunk = _pq_probe_chunk(block, np_eff, index.l_pad, index.m_sub)
     q_aval = aval((block, index.d_pad), np.float32)
+    statics = dict(k=kp, nprobe=np_eff, chunk=chunk, fastscan=index.fastscan)
+    keys = []
+    if isinstance(index, TieredIVFPQIndex):
+        planes, slot_map = index.tier.snapshot()
+        args = (
+            planes[0], planes[1], slot_map, index.counts,
+            index.centroids, index.c_norm, index.codebooks, q_aval,
+        )
+        key = kernel_cache_key("ann_pq_probe_tiered", args, mesh, statics)
+        global_precompiler().submit(
+            key, ivfpq_probe_tiered_kernel, *args, mesh=mesh, **statics
+        )
+        keys.append(key)
+        sel_args = (index.centroids, index.c_norm, q_aval)
+        sel_statics = dict(nprobe=np_eff)
+        sel_key = kernel_cache_key(
+            "ann_pq_select", sel_args, mesh, sel_statics
+        )
+        global_precompiler().submit(
+            sel_key, ivfpq_select_kernel, *sel_args,
+            mesh=mesh, **sel_statics,
+        )
+        keys.append(sel_key)
+        return keys
     args = (
         index.codes, index.scalars, index.counts,
         index.centroids, index.c_norm, index.codebooks, q_aval,
     )
-    statics = dict(k=kp, nprobe=np_eff, chunk=chunk)
     key = kernel_cache_key("ann_pq_probe", args, mesh, statics)
     global_precompiler().submit(
         key, ivfpq_probe_kernel, *args, mesh=mesh, **statics

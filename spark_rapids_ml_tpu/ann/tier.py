@@ -1,0 +1,333 @@
+#
+# srml-tier: HBM/host-RAM tiered residency for IVF list planes.
+#
+# The flat and PQ indexes stage every padded list into device HBM, so HBM
+# caps the item count long before host RAM does.  This module keeps only a
+# fixed per-shard POOL of list slots device-resident and pages the rest in
+# on demand from the host-RAM padded layout (the same packed layout the
+# refine payload already rides):
+#
+#   hot lists:   the top hot_fraction of each shard's lists by a
+#                probe-frequency score (list population — denser regions
+#                win more probes) are PINNED into the pool at stage time
+#                and never evicted.
+#   cold lists:  stay in host RAM; when a query block probes one, it pages
+#                into an LRU slot with ONE H2D slice write per plane at a
+#                TRACED slot index (ops/lanes.lane_write_kernel's insight,
+#                hoisted from serving/multiplex.py's variant paging): every
+#                page-in after the first reuses ONE cached executable per
+#                plane shape — zero new compiles at steady state.
+#   sentinel:    slot 0 of every shard is reserved and carries +inf in the
+#                scoring plane (scalars / norms), so a list that is somehow
+#                probed while non-resident contributes nothing (its
+#                candidates score +inf and lose to every real candidate) —
+#                residency bugs degrade recall, they can NEVER corrupt
+#                results.
+#
+# The probe kernels consume the pool through a (nlist_pad,) int32
+# list->slot indirection (local slot ids per shard, 0 = non-resident):
+# gathering via the indirection returns byte-identical list data, so a
+# tiered search's probed candidates — and therefore its refined results —
+# are BITWISE the all-resident search's (paging is a residency change,
+# never a math change; the CI gate asserts it).
+#
+# Buffers are replaced IMMUTABLY on page-in (the multiplex snapshot rule):
+# a dispatch that already snapshotted the previous buffers keeps reading
+# consistent values; acquire() pages and snapshots under one lock.
+#
+# Counters: {name}.hits / {name}.misses / {name}.page_bytes (+ evictions,
+# refreshes) — docs/observability.md lists the family.
+#
+
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import profiling, sanitize
+from ..ops.precompile import cached_kernel
+from ..parallel.mesh import (
+    DATA_AXIS,
+    axis_sharding,
+    data_sharding,
+    replicated_sharding,
+)
+
+# smallest cold-list pool per shard: even a tiny index keeps a few slots so
+# the LRU has room to avoid thrashing a single slot
+_MIN_POOL_SLOTS = 8
+
+
+@jax.jit
+def _slot_write_kernel(buf: jax.Array, val: jax.Array, idx: jax.Array) -> jax.Array:
+    """One slot page-in: buf with buf[idx] <- val, the slot index TRACED
+    (int32 scalar) so every slot of a given plane shape shares ONE
+    executable — paging a list in is an H2D slice write, never a
+    recompile (the ops/lanes.lane_write_kernel contract on a sharded
+    buffer)."""
+    return jax.lax.dynamic_update_index_in_dim(buf, val, idx, 0)
+
+
+class TieredListPlanes:
+    """Per-shard slot pools for K parallel (nlist_pad, l_pad, ...) list
+    planes plus the list->slot indirection the tiered probe kernels
+    gather through.
+
+    `planes` are the HOST padded layouts, kept BY REFERENCE — a mutable
+    holder (ann/mutable.py) that edits a plane in place then calls
+    refresh() re-pages the resident copies, which is how tombstones stay
+    honored by paged-in lists.  `sentinels` gives the scalar fill value of
+    each plane's reserved sentinel slot (+inf for the scoring plane).
+    `counts` ranks lists for the hot split and lets empty lists skip the
+    pool entirely."""
+
+    def __init__(
+        self,
+        planes: Sequence[np.ndarray],
+        sentinels: Sequence[float],
+        counts: np.ndarray,
+        mesh,
+        hot_fraction: float,
+        pool_slots: Optional[int] = None,
+        name: str = "ann.tier",
+    ):
+        if not planes:
+            raise ValueError("at least one list plane is required")
+        nlist_pad = int(planes[0].shape[0])
+        for p in planes:
+            if int(p.shape[0]) != nlist_pad:
+                raise ValueError("every plane must share the list axis")
+        if len(sentinels) != len(planes):
+            raise ValueError("one sentinel fill value per plane")
+        if not 0.0 <= float(hot_fraction) <= 1.0:
+            raise ValueError(
+                f"hot_fraction ({hot_fraction}) must be in [0, 1]"
+            )
+        n_dev = mesh.shape[DATA_AXIS]
+        if nlist_pad % n_dev:
+            raise ValueError(
+                f"{nlist_pad} padded lists do not shard over {n_dev} devices"
+            )
+        self._mesh = mesh
+        self._name = str(name)
+        self._planes_host = list(planes)
+        self._sent = list(sentinels)
+        self._counts = np.asarray(counts, np.int64)
+        self._n_dev = int(n_dev)
+        self._lps = nlist_pad // n_dev
+        self.nlist_pad = nlist_pad
+        self.hot_fraction = float(hot_fraction)
+        self._hot_per_shard = int(
+            min(self._lps, math.ceil(self.hot_fraction * self._lps))
+        )
+        self.pool_slots = int(
+            pool_slots if pool_slots is not None
+            else max(_MIN_POOL_SLOTS, self._lps - self._hot_per_shard)
+        )
+        if self.pool_slots < 1:
+            raise ValueError(f"pool_slots ({pool_slots}) must be >= 1")
+        # per-shard slot layout: [0]=sentinel, [1..h]=pinned hot,
+        # [1+h .. 1+h+pool)=LRU'd cold pool
+        self.slots_per_shard = 1 + self._hot_per_shard + self.pool_slots
+        self._lock = sanitize.lockdep_lock(f"{self._name}.pager")
+        # residency bookkeeping: global list id -> local slot (hot ids are
+        # pinned and never leave); per-shard LRU over pool slots only
+        self._slot_of: Dict[int, int] = {}
+        self._hot_ids: set = set()
+        self._lru: List[OrderedDict] = [OrderedDict() for _ in range(n_dev)]
+        self._free: List[List[int]] = [
+            list(range(1 + self._hot_per_shard, self.slots_per_shard))[::-1]
+            for _ in range(n_dev)
+        ]
+        self._stage_initial()
+
+    # -- staging -----------------------------------------------------------
+    def _hot_lists_of_shard(self, s: int) -> np.ndarray:
+        lo, hi = s * self._lps, (s + 1) * self._lps
+        ids = np.arange(lo, hi, dtype=np.int64)
+        cnt = self._counts[lo:hi]
+        # probe-frequency proxy: list population, ties by id (deterministic)
+        order = np.lexsort((ids, -cnt))
+        hot = ids[order][: self._hot_per_shard]
+        return hot[self._counts[hot] > 0]
+
+    def _stage_initial(self) -> None:
+        sps = self.slots_per_shard
+        rows = self._n_dev * sps
+        slot_map = np.zeros(self.nlist_pad, np.int32)
+        bufs = []
+        for plane, sent in zip(self._planes_host, self._sent):
+            buf = np.zeros((rows,) + plane.shape[1:], plane.dtype)
+            if sent is not None:
+                buf[0 :: sps] = sent
+            bufs.append(buf)
+        for s in range(self._n_dev):
+            for j, g in enumerate(self._hot_lists_of_shard(s)):
+                local = 1 + j
+                slot_map[g] = local
+                self._slot_of[int(g)] = local
+                self._hot_ids.add(int(g))
+                for buf, plane in zip(bufs, self._planes_host):
+                    buf[s * sps + local] = plane[g]
+        stage_bytes = int(sum(b.nbytes for b in bufs))
+        with profiling.phase(f"{self._name}.stage", bytes=stage_bytes):
+            self._planes_dev = [
+                jax.device_put(b, axis_sharding(self._mesh, 0, b.ndim))
+                for b in bufs
+            ]
+            self._map_dev = jax.device_put(slot_map, data_sharding(self._mesh))
+        profiling.incr_counter(f"{self._name}.stage_bytes", stage_bytes)
+
+    # -- sizing ------------------------------------------------------------
+    def device_bytes(self) -> int:
+        return int(
+            sum(b.nbytes for b in self._planes_dev) + self._map_dev.nbytes
+        )
+
+    def host_bytes(self) -> int:
+        return int(sum(p.nbytes for p in self._planes_host))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hot_per_shard": self._hot_per_shard,
+                "pool_slots": self.pool_slots,
+                "slots_per_shard": self.slots_per_shard,
+                "resident_lists": len(self._slot_of),
+                "device_bytes": self.device_bytes(),
+                "host_bytes": self.host_bytes(),
+            }
+
+    # -- paging ------------------------------------------------------------
+    def plan_groups(
+        self, probes: np.ndarray
+    ) -> List[Tuple[int, int]]:
+        """Split a (Q, nprobe) probe table into contiguous query ranges
+        whose distinct COLD probed lists fit the per-shard pool, so every
+        range can be fully paged before its dispatch.  A single query
+        needing more cold lists than the pool holds is a typed error
+        (nprobe outgrew the staged pool — restage with a larger pool)."""
+        Q = int(probes.shape[0])
+        groups: List[Tuple[int, int]] = []
+        need: List[set] = [set() for _ in range(self._n_dev)]
+        start = 0
+        for i in range(Q):
+            row = [
+                int(g) for g in probes[i]
+                if 0 <= g < self.nlist_pad
+                and self._counts[g] > 0
+                and int(g) not in self._hot_ids
+            ]
+            row_need: Dict[int, set] = {}
+            for g in row:
+                row_need.setdefault(g // self._lps, set()).add(g)
+            if any(len(v) > self.pool_slots for v in row_need.values()):
+                raise ValueError(
+                    f"one query probes more cold lists than the tier pool "
+                    f"holds ({self.pool_slots} slots/shard); restage with "
+                    f"a larger pool (nprobe grew past the staging hint)"
+                )
+            if any(
+                len(need[s] | v) > self.pool_slots
+                for s, v in row_need.items()
+            ):
+                groups.append((start, i))
+                start = i
+                need = [set() for _ in range(self._n_dev)]
+            for s, v in row_need.items():
+                need[s] |= v
+        groups.append((start, Q))
+        return groups
+
+    def acquire(self, lists: Sequence[int]):
+        """Page every list in `lists` into the pool (LRU eviction, pinned
+        hot lists untouched) and return the snapshot
+        (plane buffers tuple, list->slot map) the probe kernel should
+        gather through.  Page-in and snapshot share one critical section,
+        so the returned buffers always hold every requested list; later
+        page-ins replace buffers immutably and never disturb a dispatch
+        holding this snapshot."""
+        with self._lock:
+            req = [
+                g for g in sorted({int(g) for g in lists})
+                if 0 <= g < self.nlist_pad and self._counts[g] > 0
+            ]
+            # pass 1: touch already-resident requests FIRST so pass-2
+            # evictions can never victimize a list this same acquire needs
+            # (the planner bounds distinct cold requests by the pool size,
+            # so after the touch pass the LRU front is always a non-request)
+            misses = []
+            for g in req:
+                slot = self._slot_of.get(g)
+                if slot is None:
+                    misses.append(g)
+                    continue
+                profiling.incr_counter(f"{self._name}.hits")
+                if g not in self._hot_ids:
+                    self._lru[g // self._lps].move_to_end(slot)
+            for g in misses:
+                self._page_in_locked(g)
+            return tuple(self._planes_dev), self._map_dev
+
+    def snapshot(self):
+        with self._lock:
+            return tuple(self._planes_dev), self._map_dev
+
+    def _page_in_locked(self, g: int) -> None:
+        s = g // self._lps
+        profiling.incr_counter(f"{self._name}.misses")
+        if self._free[s]:
+            slot = self._free[s].pop()
+        else:
+            slot, evicted = self._lru[s].popitem(last=False)
+            del self._slot_of[evicted]
+            self._write_map(evicted, 0)
+            profiling.incr_counter(f"{self._name}.evictions")
+        self._write_planes(s, slot, g)
+        self._write_map(g, slot)
+        self._slot_of[g] = slot
+        self._lru[s][slot] = g
+
+    def refresh(self, lists: Sequence[int]) -> None:
+        """Re-page RESIDENT lists from the (possibly just-mutated) host
+        planes — the tombstone-interaction hook: a delete flips the host
+        norm plane, refresh() makes every resident copy honor it, and
+        non-resident lists pick the mutation up at their next page-in."""
+        with self._lock:
+            for g in sorted({int(g) for g in lists}):
+                slot = self._slot_of.get(g)
+                if slot is None:
+                    continue
+                self._write_planes(g // self._lps, slot, g)
+                profiling.incr_counter(f"{self._name}.refreshes")
+
+    def _write_planes(self, s: int, local_slot: int, g: int) -> None:
+        row = jnp.asarray(np.int32(s * self.slots_per_shard + local_slot))
+        nbytes = 0
+        for i, plane in enumerate(self._planes_host):
+            val = jax.device_put(
+                np.ascontiguousarray(plane[g]),
+                replicated_sharding(self._mesh),
+            )
+            self._planes_dev[i] = cached_kernel(
+                f"{self._name}.w{i}", _slot_write_kernel,
+                self._planes_dev[i], val, row,
+            )
+            nbytes += int(plane[g].nbytes)
+        profiling.incr_counter(f"{self._name}.page_bytes", nbytes)
+
+    def _write_map(self, g: int, local_slot: int) -> None:
+        self._map_dev = cached_kernel(
+            f"{self._name}.map", _slot_write_kernel,
+            self._map_dev,
+            jax.device_put(
+                np.int32(local_slot), replicated_sharding(self._mesh)
+            ),
+            jnp.asarray(np.int32(g)),
+        )

@@ -32,6 +32,7 @@ from ..ann.ivfflat import (
     default_nprobe,
     index_from_packed,
     ivfflat_search_prepared,
+    tiered_index_from_packed,
     warm_probe_kernels,
 )
 from ..ann.pq import (
@@ -42,6 +43,7 @@ from ..ann.pq import (
     default_m_sub,
     index_from_packed_pq,
     ivfpq_search_prepared,
+    tiered_index_from_packed_pq,
     warm_pq_probe_kernels,
 )
 from ..core import _TpuEstimatorSupervised, _TpuModel
@@ -57,12 +59,15 @@ from ..params import (
 from ..parallel.mesh import get_mesh
 
 # per-algorithm algoParams surfaces (a typo'd key is a hard error, never a
-# silent default); the PQ keys follow the upstream cuML names
+# silent default); the PQ keys follow the upstream cuML names.
+# 'hot_fraction' (both tiers) opts into the tiered HBM/host-RAM residency
+# split (ann/tier.py); 'opq' (pq tier) trains a learned rotation before
+# the subspace split (ann/pq.py _train_opq_rotation).
 _ALGO_PARAM_KEYS = {
-    "ivfflat": {"nlist", "nprobe"},
+    "ivfflat": {"nlist", "nprobe", "hot_fraction"},
     "ivfpq": {
         "nlist", "nprobe", "M", "n_bits", "usePrecomputedTables",
-        "refine_ratio",
+        "refine_ratio", "opq", "hot_fraction",
     },
 }
 
@@ -88,7 +93,7 @@ class _ApproximateNearestNeighborsParams(
     k = Param(_dummy(), "k", "the number of nearest neighbors to retrieve (> 0)", TypeConverters.toInt)
     idCol = Param(_dummy(), "idCol", "id column name; if unset a monotonically increasing id column is generated", TypeConverters.toString)
     algorithm = Param(_dummy(), "algorithm", "the ANN algorithm: 'ivfflat' (raw f32 lists) or 'ivfpq' (product-quantized lists)", TypeConverters.toString)
-    algoParams = Param(_dummy(), "algoParams", "algorithm parameters: {'nlist', 'nprobe'} (both tiers) plus, for ivfpq, {'M': subspaces, 'n_bits': bits per code, 'refine_ratio': f32 re-score factor, 'usePrecomputedTables': ignored}", TypeConverters.identity)
+    algoParams = Param(_dummy(), "algoParams", "algorithm parameters: {'nlist', 'nprobe', 'hot_fraction': HBM-resident list fraction} (both tiers) plus, for ivfpq, {'M': subspaces, 'n_bits': bits per code (4 packs two codes/byte and takes the fast-scan kernel), 'refine_ratio': f32 re-score factor (1 = ADC only), 'opq': train a learned rotation before the subspace split, 'usePrecomputedTables': ignored}", TypeConverters.identity)
     exactSearch = Param(_dummy(), "exactSearch", "route kneighbors through the exact brute-force engine over the indexed items (recall escape hatch)", TypeConverters.toBoolean)
 
     def __init__(self, *args: Any, **kwargs: Any) -> None:
@@ -162,9 +167,15 @@ class _ApproximateNearestNeighborsParams(
 
     def _resolved_pq_params(
         self, dim: int, warn: bool = False
-    ) -> Tuple[int, int, int]:
-        """(M, n_bits, refine_ratio) for algorithm='ivfpq' with the
-        documented defaults (ann/pq default_m_sub, 8 bits, refine x4).
+    ) -> Tuple[int, int, int, bool]:
+        """(M, n_bits, refine_ratio, opq) for algorithm='ivfpq' with the
+        documented defaults (ann/pq default_m_sub, 8 bits, refine x4, no
+        rotation).  refine_ratio semantics: 1 means "ADC only, no refine"
+        (the probed scan IS the answer); >= 2 re-scores the top
+        k*refine_ratio ADC candidates against the host f32 payload.  0 is
+        a typed error — it used to slip through the old `>= 0` guard and
+        then silently behave like 1 because the refine gate keys off
+        `> 1`; an explicit ratio must name a real mode.
         usePrecomputedTables is accepted for upstream compatibility but
         IGNORED with a warning (once, at fit): the ADC formulation folds
         the list-dependent table term into the packed per-item scalar, so
@@ -180,13 +191,37 @@ class _ApproximateNearestNeighborsParams(
         m = int(ap.get("M", default_m_sub(dim)))
         n_bits = int(ap.get("n_bits", DEFAULT_N_BITS))
         ratio = int(ap.get("refine_ratio", DEFAULT_REFINE_RATIO))
+        opq = bool(ap.get("opq", False))
         if m < 1:
             raise ValueError(f"M ({m}) must be >= 1")
         if not 1 <= n_bits <= 8:
             raise ValueError(f"n_bits ({n_bits}) must be in [1, 8]")
-        if ratio < 0:
-            raise ValueError(f"refine_ratio ({ratio}) must be >= 0")
-        return m, n_bits, ratio
+        if ratio < 1:
+            raise ValueError(
+                f"refine_ratio ({ratio}) must be >= 1 (1 = ADC only, no "
+                "f32 refine pass; >= 2 re-scores top k*ratio candidates)"
+            )
+        return m, n_bits, ratio, opq
+
+    def _resolved_hot_fraction(self) -> float:
+        """The tiered-residency knob for BOTH tiers: the fraction of each
+        shard's lists pinned HBM-resident (ann/tier.py pages the rest from
+        host RAM on probe demand).  algoParams['hot_fraction'] wins; the
+        SRML_ANN_HOT_FRACTION env var is the fleet-wide default; 1.0
+        (everything resident — the pre-tier behavior) otherwise."""
+        import os
+
+        ap = self._validated_algo_params()
+        if "hot_fraction" in ap:
+            hf = float(ap["hot_fraction"])
+        else:
+            hf = float(os.environ.get("SRML_ANN_HOT_FRACTION", "1.0"))
+        if not 0.0 <= hf <= 1.0:
+            raise ValueError(
+                f"hot_fraction ({hf}) must be in [0, 1] (1 = fully "
+                "HBM-resident, the default)"
+            )
+        return hf
 
     def _check_algorithm(self) -> None:
         if self.getAlgorithm() not in _ALGO_PARAM_KEYS:
@@ -246,12 +281,14 @@ class ApproximateNearestNeighbors(
         X = np.concatenate(feats) if len(feats) > 1 else feats[0]
         item_ids = np.concatenate(ids) if len(ids) > 1 else ids[0]
         nlist, _nprobe = self._resolved_algo_params(X.shape[0])
+        self._resolved_hot_fraction()  # fail fast on an out-of-range knob
         if self.getAlgorithm() == "ivfpq":
-            m_sub, n_bits, _ratio = self._resolved_pq_params(
+            m_sub, n_bits, _ratio, opq = self._resolved_pq_params(
                 int(X.shape[1]), warn=True
             )
             pq = build_ivfpq_packed(
-                X, item_ids, nlist, m_sub=m_sub, n_bits=n_bits, seed=0
+                X, item_ids, nlist, m_sub=m_sub, n_bits=n_bits, seed=0,
+                opq=opq,
             )
             model = ApproximateNearestNeighborsModel(
                 centroids_=pq.centroids,
@@ -266,6 +303,7 @@ class ApproximateNearestNeighbors(
                 pq_scalars_=pq.scalars,
                 pq_codebooks_=pq.codebooks,
                 pq_n_bits=pq.n_bits,
+                pq_rotation_=pq.rotation,
             )
         else:
             packed = build_ivfflat_packed(X, item_ids, nlist, seed=0)
@@ -319,6 +357,7 @@ class ApproximateNearestNeighborsModel(
         pq_scalars_: Optional[np.ndarray] = None,
         pq_codebooks_: Optional[np.ndarray] = None,
         pq_n_bits: Optional[int] = None,
+        pq_rotation_: Optional[np.ndarray] = None,
     ) -> None:
         super().__init__(
             centroids_=np.asarray(centroids_),
@@ -335,6 +374,9 @@ class ApproximateNearestNeighborsModel(
             if pq_codebooks_ is None
             else np.asarray(pq_codebooks_),
             pq_n_bits=None if pq_n_bits is None else int(pq_n_bits),
+            pq_rotation_=None
+            if pq_rotation_ is None
+            else np.asarray(pq_rotation_),
         )
         self.centroids_ = np.asarray(centroids_, np.float32)
         self.packed_items_ = np.asarray(packed_items_, np.float32)
@@ -357,6 +399,13 @@ class ApproximateNearestNeighborsModel(
             pq_codebooks_, np.float32
         )
         self.pq_n_bits = None if pq_n_bits is None else int(pq_n_bits)
+        # the OPQ rotation (d_pad, d_pad) f32, or None when fit without
+        # algoParams['opq']: codes encode ROTATED residuals, so the
+        # rotation must persist with the payload — a load that dropped it
+        # would decode against the wrong frame
+        self.pq_rotation_ = None if pq_rotation_ is None else np.asarray(
+            pq_rotation_, np.float32
+        )
         self._item_df: Optional[DataFrame] = None
         # per-mesh staging caches (die with the model, like the exact
         # model's _staged_items): the probed index (flat or pq) and the
@@ -399,6 +448,7 @@ class ApproximateNearestNeighborsModel(
             self.n_cols,
             self.pq_codes_.shape[1],
             self.pq_n_bits,
+            rotation=self.pq_rotation_,
         )
 
     def _mesh_key(self, mesh) -> Tuple:
@@ -409,7 +459,8 @@ class ApproximateNearestNeighborsModel(
         return mesh_fingerprint(mesh)
 
     def _ensure_staged_index(self, mesh):
-        key = self._mesh_key(mesh)
+        hf = self._resolved_hot_fraction()
+        key = (self._mesh_key(mesh), hf)
         if self._mutable is not None:
             if self._mutable[0] != key:
                 raise ValueError(
@@ -419,7 +470,11 @@ class ApproximateNearestNeighborsModel(
                 )
             return self._mutable[1].index
         if self._staged_index is None or self._staged_index[0] != key:
-            self._staged_index = (key, index_from_packed(self._packed(), mesh))
+            if hf < 1.0:
+                staged = tiered_index_from_packed(self._packed(), mesh, hf)
+            else:
+                staged = index_from_packed(self._packed(), mesh)
+            self._staged_index = (key, staged)
         return self._staged_index[1]
 
     def mutable_index(self, mesh: Any = None):
@@ -439,9 +494,13 @@ class ApproximateNearestNeighborsModel(
         from ..ann.mutable import MutableIVFIndex
 
         mesh = mesh or get_mesh(self.num_workers)
-        key = self._mesh_key(mesh)
+        hf = self._resolved_hot_fraction()
+        key = (self._mesh_key(mesh), hf)
         if self._mutable is None:
-            self._mutable = (key, MutableIVFIndex(self._packed(), mesh))
+            self._mutable = (
+                key,
+                MutableIVFIndex(self._packed(), mesh, hot_fraction=hf),
+            )
             self._staged_index = None  # the holder owns staging now
         elif self._mutable[0] != key:
             raise ValueError(
@@ -474,11 +533,16 @@ class ApproximateNearestNeighborsModel(
         return self
 
     def _ensure_staged_pq(self, mesh):
-        key = self._mesh_key(mesh)
+        hf = self._resolved_hot_fraction()
+        key = (self._mesh_key(mesh), hf)
         if self._staged_pq is None or self._staged_pq[0] != key:
-            self._staged_pq = (
-                key, index_from_packed_pq(self._packed_pq(), mesh)
-            )
+            if hf < 1.0:
+                staged = tiered_index_from_packed_pq(
+                    self._packed_pq(), mesh, hf
+                )
+            else:
+                staged = index_from_packed_pq(self._packed_pq(), mesh)
+            self._staged_pq = (key, staged)
         return self._staged_pq[1]
 
     def _ensure_staged_exact(self, mesh):
@@ -540,7 +604,7 @@ class ApproximateNearestNeighborsModel(
             prepared = self._ensure_staged_exact(mesh)
         elif pq:
             index = self._ensure_staged_pq(mesh)
-            _m, _b, refine_ratio = self._resolved_pq_params(self.n_cols)
+            _m, _b, refine_ratio, _opq = self._resolved_pq_params(self.n_cols)
         else:
             index = self._ensure_staged_index(mesh)
         from .. import profiling
@@ -615,7 +679,7 @@ class ApproximateNearestNeighborsModel(
         }
         if pq:
             index = self._ensure_staged_pq(mesh)
-            _m, _b, refine_ratio = self._resolved_pq_params(self.n_cols)
+            _m, _b, refine_ratio, _opq = self._resolved_pq_params(self.n_cols)
             refine_items = (
                 self.packed_items_ if refine_ratio > 1 else None
             )
@@ -698,3 +762,43 @@ class ApproximateNearestNeighborsModel(
         else:
             index = self._ensure_staged_index(mesh)
         return index.device_bytes() / max(self.n_items, 1)
+
+    def index_residency(
+        self, mesh: Any = None, hbm_budget_bytes: int = 16 << 30
+    ) -> Dict[str, float]:
+        """The residency breakdown behind index_bytes_per_item: where each
+        indexed item's bytes actually live on this mesh, and how many
+        items one device's HBM budget admits at this layout.
+
+        - hbm_bytes_per_item: device-resident index bytes / item (the
+          whole index for hot_fraction=1; hot lists + the pager pool for
+          a tiered split)
+        - host_bytes_per_item: host-RAM bytes / item — the tier's warm
+          list planes plus the payloads that are ALWAYS host-side (ids
+          and, on the pq tier, the f32 refine vectors)
+        - items_per_device: floor(hbm_budget_bytes / per-device HBM bytes
+          per item) — the headline capacity number at this (n_bits, M,
+          hot_fraction) operating point
+        """
+        self._check_algorithm()
+        mesh = mesh or get_mesh(self.num_workers)
+        if self.getAlgorithm() == "ivfpq":
+            index = self._ensure_staged_pq(mesh)
+            host_extra = self.packed_items_.nbytes + self.packed_ids_.nbytes
+        else:
+            index = self._ensure_staged_index(mesh)
+            host_extra = self.packed_ids_.nbytes
+        n = max(self.n_items, 1)
+        n_dev = max(int(np.prod(list(mesh.shape.values()))), 1)
+        hbm_bpi = index.device_bytes() / n
+        host_bpi = (
+            getattr(index, "host_bytes", lambda: 0)() + host_extra
+        ) / n
+        per_dev_bpi = hbm_bpi / n_dev
+        return {
+            "hbm_bytes_per_item": float(hbm_bpi),
+            "host_bytes_per_item": float(host_bpi),
+            "items_per_device": float(
+                np.floor(hbm_budget_bytes / max(per_dev_bpi, 1e-12))
+            ),
+        }

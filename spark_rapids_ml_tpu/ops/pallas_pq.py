@@ -43,6 +43,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -125,6 +127,161 @@ def _lut_accumulate_xla(tables: jax.Array, codes: jax.Array) -> jax.Array:
     idx = jnp.swapaxes(codes, 1, 2).astype(jnp.int32)  # (B, m_sub, R)
     gathered = jnp.take_along_axis(tables, idx, axis=2)
     return jnp.sum(gathered, axis=1)                   # (B, R)
+
+
+# -- n_bits=4 fast-scan (two codes per byte, 16-entry tables) ----------------
+#
+# André et al. ("Cache locality is not enough", VLDB 2015) observed that
+# 4-bit codes turn the ADC gather into a 16-entry table sweep.  Here the
+# packed byte layout halves the per-item HBM traffic (m_sub/2 bytes/item)
+# and the compare-select sweep shrinks from ksub=256 lanes to 16 — the
+# (16, TILE_R) compare tile is 16x smaller than the 8-bit kernel's, so the
+# whole per-subspace table column pair stays VPU-hot.  Layouts, grid, the
+# sequential-j f32 accumulation order, and the exact-gather argument are
+# the 8-bit kernel's verbatim; the only new step is the nibble unpack
+# (j even -> low nibble of byte j//2, j odd -> high nibble), which both
+# routes and the numpy oracle in tests/test_pq_engine.py share.
+
+
+def _fastscan_check(tables: jax.Array, packed: jax.Array) -> int:
+    """Validate the packed fast-scan geometry; returns m_sub.  Odd m_sub
+    cannot pack two codes per byte — a TYPED rejection, not a silent
+    repack (the build layer refuses to produce such a payload and this
+    guard keeps hand-built calls honest)."""
+    m_sub = int(tables.shape[1])
+    if m_sub % 2 != 0:
+        raise ValueError(
+            f"fast-scan requires an even m_sub (two 4-bit codes pack per "
+            f"byte); got m_sub={m_sub} — use n_bits=8 or an even M"
+        )
+    if int(tables.shape[2]) > 16:
+        raise ValueError(
+            f"fast-scan tables must have ksub <= 16 (4-bit codes); got "
+            f"ksub={int(tables.shape[2])}"
+        )
+    if int(packed.shape[2]) * 2 != m_sub:
+        raise ValueError(
+            f"packed codes carry {int(packed.shape[2])} bytes/item but "
+            f"tables expect m_sub={m_sub} subspaces ({m_sub // 2} bytes)"
+        )
+    return m_sub
+
+
+def _fastscan_kernel(t_ref, c_ref, o_ref, *, m_sub: int):
+    # t_ref (1, ksub<=16, m_sub) f32 — this query's ADC table, grid-resident
+    # c_ref (1, m_sub//2, TILE_R) uint8 — packed code tile, rows along lanes
+    # o_ref (1, TILE_R) f32
+    ksub = t_ref.shape[1]
+    packed = c_ref[0].astype(jnp.int32)                # (m_sub//2, TILE_R)
+    lo = packed & 0xF
+    hi = packed >> 4
+    tile_r = packed.shape[1]
+    cls = jax.lax.broadcasted_iota(jnp.int32, (ksub, tile_r), 0)
+    acc = jnp.zeros((1, tile_r), jnp.float32)
+    for j in range(m_sub):
+        nib = lo[j // 2, :] if j % 2 == 0 else hi[j // 2, :]
+        # exactly one of the 16 lanes matches per row: the masked sublane
+        # sum gathers T[j, code] bit-exactly (x + 0.0 == x), same argument
+        # as the 8-bit kernel with a 16x smaller compare tile
+        eq = nib[None, :] == cls                       # (ksub, TILE_R)
+        acc = acc + jnp.sum(
+            jnp.where(eq, t_ref[0, :, j][:, None], 0.0),
+            axis=0,
+            keepdims=True,
+        )
+    o_ref[:] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fastscan_pallas(
+    tables: jax.Array,  # (B, m_sub, ksub<=16) f32
+    packed: jax.Array,  # (B, R, m_sub//2) uint8, two codes per byte
+    interpret: bool = False,
+) -> jax.Array:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, m_sub, ksub = tables.shape
+    m_half = packed.shape[2]
+    r = packed.shape[1]
+    r_pad = _round_up(max(r, 1), _LUT_TILE_R)
+    t_t = jnp.swapaxes(tables, 1, 2)                   # (B, ksub, m_sub)
+    c_t = jnp.swapaxes(packed, 1, 2)                   # (B, m_sub//2, R)
+    if r_pad != r:
+        c_t = jnp.pad(c_t, ((0, 0), (0, 0), (0, r_pad - r)))
+    out = pl.pallas_call(
+        functools.partial(_fastscan_kernel, m_sub=m_sub),
+        grid=(b, r_pad // _LUT_TILE_R),
+        in_specs=[
+            pl.BlockSpec(
+                (1, ksub, m_sub), lambda qi, ri: (qi, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, m_half, _LUT_TILE_R), lambda qi, ri: (qi, 0, ri),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _LUT_TILE_R), lambda qi, ri: (qi, ri),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, r_pad), jnp.float32),
+        interpret=interpret,
+    )(t_t, c_t)
+    return out[:, :r]
+
+
+def pack_codes4(codes: np.ndarray) -> np.ndarray:
+    """HOST-side packer, the unpack_codes4 inverse: (N, m_sub even) uint8
+    4-bit codes -> (N, m_sub//2) bytes, byte p = code[:, 2p] |
+    code[:, 2p+1] << 4.  The stager packs once at layout time; the wire
+    payload keeps unpacked codes (one persistence format across n_bits)."""
+    codes = np.asarray(codes, np.uint8)
+    if codes.ndim != 2 or codes.shape[1] % 2:
+        raise ValueError(
+            f"pack_codes4 needs (N, even m_sub) codes; got {codes.shape}"
+        )
+    if codes.size and int(codes.max()) > 0xF:
+        raise ValueError("pack_codes4 codes must be 4-bit (values < 16)")
+    return (codes[:, 0::2] | (codes[:, 1::2] << 4)).astype(np.uint8)
+
+
+def unpack_codes4(packed: jax.Array) -> jax.Array:
+    """(B, R, m_sub//2) packed bytes -> (B, R, m_sub) 4-bit codes in the
+    j order the kernels sweep: byte p holds codes for subspaces j=2p (low
+    nibble) and j=2p+1 (high nibble).  Shared by the XLA route and the
+    oracle-building tests (one unpack convention, stated once)."""
+    p = packed.astype(jnp.int32)
+    lo = p & 0xF
+    hi = p >> 4
+    b, r, m_half = p.shape
+    return jnp.stack([lo, hi], axis=-1).reshape(b, r, m_half * 2)
+
+
+def _fastscan_xla(tables: jax.Array, packed: jax.Array) -> jax.Array:
+    """Identical-math XLA unpack route: nibble unpack, then EXACTLY the
+    8-bit route's gather+reduce (take_along_axis over ksub, sum over the
+    m_sub axis) — the same fixed-shape per-item reduction, so 4-bit probed
+    results keep the bitwise mesh-parity basis on the CPU/tier-1 route."""
+    return _lut_accumulate_xla(tables, unpack_codes4(packed))
+
+
+def fastscan_lut_accumulate(
+    tables: jax.Array,  # (B, m_sub, ksub<=16) f32 per-query ADC tables
+    packed: jax.Array,  # (B, R, m_sub//2) uint8 packed candidate codes
+    interpret: bool = False,
+) -> jax.Array:
+    """Fast-scan ADC accumulation over 4-bit packed codes:
+    out[b, r] = sum_j tables[b, j, code(b, r, j)] with code unpacked from
+    two-per-byte nibbles.  Pallas on TPU (or interpret=True for tests),
+    the identical-math XLA unpack route elsewhere — the lut_accumulate
+    routing contract at half the code bytes.  Rejects odd m_sub and
+    ksub > 16 with typed errors."""
+    _fastscan_check(tables, packed)
+    if interpret or pallas_enabled():
+        return _fastscan_pallas(tables, packed, interpret=interpret)
+    return _fastscan_xla(tables, packed)
 
 
 def lut_accumulate(

@@ -103,7 +103,7 @@ def armed_faults(monkeypatch):
 def model_zoo():
     """Lazily-fitted tiny models over one shared dataset, keyed by arm name
     ("kmeans", "pca", "linreg", "logreg", "rf_clf", "rf_reg", "umap",
-    "knn", "ann", "ivfpq").  Returns a factory: model_zoo(name) -> (model, X) with X the
+    "knn", "ann", "ivfpq", "ivfpq_opq").  Returns a factory: model_zoo(name) -> (model, X) with X the
     float32 feature matrix the model was fit on.  Session-scoped and cached
     so the persistence matrix and the serving tests share ONE fit per
     class instead of re-fitting per test."""
@@ -170,6 +170,18 @@ def model_zoo():
                 k=4,
                 algorithm="ivfpq",
                 algoParams={"nlist": 4, "nprobe": 4, "M": 2, "n_bits": 4},
+            ).setFeaturesCol("features").fit(df)
+        if name == "ivfpq_opq":
+            # the OPQ x fast-scan composition: a learned rotation rides the
+            # wire with the payload, codes stay 4-bit packed — persistence
+            # must restage BOTH bit-identically on any mesh
+            return ApproximateNearestNeighbors(
+                k=4,
+                algorithm="ivfpq",
+                algoParams={
+                    "nlist": 4, "nprobe": 4, "M": 2, "n_bits": 4,
+                    "opq": True,
+                },
             ).setFeaturesCol("features").fit(df)
         raise KeyError(name)
 

@@ -55,13 +55,15 @@ def test_save_load_transform_equivalence(arm, model_zoo, tmp_path):
         ), f"{arm}: column {col!r} changed across save/load"
 
 
-@pytest.mark.parametrize("arm", ["ann", "ivfpq"])
+@pytest.mark.parametrize("arm", ["ann", "ivfpq", "ivfpq_opq"])
 def test_ann_save_load_kneighbors_equivalence(arm, model_zoo, tmp_path):
     """The ANN models have no transform — their persistence gate is
     save -> load -> kneighbors BIT-IDENTICAL to the in-memory model (the
     packed index layout — raw lists for ivfflat, codes + ADC scalars +
-    codebooks for ivfpq — is mesh-independent data, and the probed search
-    is deterministic, so exact equality is the right bar here too)."""
+    codebooks for ivfpq, plus the OPQ rotation and the packed 4-bit
+    fast-scan layout on their arms — is mesh-independent data, and the
+    probed search is deterministic, so exact equality is the right bar
+    here too)."""
     model, X = model_zoo(arm)
     path = str(tmp_path / arm)
     model.save(path)
@@ -81,7 +83,7 @@ def test_ann_save_load_kneighbors_equivalence(arm, model_zoo, tmp_path):
             [np.asarray(list(p[col])) for p in after.partitions if len(p)]
         )
         assert np.array_equal(a, b), f"{arm}: column {col!r} changed across save/load"
-    if arm == "ivfpq":
+    if arm.startswith("ivfpq"):
         # across mesh SHAPES too: the loaded payload staged on a 1-device
         # mesh must answer bit-identically to the default (8-device) mesh —
         # the engine parity gate re-asserted through the persisted artifact
@@ -92,6 +94,14 @@ def test_ann_save_load_kneighbors_equivalence(arm, model_zoo, tmp_path):
         from spark_rapids_ml_tpu.parallel.mesh import get_mesh
 
         packed = loaded._packed_pq()
+        if arm == "ivfpq_opq":
+            # the rotation is payload, not staging state: it must survive
+            # the npz round trip exactly (codes decode against it)
+            assert loaded.pq_rotation_ is not None
+            np.testing.assert_array_equal(
+                loaded.pq_rotation_, model.pq_rotation_
+            )
+            assert packed.rotation is not None
         out = {}
         for tag, mesh in (("one", get_mesh(1)), ("all", get_mesh())):
             idx = index_from_packed_pq(packed, mesh)

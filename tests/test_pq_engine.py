@@ -353,3 +353,285 @@ def test_default_m_sub_geometry():
     assert pq_geometry(5, 4) == (4, 2, 8)    # pow2-padded subspaces
     assert pq_geometry(256, 32) == (32, 8, 256)
     assert pq_geometry(16, 64) == (16, 1, 16)  # m_sub clamped to dim
+
+
+# -- 4-bit fast-scan ----------------------------------------------------------
+
+
+def test_fastscan_kernel_matches_numpy_adc_oracle():
+    """out[b, r] = sum_j T[b, j, nibble_j(packed[b, r])] with SEQUENTIAL
+    f32 accumulation in subspace order — the packed two-codes-per-byte
+    kernel must equal the oracle bit for bit in interpret mode, on ragged
+    row counts and sub-16 table widths, and the routed XLA unpack path
+    must compute the identical sum."""
+    from spark_rapids_ml_tpu.ops.pallas_pq import (
+        _fastscan_pallas,
+        fastscan_lut_accumulate,
+        pack_codes4,
+        unpack_codes4,
+    )
+
+    rng = np.random.default_rng(11)
+    cases = [(3, 700, 4, 16), (1, 512, 2, 16), (2, 33, 8, 5)]
+    wants, outs = [], []   # device outputs batched; ONE fetch after the loop
+    for B, R, m_sub, ksub in cases:
+        T = rng.standard_normal((B, m_sub, ksub)).astype(np.float32)
+        C = rng.integers(0, ksub, size=(B, R, m_sub)).astype(np.uint8)
+        packed = np.stack([pack_codes4(C[b]) for b in range(B)])
+        want = np.zeros((B, R), np.float32)
+        for j in range(m_sub):  # sequential j — the accumulation contract
+            want += np.take_along_axis(
+                T[:, j, :], C[:, :, j].astype(np.int64), axis=1
+            )
+        wants.append((want, C))
+        outs.append((
+            _fastscan_pallas(jnp.asarray(T), jnp.asarray(packed), interpret=True),
+            fastscan_lut_accumulate(jnp.asarray(T), jnp.asarray(packed)),
+            unpack_codes4(jnp.asarray(packed)),
+        ))
+    for case, (want, C), (got, got_routed, unpacked) in zip(
+        cases, wants, jax.device_get(outs)
+    ):
+        np.testing.assert_array_equal(got, want, err_msg=f"{case}")
+        np.testing.assert_allclose(got_routed, want, rtol=1e-6, atol=1e-6)
+        # the unpack round-trip is lossless (nibble order: low = even j)
+        np.testing.assert_array_equal(unpacked, C)
+
+
+def test_fastscan_typed_rejections():
+    """Odd m_sub cannot pack two codes per byte and a 4-bit nibble cannot
+    address ksub > 16 — both are typed errors at the packing/kernel
+    layer.  The ROUTE derivation keeps odd-m_sub payloads off the packed
+    layout entirely (they build and search on the unpacked byte-per-code
+    route, the pre-fast-scan behavior), so the typed errors guard the
+    kernel's contract, not the user's geometry choice."""
+    from spark_rapids_ml_tpu.ann.pq import (
+        index_from_packed_pq,
+        pq_fastscan,
+    )
+    from spark_rapids_ml_tpu.ops.pallas_pq import pack_codes4
+
+    with pytest.raises(ValueError, match="even"):
+        pack_codes4(np.zeros((4, 3), np.uint8))
+    with pytest.raises(ValueError, match="16"):
+        pack_codes4(np.full((4, 2), 16, np.uint8))
+    assert pq_fastscan(4, 2) and not pq_fastscan(8, 2)
+    assert not pq_fastscan(4, 3)  # odd m_sub: unpacked route, no error
+    X, ids = _clustered(n=200, d=8, n_blobs=4, seed=9)
+    packed = build_ivfpq_packed(X, ids, 4, m_sub=3, n_bits=4, seed=0)
+    index = index_from_packed_pq(packed, get_mesh())
+    assert not index.fastscan
+    _, i = ivfpq_search_prepared(index, X[:8], 3, 4, get_mesh())
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], ids[:8])
+
+
+# -- OPQ ----------------------------------------------------------------------
+
+
+def test_opq_recall_at_equal_index_bytes():
+    """The OPQ acceptance gate, at EQUAL device code bytes per item (4-bit
+    at M vs 8-bit at M/2 — both M/2 bytes of codes): the OPQ+4-bit
+    PIPELINE (refined against the host-side f32 payload, which costs zero
+    HBM) must reach at least the recall@10 of the raw ADC-only 8-bit arm
+    on the clustered bench shape.  Rotation must also strictly help the
+    4-bit arm's own raw ADC recall — that is the part OPQ buys.  (An
+    ADC-vs-ADC flip at equal rate is NOT gated: a joint 256-word codebook
+    over 2*dsub dims is structurally at least as expressive as the product
+    of two 16-word codebooks, so 8-bit raw ADC >= 4-bit raw ADC at equal
+    bytes always — docs/ann_engine.md carries the measured table.)"""
+    X, ids = _clustered(n=2000, d=16, n_blobs=24, seed=13)
+    mesh = get_mesh()
+    nlist, nprobe, M = 16, 8, 8
+    prepared = prepare_items(X, ids, mesh)
+    Q = X[:256]
+    _, i_exact = knn_search_prepared(prepared, Q, 10, mesh)
+    arms = {
+        "raw8_halfM": (M // 2, 8, False),
+        "opq4": (M, 4, True),
+        "raw4": (M, 4, False),
+    }
+    raw, ref = {}, {}
+    for label, (m_sub, n_bits, opq) in arms.items():
+        packed = build_ivfpq_packed(
+            X, ids, nlist, m_sub=m_sub, n_bits=n_bits, seed=1, opq=opq
+        )
+        index = index_from_packed_pq(packed, mesh)
+        _, i_raw = ivfpq_search_prepared(index, Q, 10, nprobe, mesh)
+        raw[label] = recall_at_k(i_raw, i_exact)
+        _, i_ref = ivfpq_search_prepared(
+            index, Q, 10, nprobe, mesh,
+            refine_items=packed.items, refine_ratio=8,
+        )
+        ref[label] = recall_at_k(i_ref, i_exact)
+    # the equal-HBM-bytes headline: refined opq4 >= raw 8-bit at half M
+    assert ref["opq4"] >= raw["raw8_halfM"], (ref, raw)
+    assert ref["opq4"] >= 0.9, ref
+    # the rotation itself must pay for its training loop
+    assert raw["opq4"] > raw["raw4"], raw
+
+
+def test_opq_reduces_reconstruction_error():
+    """The rotation exists to cut quantization error: OPQ reconstruction
+    MSE must not exceed the unrotated build's at the same geometry, and
+    reconstruct() must un-rotate (error far below residual variance)."""
+    X, ids = _clustered(n=800, d=8, n_blobs=6, seed=4)
+    errs = {}
+    for opq in (False, True):
+        packed = build_ivfpq_packed(X, ids, 6, m_sub=2, n_bits=4, seed=5, opq=opq)
+        rec = reconstruct(packed)
+        errs[opq] = float(np.mean((rec - packed.items) ** 2))
+    assert errs[True] <= errs[False] * 1.001, errs
+    packed = build_ivfpq_packed(X, ids, 6, m_sub=2, n_bits=4, seed=5, opq=True)
+    assert packed.rotation is not None
+    R = packed.rotation.astype(np.float64)
+    np.testing.assert_allclose(R @ R.T, np.eye(R.shape[0]), atol=1e-5)
+
+
+def test_opq_and_fastscan_mesh_parity_bitwise():
+    """Acceptance: probed AND refined results BITWISE identical on 1-dev
+    and 8-dev meshes for the opq arm and the 4-bit fast-scan arm (and
+    their composition)."""
+    X, ids = _clustered(n=800, d=16, n_blobs=12, seed=17)
+    Q = X[:200]
+    for n_bits, opq in ((8, True), (4, False), (4, True)):
+        packed = build_ivfpq_packed(
+            X, ids, 8, m_sub=4, n_bits=n_bits, seed=2, opq=opq
+        )
+        out = {}
+        for name, mesh in (("one", get_mesh(1)), ("all", get_mesh())):
+            index = index_from_packed_pq(packed, mesh)
+            out[name] = (
+                ivfpq_search_prepared(index, Q, 10, 4, mesh),
+                ivfpq_search_prepared(
+                    index, Q, 10, 4, mesh,
+                    refine_items=packed.items, refine_ratio=3,
+                ),
+            )
+        for arm in (0, 1):
+            d1, i1 = out["one"][arm]
+            d8, i8 = out["all"][arm]
+            np.testing.assert_array_equal(i1, i8, err_msg=f"{n_bits}/{opq}")
+            np.testing.assert_array_equal(
+                d1.astype(np.float32).view(np.uint32),
+                d8.astype(np.float32).view(np.uint32),
+                err_msg=f"{n_bits}/{opq}",
+            )
+
+
+# -- tiered residency ---------------------------------------------------------
+
+
+def test_tiered_matches_resident_bitwise(pq_setup):
+    """Acceptance: a hot_fraction=0.25 tiered index answers the SAME
+    probed+refined search BITWISE identically to the all-resident staging
+    (the tiered kernel is the resident body plus one slot indirection),
+    and the cold->warm page-in sweep performs ZERO new compilations after
+    the first block geometry."""
+    from spark_rapids_ml_tpu.ann.pq import tiered_index_from_packed_pq
+
+    X, ids, packed = pq_setup
+    mesh = get_mesh()
+    resident = index_from_packed_pq(packed, mesh)
+    tiered = tiered_index_from_packed_pq(packed, mesh, hot_fraction=0.25)
+    kw = dict(refine_items=packed.items, refine_ratio=3)
+    Q = X[:192]
+    d_r, i_r = ivfpq_search_prepared(resident, Q, 10, 6, mesh, **kw)
+    d_t, i_t = ivfpq_search_prepared(tiered, Q, 10, 6, mesh, **kw)
+    np.testing.assert_array_equal(i_r, i_t)
+    np.testing.assert_array_equal(
+        np.asarray(d_r, np.float32).view(np.uint32),
+        np.asarray(d_t, np.float32).view(np.uint32),
+    )
+    # cold->warm sweep: disjoint query slices probe different lists, so
+    # the pager keeps paging — but never compiles anew at this geometry
+    before = profiling.counters("precompile.")
+    t0 = profiling.counter("ann.tier.hits") + profiling.counter("ann.tier.misses")
+    for lo in range(192, 2112, 192):
+        ivfpq_search_prepared(tiered, X[lo:lo + 192], 10, 6, mesh, **kw)
+    delta = profiling.counter_deltas(before, "precompile.")
+    assert delta.get("precompile.compile", 0) == 0, delta
+    assert delta.get("precompile.fallback", 0) == 0, delta
+    # the pager actually worked (counters are the observability surface)
+    assert (
+        profiling.counter("ann.tier.hits")
+        + profiling.counter("ann.tier.misses")
+    ) > t0
+    assert profiling.counter("ann.tier.stage_bytes") > 0
+
+
+def test_tiered_tombstone_interaction():
+    """Tiered + live mutation: lists paged in from host AFTER a delete
+    must honor the tombstone bitmap — the tier's host planes are views of
+    the holder's mirrors and delete_items refreshes resident slots, so a
+    tombstoned id must never resurface from ANY list, hot, resident-warm,
+    or paged-in-later cold."""
+    from spark_rapids_ml_tpu.ann.ivfflat import build_ivfflat_packed
+    from spark_rapids_ml_tpu.ann.mutable import MutableIVFIndex
+
+    rng = np.random.default_rng(23)
+    X = rng.standard_normal((1200, 16)).astype(np.float32)
+    ids = np.arange(1200, dtype=np.int64)
+    mesh = get_mesh()
+    packed = build_ivfflat_packed(X, ids, 16, seed=0)
+    holder = MutableIVFIndex(packed, mesh, hot_fraction=0.25)
+    # warm only a few lists so most stay cold on host
+    holder.search(X[:16], 5, 2)
+    victims = ids[:48]
+    holder.delete_items(victims)
+    # nprobe = nlist forces EVERY list through the pager, including cold
+    # lists first touched after the delete
+    d, i = holder.search(X[:128], 10, 16)
+    assert not np.isin(i, victims).any()
+    assert holder.stats()["tombstoned"] == 48
+    # the paged-in rows carry live neighbors, not garbage
+    live = ids[48:]
+    hits = i[i >= 0]
+    assert np.isin(hits, live).all()
+
+
+def test_model_refine_ratio_edge_semantics():
+    """Satellite regression: refine_ratio=0 used to pass the `>= 0` guard
+    and silently behave like 1 (the refine gate keys off `> 1`); it is now
+    a typed error, while refine_ratio=1 is the documented "ADC only, no
+    refine" mode and must equal the engine's raw probed route."""
+    X, _ = _clustered(n=300, d=8, n_blobs=6, seed=29)
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=1)
+    for bad in (0, -2):
+        with pytest.raises(ValueError, match="refine_ratio"):
+            ApproximateNearestNeighbors(
+                algorithm="ivfpq",
+                algoParams={"nlist": 4, "nprobe": 4, "M": 2, "refine_ratio": bad},
+            ).setFeaturesCol("features").fit(df)
+    base = {"nlist": 4, "nprobe": 4, "M": 2, "n_bits": 8}
+    model = ApproximateNearestNeighbors(
+        k=5, algorithm="ivfpq", algoParams={**base, "refine_ratio": 1},
+    ).setFeaturesCol("features").fit(df)
+    _, _, knn_df = model.kneighbors(DataFrame.from_numpy(X[:8], num_partitions=1))
+    got = np.concatenate(
+        [np.asarray(list(p["indices"])) for p in knn_df.partitions if len(p)]
+    )
+    mesh = get_mesh(model.num_workers)
+    index = model._ensure_staged_pq(mesh)
+    _, want = ivfpq_search_prepared(index, X[:8], 5, 4, mesh)  # raw ADC
+    np.testing.assert_array_equal(got, want)
+
+
+def test_model_hot_fraction_param_surface():
+    X, _ = _clustered(n=200, d=8, n_blobs=4, seed=31)
+    df = DataFrame.from_numpy(X, feature_layout="array", num_partitions=1)
+    with pytest.raises(ValueError, match="hot_fraction"):
+        ApproximateNearestNeighbors(
+            algoParams={"nlist": 4, "hot_fraction": 1.5}
+        ).setFeaturesCol("features").fit(df)
+    model = ApproximateNearestNeighbors(
+        k=3, algoParams={"nlist": 4, "nprobe": 4, "hot_fraction": 0.5},
+    ).setFeaturesCol("features").fit(df)
+    _, _, knn_df = model.kneighbors(DataFrame.from_numpy(X[:6], num_partitions=1))
+    got = np.concatenate(
+        [np.asarray(list(p["indices"])) for p in knn_df.partitions if len(p)]
+    )
+    np.testing.assert_array_equal(got[:, 0], np.arange(6))
+    res = model.index_residency()
+    assert res["hbm_bytes_per_item"] > 0
+    assert res["host_bytes_per_item"] > 0
+    assert res["items_per_device"] >= 1
