@@ -41,7 +41,10 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
         query_df = transform_df or train_df
         if self.args.mode == "tpu":
             from spark_rapids_ml_tpu import NearestNeighbors, profiling
-            from spark_rapids_ml_tpu.parallel.exchange import byte_totals
+            from spark_rapids_ml_tpu.parallel import topology
+            from spark_rapids_ml_tpu.parallel.exchange import (
+                byte_totals, link_totals,
+            )
 
             # exchange bytes are counted over the WHOLE run (staging +
             # warmup + timed repeats): device sections move at trace time,
@@ -49,6 +52,7 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
             # is recorded — a window over just the timed repeats would
             # always read zero on a warm engine
             _xt0, x0_per = byte_totals()
+            link0 = link_totals()
 
             # Deterministic staging: re-host the loaded frames as
             # block-stashed DataFrames (from_numpy pins ONE contiguous
@@ -104,11 +108,30 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
                 - pre_compiles
             )
             _xt1, x1_per = byte_totals()
+            link1 = link_totals()
             exchange_sections = {
                 name: v - x0_per.get(name, 0)
                 for name, v in sorted(x1_per.items())
                 if v - x0_per.get(name, 0) > 0
             }
+            # route + topology attribution: without these in the record,
+            # flat-vs-hierarchical rounds are indistinguishable in standings.
+            # The route comes from the per-dispatch counter (what actually
+            # ran, including the even-sharding gather fallback), the
+            # topology string from the ONE derivation the kernels key on.
+            from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+            route_counts = profiling.counters("knn.exchange_route")
+            exchange_route = "/".join(
+                sorted(
+                    k.rsplit(".", 1)[1]
+                    for k, v in route_counts.items()
+                    if v > 0
+                )
+            ) or "none"
+            topo_str = topology.topology_map(
+                mesh=get_mesh(getattr(model, "num_workers", None))
+            ).describe()
             phases = {
                 name: round(sec, 4)
                 for name, sec in sorted(phase_runs[-1].items())
@@ -128,6 +151,12 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
                 "repeat_new_compiles": int(repeat_new_compiles),
                 "exchange_bytes": int(sum(exchange_sections.values())),
                 "exchange_sections": exchange_sections,
+                "exchange_route": exchange_route,
+                "topology": topo_str,
+                "exchange_link_bytes": {
+                    link: int(link1[link] - link0.get(link, 0))
+                    for link in ("ici", "dcn")
+                },
             }
             if inner_repeats > 1:
                 out["times_sec"] = [round(t, 4) for t in repeat_times]

@@ -743,6 +743,69 @@ assert tiered["host_bytes_per_item"] > 0, tiered
 EOF
 rm -rf "$TIER_SMOKE"
 
+# 3s. srml-topo gates: topology-aware hierarchical collectives (also
+#     inside the full suite; re-asserted by name so marker drift can
+#     never silently drop them — docs/knn_pipeline.md §topology,
+#     docs/observability.md §5):
+#     - BITWISE parity: hierarchical device collectives (allgather_rows /
+#       gather_stack / psum_merge) == flat on contiguous and interleaved
+#       group shapes; the kNN ring+gather kernels == the single-device
+#       reference on 1/2/8-device meshes across simulated topologies
+#       1x8 / 2x4 / 4x2, with and without the SRML_EXCHANGE_TOPO=flat pin
+#     - per-link counter split matches the byte model exactly, and on a
+#       simulated 2x4 the hierarchical schedule's DCN bytes <=
+#       flat DCN / n_hosts (+10% slack) — the headline collapse
+#     - TopologyMap is a compile-cache static (flat / hier / pinned key
+#       differently; equal-by-value maps key identically) and the hier
+#       route performs ZERO new compilations on repeat search
+#     - the host-plane ring adopts the same cycle (CRC-agreed) bitwise
+#       vs flat, with ici/dcn attribution only under SRML_TOPO
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_topology.py -q
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_topology.py tests/test_router.py -q \
+    -k "test_knn_topology_parity_matrix_bitwise \
+        or test_hier_collectives_bitwise_match_flat \
+        or test_knn_hier_dcn_bytes_bound_on_2x4 \
+        or test_hier_route_zero_new_compiles_on_repeat_search \
+        or test_slice_meshes_topology_aware_never_straddles_host_group"
+# the exchange plane + its consumers must stay graftlint-clean (R8: only
+# exchange.py touches the remote-DMA API; R1/R6 on the new topology path)
+python -m tools.graftlint \
+    spark_rapids_ml_tpu/parallel/topology.py \
+    spark_rapids_ml_tpu/parallel/exchange.py \
+    spark_rapids_ml_tpu/parallel/mesh.py \
+    spark_rapids_ml_tpu/ops/knn.py
+# paired bench smoke on ONE dataset: hierarchical 2x4 vs flat-pinned 2x4;
+# the DCN collapse and zero steady-state compiles are captured artifacts
+TOPO_SMOKE=$(mktemp -d)
+python -m benchmark.gen_data blobs --num_rows 2000 --num_cols 16 --n_clusters 8 \
+    --output_dir "$TOPO_SMOKE/blobs" --output_num_files 2
+XLA_FLAGS="--xla_force_host_platform_device_count=8" SRML_TOPO=2:4 \
+    python -m benchmark.benchmark_runner knn \
+    --train_path "$TOPO_SMOKE/blobs" --k 10 \
+    --report_path "$TOPO_SMOKE/knn_topo.jsonl"
+XLA_FLAGS="--xla_force_host_platform_device_count=8" SRML_TOPO=2:4 \
+    SRML_EXCHANGE_TOPO=flat \
+    python -m benchmark.benchmark_runner knn \
+    --train_path "$TOPO_SMOKE/blobs" --k 10 \
+    --report_path "$TOPO_SMOKE/knn_topo.jsonl"
+python - "$TOPO_SMOKE/knn_topo.jsonl" <<'EOF'
+import json, sys
+hier, flat = [json.loads(l) for l in open(sys.argv[1])]
+assert hier["topology"] == "2x4/hier", hier["topology"]
+assert flat["topology"] == "2x4/flat-pinned", flat["topology"]
+for r in (hier, flat):
+    assert r["repeat_new_compiles"] == 0, r
+    assert r["exchange_route"] != "none", r
+hd, fd = hier["exchange_link_bytes"]["dcn"], flat["exchange_link_bytes"]["dcn"]
+# flat on a multi-group topology accounts everything as DCN; the
+# hierarchical schedule must collapse cross-host traffic by >= n_hosts
+assert hier["exchange_link_bytes"]["ici"] > 0, hier
+assert fd > 0 and hd <= fd / 2 * 1.10, (hd, fd)
+EOF
+rm -rf "$TOPO_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

@@ -468,6 +468,17 @@ def _exchange_geometry(n_loc: int, q_rows: int, n_dev: int, route: str):
     return chunk, qt
 
 
+def _exchange_topology(mesh: Mesh):
+    """TopologyMap static for the exchange kernels — the ONE derivation
+    shared by dispatch (_exact_block_search) and warm_search_kernels, so
+    the two always key the same executable AND a topology change (env
+    override flipped, different process layout) re-keys the AOT cache
+    instead of silently reusing a schedule compiled for another shape."""
+    from ..parallel import topology
+
+    return topology.topology_map(mesh=mesh)
+
+
 def _lex_local_scan(items_loc, x_norm, pos_loc, valid_loc, q, k, chunk, qt):
     """Per-shard lex-(d2, pos) top-k of `q` against the resident items:
     lax.scan over fixed qt-row query sub-tiles (outer) and fixed chunk-wide
@@ -515,7 +526,9 @@ def _lex_local_scan(items_loc, x_norm, pos_loc, valid_loc, q, k, chunk, qt):
     return ds.reshape(-1, k), ps.reshape(-1, k)
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "route", "chunk", "qt"))
+@partial(
+    jax.jit, static_argnames=("mesh", "k", "route", "chunk", "qt", "topo")
+)
 def knn_block_kernel_exchange(
     items: jax.Array,      # (N_pad, D) row-sharded
     item_norm: jax.Array,  # (N_pad,) row-sharded
@@ -528,6 +541,7 @@ def knn_block_kernel_exchange(
     route: str,            # "ring" | "gather"
     chunk: int,
     qt: int,
+    topo=None,             # TopologyMap static (hashable); None = flat
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k nearest items per query over the candidate-exchange routes
     (module header).  Same output contract as knn_block_kernel: (distances
@@ -546,8 +560,8 @@ def knn_block_kernel_exchange(
     n_pad = items.shape[0]
 
     def per_shard_ring(items_loc, x_norm, pos_loc, valid_loc, q_blk):
-        sec_q = device_collective("knn.ring_q")
-        sec_c = device_collective("knn.ring_cand")
+        sec_q = device_collective("knn.ring_q", topo)
+        sec_c = device_collective("knn.ring_cand", topo)
         bd = jnp.full((q_blk.shape[0], k), jnp.inf, jnp.float32)
         bp = jnp.full((q_blk.shape[0], k), LEX_POS_SENTINEL, jnp.int32)
         for _hop in range(n_dev):
@@ -577,7 +591,7 @@ def knn_block_kernel_exchange(
             items_loc, x_norm, pos_loc, valid_loc, q, k, chunk, qt
         )
         Q = q.shape[0]
-        sec = device_collective("knn.gather_cand")
+        sec = device_collective("knn.gather_cand", topo)
         all_d = sec.psum_merge(cd, DATA_AXIS)   # (n_dev, Q, k) slabs —
         all_p = sec.psum_merge(cp, DATA_AXIS)   # exact as a gather
         fd, fp = lex_topk(
@@ -629,6 +643,7 @@ def _exact_block_search(items, item_norm, item_pos, valid, qd, mesh, k):
     chunk, qt = _exchange_geometry(
         items.shape[0] // n_dev, qd.shape[0], n_dev, route
     )
+    topo = _exchange_topology(mesh)
     if route == "ring":
         from ..parallel.mesh import data_sharding
 
@@ -638,12 +653,12 @@ def _exact_block_search(items, item_norm, item_pos, valid, qd, mesh, k):
         return _cached_kernel(
             "knn_ring", knn_block_kernel_exchange,
             items, item_norm, item_pos, valid, qd,
-            mesh=mesh, k=k, route="ring", chunk=chunk, qt=qt,
+            mesh=mesh, k=k, route="ring", chunk=chunk, qt=qt, topo=topo,
         )
     return _cached_kernel(
         "knn_gather", knn_block_kernel_exchange,
         items, item_norm, item_pos, valid, qd,
-        mesh=mesh, k=k, route="gather", chunk=chunk, qt=qt,
+        mesh=mesh, k=k, route="gather", chunk=chunk, qt=qt, topo=topo,
     )
 
 
@@ -1618,8 +1633,22 @@ def distributed_kneighbors(
         and est_bytes
         <= _hbm_budget_bytes() * max(1, mesh.shape[DATA_AXIS])
     )
+    # host-plane ring cycle: rank topology from SRML_TOPO only (host ranks
+    # expose no device attributes), same two-level ring_cycle derivation
+    # the in-mesh ring_shift uses.  The cycle must be IDENTICAL on every
+    # rank or the ring desyncs, so its checksum rides the metadata round
+    # and any disagreement (one rank missing the env override) falls every
+    # rank back to the flat rotation.
+    import zlib
+
+    from ..parallel import topology as _topo_mod
+
+    rank_topo = _topo_mod.topology_map(n_devices=nranks)
+    ring_cycle = _topo_mod.ring_cycle(rank_topo)
+    cycle_crc = zlib.crc32(repr(ring_cycle).encode()) & 0x7FFFFFFF
     meta = np.array(
-        [q_cat.shape[0], n_items_loc, d_q, d_i, ring_ok], np.int64
+        [q_cat.shape[0], n_items_loc, d_q, d_i, ring_ok, cycle_crc],
+        np.int64,
     )
     metas = [
         unpack_arrays(fr)[0]
@@ -1651,10 +1680,14 @@ def distributed_kneighbors(
     # out-of-core rank flips every rank to the allgather protocol, and the
     # counter must say what actually ran
     if all(int(m[4]) for m in metas):
+        if {int(m[5]) for m in metas} != {cycle_crc}:
+            rank_topo = _topo_mod.flat_topology(nranks)
+            ring_cycle = _topo_mod.ring_cycle(rank_topo)
         profiling.incr_counter("knn.exchange_route.dist_ring")
         return _distributed_ring(
             control_plane, rank, nranks, q_cat, q_rows, item_parts,
             n_items_loc, D, k, k_eff, mesh, dtype,
+            rank_topo=rank_topo, cycle=ring_cycle,
         )
     profiling.incr_counter("knn.exchange_route.dist_allgather")
 
@@ -1724,16 +1757,37 @@ def distributed_kneighbors(
 def _distributed_ring(
     control_plane, rank, nranks, q_cat, q_rows, item_parts,
     n_items_loc, D, k, k_eff, mesh, dtype,
+    rank_topo=None, cycle=None,
 ):
     """Ring route of distributed_kneighbors (docstring there): the (query
-    block, running candidates) frame rotates rank -> rank+1 for nranks
-    hops; each hop the receiving rank scans the visiting block against its
-    RESIDENT item blocks and merges into the block's traveling top-k.  The
-    last rotation delivers every block home, so no result scatter round is
-    needed.  COLLECTIVE: exactly nranks ring_pass_bytes calls per rank,
-    empty blocks included."""
+    block, running candidates) frame travels the agreed single n-cycle for
+    nranks hops; each hop the receiving rank scans the visiting block
+    against its RESIDENT item blocks and merges into the block's traveling
+    top-k.  n hops of an n-cycle = identity, so the last hop delivers
+    every block home and no result scatter round is needed.  COLLECTIVE:
+    exactly nranks ring_pass_bytes calls per rank, empty blocks included.
+
+    `cycle` is the topology-aware permutation (topology.ring_cycle over
+    the SRML_TOPO rank grouping, checksum-agreed in the metadata round —
+    the flat rotation when absent): intra-host edges stay on ICI, one
+    gateway edge per adjacent host pair crosses DCN, and each hop's send
+    is attributed to `exchange.ring.ici_bytes`/`.dcn_bytes` by the edge
+    this rank drives (simulated topologies only — no attribution without
+    an SRML_TOPO grouping)."""
     from .. import native
+    from ..parallel import topology as _topo_mod
     from ..parallel.exchange import pack_arrays, ring_pass_bytes, unpack_arrays
+
+    if rank_topo is None:
+        rank_topo = _topo_mod.flat_topology(nranks)
+    if cycle is None:
+        cycle = _topo_mod.ring_cycle(rank_topo)
+    nxt = dict(cycle)
+    prv = {d: s for s, d in cycle}
+    link = None
+    if rank_topo.source == "env":
+        gof = rank_topo.group_of
+        link = "ici" if gof[rank] == gof[nxt[rank]] else "dcn"
 
     def _parts():
         for f, i in item_parts:
@@ -1776,7 +1830,10 @@ def _distributed_ring(
         with profiling.span("knn.ring.hop", hop=hop):
             faults.site("knn.ring_hop", rank=rank)
             payload = pack_arrays([qb, d_cur, i_cur])
-            got = ring_pass_bytes(control_plane, rank, nranks, payload)
+            got = ring_pass_bytes(
+                control_plane, rank, nranks, payload,
+                src=prv[rank], link=link,
+            )
             qb, d_cur, i_cur = unpack_arrays(got)
             qb = qb.astype(dtype, copy=False)
             if hop < nranks - 1 and qb.shape[0] and blocks:
@@ -2094,7 +2151,9 @@ def warm_search_kernels(
         prepared.items, prepared.norm, prepared.pos, prepared.valid, q_aval,
     )
     name = "knn_ring" if route == "ring" else "knn_gather"
-    statics = dict(k=k, route=route, chunk=chunk, qt=qt)
+    statics = dict(
+        k=k, route=route, chunk=chunk, qt=qt, topo=_exchange_topology(mesh)
+    )
     key = _kernel_cache_key(name, args, mesh, statics)
     pc.submit(key, knn_block_kernel_exchange, *args, mesh=mesh, **statics)
     keys.append(key)

@@ -103,6 +103,18 @@ def device_section(name: str, *arrays: Any):
     return jax.named_scope(full)
 
 
+def _record_link_bytes(name: str, ici: int, dcn: int) -> None:
+    """Per-link split counters, ADDITIVE to the legacy `.bytes` total:
+    `exchange.<name>.ici_bytes` / `.dcn_bytes` are whole-mesh byte MODELS
+    of the schedule that traced (topology.link_split_*), where `.bytes`
+    stays the per-shard payload.  The `_bytes` suffix keeps them out of
+    byte_totals()'s `.bytes` scan; link_totals() rolls them up."""
+    if ici:
+        profiling.incr_counter(f"exchange.{name}.ici_bytes", int(ici))
+    if dcn:
+        profiling.incr_counter(f"exchange.{name}.dcn_bytes", int(dcn))
+
+
 def pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
     """One binary frame: magic, array count, then per array a dtype/shape
     header followed by the raw C-order buffer.  No base64, no JSON."""
@@ -215,70 +227,190 @@ def allgather_bytes(
 
 class DeviceSection:
     """Typed handle for one named in-mesh collective section.  Construct
-    via device_collective(name); every method must be called ONLY inside a
-    shard_map body bound over `axis_name` (default DATA_AXIS)."""
+    via device_collective(name[, topo]); every method must be called ONLY
+    inside a shard_map body bound over `axis_name` (default DATA_AXIS).
 
-    __slots__ = ("name",)
+    With a hierarchical `topology.TopologyMap` attached, the gather-class
+    collectives run the two-level schedule (gather within the host group,
+    ONE gateway exchange across groups, broadcast back within the group)
+    and ring_shift follows the gateway-aware cycle; every method also
+    splits its modeled traffic into `.ici_bytes`/`.dcn_bytes`.  The map is
+    STATIC data — callers must carry it in their jit/cache keys (the kNN
+    kernels pass it through kernel_cache_key statics), never read it from
+    the environment at trace time."""
 
-    def __init__(self, name: str):
+    __slots__ = ("name", "topo")
+
+    def __init__(self, name: str, topo=None):
         self.name = name
+        self.topo = topo
+
+    def _resolved(self, n_dev: int):
+        """The attached map when it matches this mesh's axis size, else
+        the trivial flat map (a mismatched map would mis-schedule; the
+        kNN dispatch derives per-mesh so this only guards foreign
+        reuse)."""
+        from . import topology
+
+        if self.topo is not None and self.topo.n_devices == int(n_dev):
+            return self.topo
+        return topology.flat_topology(int(n_dev))
+
+    def _hier_slab(self, x, axis: str, topo):
+        """The (n_dev, ...) all-shards slab via the two-level schedule:
+        gather within the host group (ICI), scatter the group's blocks
+        into a zeros slab on the GATEWAY only, then ONE full-axis psum so
+        each group's slab-part crosses DCN once and lands replicated
+        (which also keeps shard_map's replication inference sound —
+        grouped gathers alone are opaque to it).  Every slab element is
+        one shard's value plus zeros exactly like the flat zeros-slab
+        psum, so the result is BITWISE equal to the flat schedule."""
+        import jax
+        import jax.numpy as jnp
+
+        gmat = jnp.asarray(np.asarray(topo.groups, dtype=np.int32))
+        gof = jnp.asarray(np.asarray(topo.group_of, dtype=np.int32))
+        gate = jnp.asarray(np.asarray(topo.gateways, dtype=np.int32))
+        idx = jax.lax.axis_index(axis)
+        gid = jnp.take(gof, idx)
+        intra = jax.lax.all_gather(
+            x, axis, axis_index_groups=[list(g) for g in topo.groups]
+        )
+        rows = jnp.take(gmat, gid, axis=0)
+        slab = (
+            jnp.zeros((topo.n_devices,) + x.shape, x.dtype)
+            .at[rows].set(intra)
+        )
+        part = jnp.where(
+            (idx == jnp.take(gate, gid)).reshape((1,) * slab.ndim),
+            slab,
+            jnp.zeros_like(slab),
+        )
+        return jax.lax.psum(part, axis)
 
     def allgather_rows(self, x, axis_name: str = None):
         """Concatenate per-device row blocks along axis 0 (tiled)."""
         import jax
 
+        from . import topology
         from .mesh import DATA_AXIS
 
+        axis = axis_name or DATA_AXIS
         with device_section(self.name, x):
-            return jax.lax.all_gather(
-                x, axis_name or DATA_AXIS, axis=0, tiled=True
+            n_dev = jax.lax.psum(1, axis)
+            topo = self._resolved(n_dev)
+            _record_link_bytes(
+                self.name, *topology.link_split_gather(topo, _static_nbytes(x))
             )
+            if topo.is_hierarchical:
+                slab = self._hier_slab(x, axis, topo)
+                return slab.reshape((n_dev * x.shape[0],) + x.shape[1:])
+            return jax.lax.all_gather(x, axis, axis=0, tiled=True)
 
     def gather_stack(self, x, axis_name: str = None):
         """Stack per-device blocks into a leading (n_dev, ...) axis —
         the candidate-list gather shape of the exact kNN block kernel."""
         import jax
 
-        from .mesh import DATA_AXIS
-
-        with device_section(self.name, x):
-            return jax.lax.all_gather(x, axis_name or DATA_AXIS)
-
-    def psum(self, x, axis_name: str = None):
-        """Element-wise sum of per-device partials (lax.psum)."""
-        import jax
-
-        from .mesh import DATA_AXIS
-
-        with device_section(self.name, *jax.tree_util.tree_leaves(x)):
-            return jax.lax.psum(x, axis_name or DATA_AXIS)
-
-    def psum_merge(self, x, axis_name: str = None):
-        """Stack per-device candidate blocks into one (n_dev, ...) slab via
-        a single psum (zeros-slab scatter; exact as a gather — every element
-        receives one shard's value plus zeros, and x + 0.0 is exact for the
-        finite/+inf distances and int32 positions the merges carry)."""
-        import jax
-        import jax.numpy as jnp
-
+        from . import topology
         from .mesh import DATA_AXIS
 
         axis = axis_name or DATA_AXIS
         with device_section(self.name, x):
             n_dev = jax.lax.psum(1, axis)
+            topo = self._resolved(n_dev)
+            _record_link_bytes(
+                self.name, *topology.link_split_gather(topo, _static_nbytes(x))
+            )
+            if topo.is_hierarchical:
+                return self._hier_slab(x, axis, topo)
+            return jax.lax.all_gather(x, axis)
+
+    def psum(self, x, axis_name: str = None):
+        """Element-wise sum of per-device partials (lax.psum).  The
+        hierarchical schedule reduces within the group first and crosses
+        DCN with the group-reduced partial; summation is re-associated, so
+        (unlike the movement-only collectives) it is NOT bitwise-pinned to
+        the flat schedule for non-exact dtypes — the forest/stat engines
+        that need exactness keep the flat default."""
+        import jax
+        import jax.numpy as jnp
+
+        from . import topology
+        from .mesh import DATA_AXIS
+
+        axis = axis_name or DATA_AXIS
+        leaves = jax.tree_util.tree_leaves(x)
+        with device_section(self.name, *leaves):
+            n_dev = jax.lax.psum(1, axis)
+            topo = self._resolved(n_dev)
+            _record_link_bytes(
+                self.name,
+                *topology.link_split_reduce(topo, _static_nbytes(*leaves)),
+            )
+            if topo.is_hierarchical:
+                gof = jnp.asarray(np.asarray(topo.group_of, dtype=np.int32))
+                gate = jnp.asarray(np.asarray(topo.gateways, dtype=np.int32))
+                idx = jax.lax.axis_index(axis)
+                is_gate = idx == jnp.take(gate, jnp.take(gof, idx))
+                groups = [list(g) for g in topo.groups]
+
+                def _leaf(leaf):
+                    intra = jax.lax.all_gather(
+                        leaf, axis, axis_index_groups=groups
+                    )
+                    part = jnp.sum(intra, axis=0)
+                    part = jnp.where(
+                        is_gate.reshape((1,) * part.ndim),
+                        part,
+                        jnp.zeros_like(part),
+                    )
+                    return jax.lax.psum(part, axis)
+
+                return jax.tree_util.tree_map(_leaf, x)
+            return jax.lax.psum(x, axis)
+
+    def psum_merge(self, x, axis_name: str = None):
+        """Stack per-device candidate blocks into one (n_dev, ...) slab via
+        a single psum (zeros-slab scatter; exact as a gather — every element
+        receives one shard's value plus zeros, and x + 0.0 is exact for the
+        finite/+inf distances and int32 positions the merges carry).  The
+        hierarchical schedule (_hier_slab) keeps the identical one-value-
+        plus-zeros summand structure, so both schedules are BITWISE equal."""
+        import jax
+        import jax.numpy as jnp
+
+        from . import topology
+        from .mesh import DATA_AXIS
+
+        axis = axis_name or DATA_AXIS
+        with device_section(self.name, x):
+            n_dev = jax.lax.psum(1, axis)
+            topo = self._resolved(n_dev)
+            _record_link_bytes(
+                self.name, *topology.link_split_gather(topo, _static_nbytes(x))
+            )
+            if topo.is_hierarchical:
+                return self._hier_slab(x, axis, topo)
             idx = jax.lax.axis_index(axis)
             slab = jnp.zeros((n_dev,) + x.shape, x.dtype).at[idx].set(x)
             return jax.lax.psum(slab, axis)
 
     def ring_shift(self, x, axis_name: str = None, shift: int = 1):
-        """Send this shard's block to the (index + shift) % n_dev neighbor
-        and receive the (index - shift) one's — the ring-permute hop of the
-        kNN candidate exchange.  Counters record the per-hop payload, so a
-        full ring pass shows n_dev x block bytes (vs the n_dev^2 x block an
-        all-gather replicates).  TPU: Pallas remote-DMA kernel; elsewhere:
-        lax.ppermute (identical semantics, the tier-1/parity path)."""
+        """Send this shard's block to its ring successor and receive its
+        predecessor's — the ring-permute hop of the kNN candidate
+        exchange.  Counters record the per-hop payload, so a full ring
+        pass shows n_dev x block bytes (vs the n_dev^2 x block an
+        all-gather replicates).  With a hierarchical topology the cycle
+        tours each host group's ICI neighbors consecutively with exactly
+        one gateway edge per group pair on DCN (topology.ring_cycle);
+        flat keeps the +shift rotation (mesh.ring_permutation, the ONE
+        flat ring order).  TPU: Pallas remote-DMA kernel for the uniform
+        flat rotation; the hierarchical cycle and every non-TPU backend
+        ride lax.ppermute (identical semantics, the tier-1/parity path)."""
         import jax
 
+        from . import topology
         from .mesh import DATA_AXIS
 
         axis = axis_name or DATA_AXIS
@@ -286,6 +418,20 @@ class DeviceSection:
             n_dev = jax.lax.psum(1, axis)
             if n_dev == 1:
                 return x
+            topo = self._resolved(n_dev)
+            _record_link_bytes(
+                self.name,
+                *topology.link_split_ring_hop(topo, _static_nbytes(x)),
+            )
+            if topo.is_hierarchical:
+                # the remote-DMA kernel computes dst = my + shift analytically,
+                # which only matches the uniform rotation; the gateway cycle
+                # rides ppermute on every backend (XLA schedules TPU ppermute
+                # over ICI fine — the dedicated gateway DMA kernel is
+                # accelerator-session work)
+                return jax.lax.ppermute(
+                    x, axis, topology.ring_cycle(topo, shift)
+                )
             if _remote_dma_enabled():
                 return _ring_shift_remote_dma(x, axis, shift, n_dev)
             from .mesh import ring_permutation
@@ -293,9 +439,12 @@ class DeviceSection:
             return jax.lax.ppermute(x, axis, ring_permutation(n_dev, shift))
 
 
-def device_collective(name: str) -> DeviceSection:
-    """The typed-section constructor: one named handle per call site."""
-    return DeviceSection(name)
+def device_collective(name: str, topo=None) -> DeviceSection:
+    """The typed-section constructor: one named handle per call site.
+    `topo` (a topology.TopologyMap) opts the section into the
+    hierarchical schedules — pass it ONLY from code that also carries it
+    in its compilation cache key."""
+    return DeviceSection(name, topo)
 
 
 # remote-DMA gate: TPU hardware with pallas enabled, unless explicitly
@@ -401,12 +550,43 @@ def byte_totals(prefix: str = "exchange."):
     host sections count per call, device sections per compiled geometry
     (trace time).  bench.py snapshots this around each arm so the round
     standings can print a `bytes moved` column and make the all-gather ->
-    ring traffic reduction a captured artifact."""
+    ring traffic reduction a captured artifact.  The per-LINK rollup of
+    the same namespace lives in link_totals() — the `.ici_bytes`/
+    `.dcn_bytes` split counters carry an underscore suffix precisely so
+    this scan never double-counts them."""
     per = {}
     for name, v in profiling.counters(prefix).items():
         if name.endswith(".bytes"):
             per[name[len(prefix):-len(".bytes")]] = int(v)
     return sum(per.values()), per
+
+
+def link_totals(prefix: str = "exchange."):
+    """{"ici": bytes, "dcn": bytes} rollup of the per-section link-split
+    counters (`exchange.<name>.ici_bytes` / `.dcn_bytes`) — the link-
+    pressure view of byte_totals().  Surfaced continuously through
+    export_metrics()["gauges"] (the `exchange.link.*` provider below) and
+    rendered as the `srml_exchange_bytes{link="ici|dcn"}` Prometheus
+    family, so the serving plane's dashboards see DCN pressure without a
+    bench round."""
+    out = {"ici": 0, "dcn": 0}
+    for name, v in profiling.counters(prefix).items():
+        if name.endswith(".ici_bytes"):
+            out["ici"] += int(v)
+        elif name.endswith(".dcn_bytes"):
+            out["dcn"] += int(v)
+    return out
+
+
+def _link_gauges():
+    links = link_totals()
+    return {
+        "exchange.link.ici_bytes": float(links["ici"]),
+        "exchange.link.dcn_bytes": float(links["dcn"]),
+    }
+
+
+profiling.register_gauges("exchange.link", _link_gauges)
 
 
 def ring_pass_bytes(
@@ -415,12 +595,21 @@ def ring_pass_bytes(
     nranks: int,
     payload: bytes,
     chunk: int = CHUNK_BYTES,
+    src: Optional[int] = None,
+    link: Optional[str] = None,
 ) -> bytes:
-    """One ring hop over the control plane: send `payload` to the
-    (rank + 1) % nranks neighbor and return the payload received from
-    (rank - 1) % nranks — the HOST-plane analog of DeviceSection.ring_shift,
-    used by distributed_kneighbors' ring route to rotate query blocks +
-    running candidate lists between ranks as binary frames.
+    """One ring hop over the control plane: contribute `payload` and
+    return the payload received from `src` (default the flat-ring
+    predecessor, (rank - 1) % nranks) — the HOST-plane analog of
+    DeviceSection.ring_shift, used by distributed_kneighbors' ring route
+    to rotate query blocks + running candidate lists between ranks as
+    binary frames.  A non-default `src` lets the caller follow a
+    topology-aware cycle (topology.ring_cycle over ranks): every rank
+    must apply the SAME cycle and pass its own predecessor in it — the
+    broadcast transport carries every frame regardless, so routing IS the
+    receiver's decode choice.  `link` ("ici" | "dcn") attributes this
+    hop's outgoing payload to the `exchange.ring.<link>_bytes` split
+    counter when the caller knows the edge's link class.
 
     The wire rides the broadcast allGather (the only collective a Spark
     barrier offers) but the decode is p2p-shaped: a receiver b64-decodes /
@@ -432,9 +621,12 @@ def ring_pass_bytes(
     # receiver's SRX1 magic check must fail loudly); die/raise simulate a
     # rank lost mid-ring
     payload = faults.site("exchange.ring_pass", rank=rank, payload=payload)
+    if link in ("ici", "dcn") and payload:
+        profiling.incr_counter(f"exchange.ring.{link}_bytes", len(payload))
     with section("ring", nbytes=len(payload)):
         use_bytes = hasattr(cp, "allGatherBytes")
-        src = (rank - 1) % nranks
+        if src is None:
+            src = (rank - 1) % nranks
         mine = _chunks(payload, chunk)
         counts = [int(c) for c in cp.allGather(str(len(mine)))]
         parts: List[bytes] = []

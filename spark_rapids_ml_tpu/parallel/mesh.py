@@ -88,10 +88,20 @@ def slice_meshes(n_slices: int, devices=None) -> list:
     replicas on the same chips anyway.  With fewer devices than slices the
     surplus slices each get ONE device, round-robin — single-device
     programs have no cross-program rendezvous, so oversubscription degrades
-    to compute contention instead of deadlock."""
+    to compute contention instead of deadlock.
+
+    The carve order is GROUP-MAJOR over the host topology
+    (parallel/topology.py): devices sharing a host group come first,
+    consecutively, so a contiguous slice never straddles a host group when
+    the device count allows — a replica spanning DCN would pay the slow
+    link on every dispatch.  On flat/unknown topologies this is the
+    identity order."""
     if n_slices < 1:
         raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    from . import topology
+
     devs = list(devices) if devices is not None else jax.devices()
+    devs = topology.group_major_devices(devs)
     per = len(devs) // n_slices
     out = []
     for i in range(n_slices):

@@ -1,0 +1,289 @@
+#
+# Topology map: which devices share a host (ICI-connected) and which pairs
+# can only reach each other over DCN — the physical-link dimension of the
+# exchange plane (ROADMAP item 2's software half).
+#
+# On real multi-host TPU topology an intra-host ICI hop is an order of
+# magnitude cheaper than a cross-host DCN hop, but every collective in
+# parallel/exchange.py historically treated all neighbors as equal.  The
+# TopologyMap derived here feeds three consumers:
+#
+#   * DeviceSection (parallel/exchange.py): hierarchical schedules for
+#     allgather_rows / psum / psum_merge (gather within the host group,
+#     ONE gateway exchange across groups, broadcast back inside the group)
+#     and the gateway-aware ring_shift cycle, plus the per-link
+#     `exchange.<name>.ici_bytes` / `.dcn_bytes` accounting split.
+#   * ops/knn.py: the in-mesh ring/gather exchange kernels carry the map as
+#     a cache-key STATIC (a topology change can never silently reuse a
+#     stale executable), and distributed_kneighbors orders its host-plane
+#     ring along the same two-level cycle.
+#   * parallel/mesh.slice_meshes: router replica slices are carved
+#     group-major so a replica never straddles a host group when the
+#     device count allows.
+#
+# Derivation prefers real device attributes (process_index — jax's host
+# grouping).  `SRML_TOPO=hosts:devs_per_host` overrides it for CI
+# simulation on the virtual CPU mesh (grouping by device id), and
+# `SRML_EXCHANGE_TOPO=flat` pins the topology-oblivious flat schedule —
+# the parity comparator and the escape hatch, same role SRML_KNN_EXCHANGE
+# plays for the route.
+#
+# Link accounting model (documented in docs/observability.md): the split
+# counters are TRACE-TIME whole-mesh byte models per collective, not
+# measured wire bytes.  A hierarchical schedule charges its intra-group
+# stages to ICI and its single gateway stage to DCN; a flat schedule on a
+# multi-group topology offers no locality guarantee, so ALL its traffic is
+# charged to DCN (on a single-group topology everything is provably ICI).
+# That conservative attribution is exactly the headline CI asserts: the
+# flat ring pushes O(n_dev) unpinned frames per block per round where the
+# hierarchical cycle guarantees O(n_hosts) gateway crossings.
+#
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+TOPO_ENV = "SRML_TOPO"
+EXCHANGE_TOPO_ENV = "SRML_EXCHANGE_TOPO"
+
+
+@dataclass(frozen=True)
+class TopologyMap:
+    """Host-group partition of a 1-D device axis.
+
+    `groups` holds LOGICAL axis positions (tuple per host group, groups in
+    gateway order, positions ascending within a group).  Hashable with
+    stable equality by value, so it can ride jit static_argnames and the
+    AOT `kernel_cache_key` statics tuple directly."""
+
+    groups: Tuple[Tuple[int, ...], ...]
+    source: str = "flat"  # "process" | "env" | "flat"
+    pinned: bool = False  # SRML_EXCHANGE_TOPO=flat held at derivation time
+
+    @property
+    def n_devices(self) -> int:
+        return sum(len(g) for g in self.groups)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def group_size(self) -> int:
+        """Uniform group size, or 0 when groups are unequal (a shape the
+        hierarchical schedules refuse — they fall back to flat)."""
+        sizes = {len(g) for g in self.groups}
+        return sizes.pop() if len(sizes) == 1 else 0
+
+    @property
+    def group_of(self) -> Tuple[int, ...]:
+        out = [0] * self.n_devices
+        for k, g in enumerate(self.groups):
+            for p in g:
+                out[p] = k
+        return tuple(out)
+
+    @property
+    def gateways(self) -> Tuple[int, ...]:
+        """One designated gateway position per group (the first member):
+        the device that carries the group's cross-DCN exchange."""
+        return tuple(g[0] for g in self.groups)
+
+    @property
+    def schedule(self) -> str:
+        """"hier" when a two-level schedule is worthwhile and sound:
+        more than one group, uniform group size > 1, and not pinned flat.
+        Everything else degenerates to "flat"."""
+        if self.pinned or self.n_groups <= 1 or self.group_size <= 1:
+            return "flat"
+        return "hier"
+
+    @property
+    def is_hierarchical(self) -> bool:
+        return self.schedule == "hier"
+
+    def describe(self) -> str:
+        """Stable topology string for bench artifacts and logs,
+        e.g. "2x4/hier", "1x8/flat", "2x4/flat-pinned"."""
+        g = self.group_size
+        shape = f"{self.n_groups}x{g}" if g else "x".join(
+            str(len(g)) for g in self.groups
+        )
+        sched = self.schedule + ("-pinned" if self.pinned else "")
+        return f"{shape}/{sched}"
+
+
+def flat_topology(n_devices: int) -> TopologyMap:
+    """The trivial single-group map (every device one ICI domain)."""
+    return TopologyMap(groups=(tuple(range(n_devices)),), source="flat")
+
+
+def _pinned_flat() -> bool:
+    return os.environ.get(EXCHANGE_TOPO_ENV, "").strip().lower() == "flat"
+
+
+def _parse_override() -> Optional[int]:
+    """SRML_TOPO=hosts:devs_per_host → devs_per_host (the physical
+    grouping stride; `hosts` documents intent and is sanity-checked only).
+    Malformed specs raise: a typo'd topology silently simulating flat
+    would invalidate every gate that depends on it."""
+    spec = os.environ.get(TOPO_ENV, "").strip()
+    if not spec:
+        return None
+    try:
+        hosts_s, devs_s = spec.split(":")
+        hosts, devs = int(hosts_s), int(devs_s)
+    except ValueError:
+        raise ValueError(
+            f"{TOPO_ENV}={spec!r}: expected 'hosts:devs_per_host'"
+        )
+    if hosts < 1 or devs < 1:
+        raise ValueError(f"{TOPO_ENV}={spec!r}: both fields must be >= 1")
+    return devs
+
+
+def _group_positions(keys: Sequence[Any]) -> Tuple[Tuple[int, ...], ...]:
+    """Partition positions 0..n-1 by key; groups ordered by sorted key,
+    positions ascending within each group."""
+    by_key: dict = {}
+    for pos, k in enumerate(keys):
+        by_key.setdefault(k, []).append(pos)
+    return tuple(tuple(by_key[k]) for k in sorted(by_key))
+
+
+def topology_map(
+    mesh: Any = None,
+    devices: Optional[Sequence[Any]] = None,
+    n_devices: Optional[int] = None,
+) -> TopologyMap:
+    """The ONE TopologyMap derivation, shared by the exchange plane, the
+    kNN dispatch/warm key derivation, slice_meshes, and the host-plane
+    ring.  Pass exactly one of `mesh` (1-D data mesh), `devices` (an
+    explicit device list — positions are list positions), or `n_devices`
+    (host ranks: no device attributes, env override only).
+
+    Priority: `SRML_TOPO=hosts:devs_per_host` simulation override (groups
+    by device id — or by position when ids are unavailable — so a shuffled
+    device list is genuinely non-contiguous), then device process_index
+    (jax's host grouping), then flat.  `SRML_EXCHANGE_TOPO=flat` keeps the
+    derived groups (link attribution stays honest) but pins the schedule
+    flat."""
+    pinned = _pinned_flat()
+    if mesh is not None:
+        devices = list(mesh.devices.flat)
+    if devices is not None:
+        n = len(devices)
+    elif n_devices is not None:
+        n = int(n_devices)
+    else:
+        raise ValueError("topology_map needs a mesh, devices, or n_devices")
+    if n <= 0:
+        raise ValueError(f"topology_map: need at least one device, got {n}")
+
+    devs_per_host = _parse_override()
+    if devs_per_host is not None:
+        if devices is not None:
+            keys = [
+                int(getattr(d, "id", pos)) // devs_per_host
+                for pos, d in enumerate(devices)
+            ]
+        else:
+            keys = [pos // devs_per_host for pos in range(n)]
+        groups = _group_positions(keys)
+        return TopologyMap(groups=groups, source="env", pinned=pinned)
+
+    if devices is not None:
+        procs = [getattr(d, "process_index", 0) for d in devices]
+        if len(set(procs)) > 1:
+            return TopologyMap(
+                groups=_group_positions(procs), source="process",
+                pinned=pinned,
+            )
+    return TopologyMap(
+        groups=(tuple(range(n)),), source="flat", pinned=pinned
+    )
+
+
+def ring_cycle(topo: TopologyMap, shift: int = 1) -> List[Tuple[int, int]]:
+    """Topology-aware ring permutation: a single n-cycle that tours each
+    host group's devices consecutively over ICI with exactly ONE gateway
+    edge per adjacent group pair crossing DCN.  Same (src, dst) pair
+    format as mesh.ring_permutation — which remains the flat definition
+    (and what this degenerates to when groups are contiguous).  Applied
+    every hop, a block visits all n devices and is home after n hops,
+    which is all the lex-merge exchange kernels require — visit ORDER is
+    irrelevant under a total-order merge."""
+    order = [p for g in topo.groups for p in g]
+    n = len(order)
+    nxt = {order[j]: order[(j + shift) % n] for j in range(n)}
+    return [(p, nxt[p]) for p in range(n)]
+
+
+# -- per-link byte models ------------------------------------------------------
+# Whole-mesh trace-time byte split per collective, from the SCHEDULE the
+# collective actually runs (see module header for the attribution rule).
+# `nbytes` is the per-shard payload (the same quantity the legacy
+# `exchange.<name>.bytes` counter records).
+
+
+def _flat_split(topo: TopologyMap, total: int) -> Tuple[int, int]:
+    if topo.n_groups <= 1:
+        return total, 0
+    return 0, total
+
+
+def link_split_gather(topo: TopologyMap, nbytes: int) -> Tuple[int, int]:
+    """(ici, dcn) for the gather-class collectives (allgather_rows,
+    gather_stack, psum_merge): every shard's block must reach every
+    device.  Flat: n*(n-1) block movements, unpinned to any link class.
+    Hierarchical: intra-group gather, one g-block frame per ordered group
+    pair over DCN, gateway rebroadcast of the foreign bytes over ICI."""
+    n = topo.n_devices
+    if n <= 1:
+        return 0, 0
+    if not topo.is_hierarchical:
+        return _flat_split(topo, n * (n - 1) * nbytes)
+    G, g = topo.n_groups, topo.group_size
+    ici = n * (g - 1) * nbytes + G * (g - 1) * (n - g) * nbytes
+    dcn = G * (G - 1) * g * nbytes
+    return ici, dcn
+
+
+def link_split_reduce(topo: TopologyMap, nbytes: int) -> Tuple[int, int]:
+    """(ici, dcn) for psum: like the gather class, but the cross-group
+    frame is the group-REDUCED partial (one block, not g)."""
+    n = topo.n_devices
+    if n <= 1:
+        return 0, 0
+    if not topo.is_hierarchical:
+        return _flat_split(topo, n * (n - 1) * nbytes)
+    G, g = topo.n_groups, topo.group_size
+    ici = n * (g - 1) * nbytes + G * (g - 1) * nbytes
+    dcn = G * (G - 1) * nbytes
+    return ici, dcn
+
+
+def link_split_ring_hop(topo: TopologyMap, nbytes: int) -> Tuple[int, int]:
+    """(ici, dcn) for ONE ring_shift hop: n simultaneous block sends.
+    The hierarchical cycle pins all but the G gateway edges to ICI; the
+    flat rotation pins nothing."""
+    n = topo.n_devices
+    if n <= 1:
+        return 0, 0
+    if not topo.is_hierarchical:
+        return _flat_split(topo, n * nbytes)
+    G = topo.n_groups
+    return (n - G) * nbytes, G * nbytes
+
+
+def group_major_devices(devices: Sequence[Any]) -> List[Any]:
+    """Reorder a device list group-major (each host group's devices
+    consecutive), preserving in-group order — the slice_meshes carve
+    order, so contiguous slices never straddle a host group when the
+    count allows.  No-op on flat/unknown topologies."""
+    topo = topology_map(devices=list(devices))
+    if topo.n_groups <= 1:
+        return list(devices)
+    return [devices[p] for g in topo.groups for p in g]

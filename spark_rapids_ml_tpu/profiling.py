@@ -973,8 +973,15 @@ def render_prometheus(metrics: Optional[Dict[str, Any]] = None) -> str:
             "srml_router": [],
             "srml_gauge": [],
         }
+        # exchange link pressure gets its own family with a `link` label
+        # (ici|dcn) — the dashboard dimension is the physical link class,
+        # not the dotted counter name
+        link_entries = []
         for k, v in sorted(gauges.items()):
-            if k.startswith("mem."):
+            if k.startswith("exchange.link."):
+                link = k[len("exchange.link."):].removesuffix("_bytes")
+                link_entries.append((link, v))
+            elif k.startswith("mem."):
                 fams["srml_memory_bytes"].append((k, v))
             elif k.startswith("health."):
                 fams["srml_health"].append((k, v))
@@ -982,6 +989,12 @@ def render_prometheus(metrics: Optional[Dict[str, Any]] = None) -> str:
                 fams["srml_router"].append((k, v))
             else:
                 fams["srml_gauge"].append((k, v))
+        if link_entries:
+            lines.append("# TYPE srml_exchange_bytes gauge")
+            for link, v in link_entries:
+                lines.append(
+                    f'srml_exchange_bytes{{link="{_prom_escape(link)}"}} {v}'
+                )
         for fam, entries in fams.items():
             if not entries:
                 continue

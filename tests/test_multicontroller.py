@@ -580,6 +580,114 @@ def test_kneighbors_across_processes_matches_single_controller(tmp_path, plane):
     assert (i_mc == i_sc).mean() > 0.99  # ids may swap only on exact ties
 
 
+def _knn_4proc_run(root, env_extra, n_items=520, n_query=64, d=9, k=7):
+    """4-process distributed_kneighbors over even partitions; returns the
+    merged (d, i) plus the inputs so callers can gate vs sklearn."""
+    nranks = 4
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(41)
+    items = rng.standard_normal((n_items, d)).astype(np.float32)
+    queries = rng.standard_normal((n_query, d)).astype(np.float32)
+    item_ids = rng.permutation(n_items).astype(np.int64) * 3
+    query_rows = np.array_split(np.arange(n_query), nranks)
+    item_rows = np.array_split(np.arange(n_items), nranks)
+    for r in range(nranks):
+        np.savez(
+            os.path.join(root, f"knn_shard_{r}.npz"),
+            item_X=items[item_rows[r]], item_id=item_ids[item_rows[r]],
+            q_X=queries[query_rows[r]],
+            q_id=query_rows[r].astype(np.int64),
+        )
+    with open(os.path.join(root, "knn_job.json"), "w") as f:
+        json.dump({"k": k}, f)
+    env = _worker_env(devs_per_rank=2)
+    env.update(env_extra)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "knn_mc_worker.py"),
+             str(r), str(nranks), root],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(nranks)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out += "\n<TIMEOUT: killed by driver>"
+        outs.append(out)
+    d_mc = np.zeros((n_query, k), np.float32)
+    i_mc = np.zeros((n_query, k), np.int64)
+    done = all(p.returncode == 0 for p in procs)
+    if done:
+        for r in range(nranks):
+            got = np.load(os.path.join(root, f"knn_out_{r}.npz"))
+            d_mc[query_rows[r]] = got["d"]
+            i_mc[query_rows[r]] = got["i"]
+    return procs, outs, d_mc, i_mc, items, item_ids, queries
+
+
+def test_kneighbors_topology_ring_bitwise_vs_flat_and_sklearn(tmp_path):
+    """srml-topo acceptance (satellite): the 4-process ring under
+    SRML_TOPO=2:2 (two simulated hosts of two ranks) returns BITWISE the
+    same results as the topology-oblivious flat run — the cycle checksum
+    agreed in the metadata round only reorders hops, and the traveling
+    lex merges are visit-order independent — and both match sklearn."""
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    base = {"SRML_KNN_EXCHANGE": "ring"}
+    pf, of, d_flat, i_flat, items, ids, queries = _knn_4proc_run(
+        str(tmp_path / "flat"), base
+    )
+    for r, (p, out) in enumerate(zip(pf, of)):
+        assert p.returncode == 0, f"[flat] rank {r} failed:\n{out}"
+    pt, ot, d_topo, i_topo, _, _, _ = _knn_4proc_run(
+        str(tmp_path / "topo"), dict(base, SRML_TOPO="2:2")
+    )
+    for r, (p, out) in enumerate(zip(pt, ot)):
+        assert p.returncode == 0, f"[2:2] rank {r} failed:\n{out}"
+    np.testing.assert_array_equal(d_topo, d_flat)
+    np.testing.assert_array_equal(i_topo, i_flat)
+    sk_d, sk_i = SkNN(n_neighbors=7, algorithm="brute").fit(
+        items
+    ).kneighbors(queries)
+    np.testing.assert_allclose(d_topo, sk_d, rtol=1e-4, atol=1e-4)
+    assert (i_topo == ids[sk_i]).mean() > 0.99
+
+
+def test_chaos_gateway_rank_death_hierarchical_ring(tmp_path):
+    """Chaos arm of the hierarchical route: under SRML_TOPO=2:2, rank 2 —
+    the GATEWAY of the second simulated host — dies mid-ring (knn.ring_hop
+    fault site, die at its 2nd hop).  Every survivor must surface a typed
+    RemoteRankError naming rank 2 within the dead-peer bound, never hang
+    to the driver timeout."""
+    import time as _time
+
+    from spark_rapids_ml_tpu.parallel.faults import DIE_EXIT_CODE
+
+    root = str(tmp_path)
+    t0 = _time.monotonic()
+    procs, outs, *_ = _knn_4proc_run(
+        root,
+        {
+            "SRML_KNN_EXCHANGE": "ring",
+            "SRML_TOPO": "2:2",
+            "SRML_FAULTS": "knn.ring_hop:rank=2:call=2:action=die",
+        },
+    )
+    wall = _time.monotonic() - t0
+    assert procs[2].returncode == DIE_EXIT_CODE, outs[2]
+    for r in (0, 1, 3):
+        assert procs[r].returncode not in (0, None), (r, outs[r])
+        assert "<TIMEOUT" not in outs[r], f"rank {r} hung:\n{outs[r]}"
+        assert "RemoteRankError" in outs[r] and "rank 2" in outs[r], outs[r]
+    assert wall < 120.0, f"cohort wind-down took {wall:.0f}s"
+
+
 @pytest.mark.parametrize("plane", ["file", "tcp"])
 def test_killed_rank_mid_fit_surfaces_typed_and_bounded(tmp_path, plane):
     """Chaos over a REAL jax.distributed session (the gap the srml-wire

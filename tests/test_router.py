@@ -86,6 +86,29 @@ def test_slice_meshes_disjoint_and_oversubscribed():
         slice_meshes(0)
 
 
+def test_slice_meshes_topology_aware_never_straddles_host_group(monkeypatch):
+    """Simulated 2x4 topology (SRML_TOPO groups by device ID), shuffled
+    device list: the group-major carve (parallel/topology.py) must land
+    every replica slice entirely inside ONE host group — a replica
+    spanning DCN would pay the slow link on every dispatch."""
+    import jax
+
+    from spark_rapids_ml_tpu.parallel.mesh import slice_meshes
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    monkeypatch.setenv("SRML_TOPO", "2:4")
+    devs = list(jax.devices())
+    shuf = [devs[j] for j in (3, 7, 0, 5, 2, 6, 1, 4)]
+    slices = slice_meshes(2, devices=shuf)
+    groups = [{d.id // 4 for d in m.devices.flat} for m in slices]
+    assert all(len(g) == 1 for g in groups), groups  # no straddling
+    assert groups[0] != groups[1]  # and still disjoint across hosts
+    # four slices of two: still one host group each
+    for m in slice_meshes(4, devices=shuf):
+        assert len({d.id // 4 for d in m.devices.flat}) == 1
+
+
 # -- scheduler policy units (pure functions, no replicas) --------------------
 
 
