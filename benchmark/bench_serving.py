@@ -37,6 +37,17 @@
 #                         router.swap() rolls a refit model in, reporting
 #                         p99 before/during/after the swap, the swap wall
 #                         time, and the (required-zero) client error count.
+#   --autoscale           srml-elastic step-load trace (ci/test.sh step 3t;
+#                         docs/serving.md §srml-elastic): deploy at
+#                         max_replicas on a 1-device-slice pool, trim to
+#                         min, then drive low -> 4x burst -> low while an
+#                         Autoscaler follows the exported signals.  Reports
+#                         the replica-count trajectory, p99 before/during/
+#                         after every scale event, shed counts, and
+#                         scale_up_new_compiles (required 0), then a
+#                         preemption-storm phase (SRML_FAULTS kills
+#                         ceil(K/2) replicas, restart budget 0) whose
+#                         storm_client_errors must be 0.
 #   --replicas/--inflight_depth size the replica set; client-side latency
 #                         (submit -> future resolution, reroutes included)
 #                         is what the router modes score — the client's
@@ -561,6 +572,311 @@ def run_swap_blip(model_name: str, model_a, model_b, X, args,
         append_report(report_path, rec)
 
 
+def run_autoscale(model_name: str, model, X, args, report_path: str) -> None:
+    """srml-elastic step-load trace (docs/serving.md §srml-elastic).
+
+    Deploy at max_replicas on a 1-device-slice pool — the whole compile
+    bill, paid once (AOT cache keys include the slice's device ids, so
+    zero-compile scale-up REQUIRES regrowing onto already-warmed slices;
+    the pool's first-fit re-lease makes that deterministic) — trim to
+    min_replicas, then drive low -> 4x burst -> low through the Router
+    while an Autoscaler follows the exported signal surface.  The base
+    rate is calibrated against the min-set's measured capacity so the 4x
+    burst saturates on any host speed.  A final preemption-storm phase
+    arms SRML_FAULTS kills for ceil(K/2) replicas under a zero restart
+    budget: repair must flow through the same re-slice + re-warm
+    actuation path with storm_client_errors == 0 (sheds are explicit
+    backpressure, not errors; every ADMITTED future must resolve)."""
+    import math
+    import os
+    import threading
+
+    from spark_rapids_ml_tpu.parallel import faults
+    from spark_rapids_ml_tpu.serving import (
+        DEGRADED,
+        READY,
+        Autoscaler,
+        AutoscalePolicy,
+        Router,
+        SlicePool,
+    )
+
+    d = max(1.0, args.duration)
+    policy = AutoscalePolicy(
+        min_replicas=max(1, args.autoscale_min),
+        max_replicas=max(args.autoscale_min, args.autoscale_max),
+        window_s=min(1.0, d / 2),
+        down_window_s=d,
+        up_fill=0.10,
+        # SLO burn is machine-speed relative (p99 vs the configured SLO),
+        # so a portable step trace keys scale-up on fill + sheds; burn is
+        # an attainment complement in [0, 1], so 1.01 disables the trigger
+        up_burn=1.01,
+        down_fill=0.05,
+        down_occupancy=0.25,
+        up_cooldown_s=min(0.5, d / 4),
+        down_cooldown_s=d / 2,
+    )
+
+    prev_restarts = os.environ.get("SRML_SERVE_MAX_RESTARTS")
+    prev_faults = os.environ.get(faults.FAULTS_ENV)
+    # replica death must be TERMINAL (the preemption model): recovery goes
+    # through the autoscaler's re-slice + re-warm path, not the in-place
+    # supervisor (_max_restarts() is read at death time, so setting the
+    # env here covers servers built below)
+    os.environ["SRML_SERVE_MAX_RESTARTS"] = "0"
+    pool = SlicePool(slice_devices=1)
+    try:
+        with Router(
+            pool=pool,
+            replicas=policy.max_replicas,
+            inflight_depth=args.inflight_depth,
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth,
+        ) as router:
+            router.serve(model_name, model)          # deploy at max: warm
+            router.scale_to(model_name, policy.min_replicas)  # trim
+            client = _RouterClient(router, model_name)
+            # calibrate: the min-set's measured throughput anchors the trace
+            cal = _open_loop(client, X, 2000.0, 0.4,
+                             args.rows_per_request, args.timeout_ms)
+            capacity = max(50.0, cal["throughput_rps"])
+            base = args.autoscale_rate or round(0.6 * capacity, 1)
+            burst = 4.0 * base
+            pc_before = profiling.counters("precompile.")
+
+            samples: List[Any] = []
+            stop_sampling = threading.Event()
+
+            def _sample():
+                while not stop_sampling.wait(0.025):
+                    try:
+                        n = len(router.replicas(model_name))
+                    except KeyError:
+                        n = 0
+                    if not samples or samples[-1][1] != n:
+                        samples.append((time.perf_counter(), n))
+
+            sampler = threading.Thread(
+                target=_sample, name="bench-autoscale-sampler", daemon=True
+            )
+
+            client.reset()
+            rng = np.random.default_rng(29)
+            submitted = 0
+
+            def _paced(rate: float, duration_s: float) -> int:
+                n = max(1, int(rate * duration_s))
+                idx = rng.integers(
+                    0, X.shape[0] - args.rows_per_request + 1, size=n
+                )
+                inter = 1.0 / rate
+                t0 = time.perf_counter()
+                for i in range(n):
+                    target = t0 + i * inter
+                    now = time.perf_counter()
+                    if target > now:
+                        time.sleep(target - now)
+                    client.submit(X[idx[i] : idx[i] + args.rows_per_request],
+                                  timeout_ms=args.timeout_ms)
+                return n
+
+            def _quiesce(total: int, timeout_s: float = 60.0) -> None:
+                deadline = time.perf_counter() + timeout_s
+                while time.perf_counter() < deadline:
+                    s = client.snapshot()
+                    if s["completed"] + s["errors"] + s["shed"] >= total:
+                        return
+                    time.sleep(0.01)
+
+            phases: List[Dict[str, Any]] = []
+            with Autoscaler(
+                router, policy=policy, interval_s=min(0.1, d / 10)
+            ) as scaler:
+                t_run0 = time.perf_counter()
+                samples.append((t_run0, len(router.replicas(model_name))))
+                sampler.start()
+                for label, rate in (
+                    ("low", base), ("burst", burst), ("low", base)
+                ):
+                    pre = client.snapshot()
+                    t0 = time.perf_counter()
+                    n = _paced(rate, d)
+                    submitted += n
+                    phases.append({
+                        "phase": label, "offered_rps": round(rate, 1),
+                        "requests": n, "t0": t0,
+                        "t1": time.perf_counter(), "pre": pre,
+                    })
+                _quiesce(submitted)
+                # idle tail: give the down-window + cooldown room to trim
+                deadline = (time.perf_counter() + 3 * d
+                            + policy.down_cooldown_s)
+                while time.perf_counter() < deadline:
+                    if (len(router.replicas(model_name))
+                            <= policy.min_replicas):
+                        break
+                    time.sleep(0.05)
+                phases[-1]["t1"] = time.perf_counter()
+
+                # -- preemption storm: kill ceil(K/2) replicas mid-stream --
+                pre_storm = list(router.replicas(model_name))
+                victims = [
+                    r.name
+                    for r in pre_storm[: math.ceil(len(pre_storm) / 2)]
+                ]
+                dead_ids = {id(r) for r in pre_storm if r.name in victims}
+                storm_rate = max(10.0, base / 2)
+                storm_pre = client.snapshot()
+                os.environ[faults.FAULTS_ENV] = ";".join(
+                    f"serving.dispatch:tag={v}:call=1:action=kill"
+                    for v in victims
+                )
+                faults.reload()
+                try:
+                    t_storm0 = time.perf_counter()
+                    n = _paced(storm_rate, d)
+                    submitted += n
+                    _quiesce(submitted)
+                finally:
+                    if prev_faults is None:
+                        os.environ.pop(faults.FAULTS_ENV, None)
+                    else:
+                        os.environ[faults.FAULTS_ENV] = prev_faults
+                    faults.reload()
+                restored = False
+                restore_deadline = time.perf_counter() + 30.0
+                while time.perf_counter() < restore_deadline:
+                    reps = router.replicas(model_name)
+                    if (
+                        len(reps) >= len(pre_storm)
+                        and not ({id(r) for r in reps} & dead_ids)
+                        and all(r.state() in (READY, DEGRADED)
+                                for r in reps)
+                    ):
+                        restored = True
+                        break
+                    time.sleep(0.05)
+                t_storm1 = time.perf_counter()
+                phases.append({
+                    "phase": "storm", "offered_rps": round(storm_rate, 1),
+                    "requests": n, "t0": t_storm0, "t1": t_storm1,
+                    "pre": storm_pre,
+                })
+                journal = scaler.journal()
+            stop_sampling.set()
+            sampler.join(timeout=5.0)
+
+            final = client.snapshot()
+            with client._lock:
+                lats = list(client.latencies)
+                done_t = list(client.done_t)
+
+            def _win(lo: float, hi: float) -> List[float]:
+                return [l for l, t in zip(lats, done_t) if lo <= t < hi]
+
+            phase_recs = []
+            for i, ph in enumerate(phases):
+                nxt = phases[i + 1]["pre"] if i + 1 < len(phases) else final
+                w = _win(ph["t0"], ph["t1"])
+                phase_recs.append({
+                    "phase": ph["phase"],
+                    "offered_rps": ph["offered_rps"],
+                    "requests": ph["requests"],
+                    "duration_sec": round(ph["t1"] - ph["t0"], 3),
+                    "completed_in_window": len(w),
+                    "shed": nxt["shed"] - ph["pre"]["shed"],
+                    "errors": nxt["errors"] - ph["pre"]["errors"],
+                    "p50_ms": _pctile_ms(w, 0.50),
+                    "p99_ms": _pctile_ms(w, 0.99),
+                })
+            events = []
+            for e in journal:
+                if e["decision"] == "hold":
+                    continue
+                t = e["t"]
+                events.append({
+                    "t_sec": round(t - t_run0, 3),
+                    "decision": e["decision"],
+                    "from_replicas": e["from_replicas"],
+                    "to_replicas": e["to_replicas"],
+                    "reason": e["reason"],
+                    "p99_before_ms": _pctile_ms(_win(t - 1.0, t), 0.99),
+                    "p99_during_ms": _pctile_ms(_win(t, t + 0.5), 0.99),
+                    "p99_after_ms": _pctile_ms(_win(t + 0.5, t + 1.5), 0.99),
+                })
+            pc_delta = profiling.counter_deltas(pc_before, "precompile.")
+            new_compiles = int(pc_delta.get("precompile.compile", 0)
+                               + pc_delta.get("precompile.fallback", 0))
+            trajectory = [
+                {"t_sec": round(t - t_run0, 3), "replicas": count}
+                for t, count in samples
+            ]
+            rec = {
+                "metric": "autoscale_step_load",
+                "model": model_name,
+                "mode": "router",
+                "min_replicas": policy.min_replicas,
+                "max_replicas": policy.max_replicas,
+                "slice_devices": 1,
+                "pool_slices": pool.capacity,
+                "calibrated_capacity_rps": round(capacity, 1),
+                "base_rps": round(base, 1),
+                "burst_rps": round(burst, 1),
+                "requests": submitted,
+                "completed": final["completed"],
+                "shed_total": final["shed"],
+                "errors_total": final["errors"],
+                "phases": phase_recs,
+                "replica_trajectory": trajectory,
+                "scale_events": events,
+                "scale_ups": int(
+                    profiling.counter(f"autoscale.{model_name}.scale_up")),
+                "scale_downs": int(
+                    profiling.counter(f"autoscale.{model_name}.scale_down")),
+                "holds": int(
+                    profiling.counter(f"autoscale.{model_name}.holds")),
+                "repairs": int(
+                    profiling.counter(f"autoscale.{model_name}.repairs")),
+                "scale_up_new_compiles": new_compiles,
+                "storm_killed": len(victims),
+                "storm_restored": restored,
+                "storm_window_sec": round(t_storm1 - t_storm0, 3),
+                "storm_client_errors": final["errors"]
+                - storm_pre["errors"],
+            }
+            traj = " -> ".join(str(p["replicas"]) for p in trajectory)
+            print(
+                f"== autoscale {model_name}: base {rec['base_rps']} req/s "
+                f"(capacity {rec['calibrated_capacity_rps']}), burst "
+                f"{rec['burst_rps']}; replicas {traj}; "
+                f"{rec['scale_ups']} up / {rec['scale_downs']} down / "
+                f"{rec['repairs']} repair(s); new compiles "
+                f"{new_compiles}"
+            )
+            for ev in events:
+                print(
+                    f"   t+{ev['t_sec']:.2f}s {ev['decision']} "
+                    f"{ev['from_replicas']}->{ev['to_replicas']} "
+                    f"p99 before/during/after = {ev['p99_before_ms']}/"
+                    f"{ev['p99_during_ms']}/{ev['p99_after_ms']} ms "
+                    f"({ev['reason']})"
+                )
+            print(
+                f"   storm: killed {rec['storm_killed']}, restored="
+                f"{rec['storm_restored']} in {rec['storm_window_sec']}s, "
+                f"client errors {rec['storm_client_errors']}"
+            )
+            append_report(report_path, rec)
+    finally:
+        if prev_restarts is None:
+            os.environ.pop("SRML_SERVE_MAX_RESTARTS", None)
+        else:
+            os.environ["SRML_SERVE_MAX_RESTARTS"] = prev_restarts
+        pool.close()
+
+
 def main(argv: List[str] = None) -> None:
     p = argparse.ArgumentParser(description="srml-serve open-loop load generator")
     p.add_argument("--models", type=str, default="kmeans,linreg",
@@ -600,6 +916,18 @@ def main(argv: List[str] = None) -> None:
                         "floor on small shared boxes)")
     p.add_argument("--swap_rate", type=float, default=100.0,
                    help="offered req/s during the --swap_blip window")
+    # -- srml-elastic mode (docs/serving.md §srml-elastic) --
+    p.add_argument("--autoscale", action="store_true",
+                   help="step-load autoscaling trace (low -> 4x burst -> "
+                        "low, then a preemption storm) through a "
+                        "1-device-slice pool + Autoscaler")
+    p.add_argument("--autoscale_min", type=int, default=2,
+                   help="autoscale floor (also the trimmed deploy size)")
+    p.add_argument("--autoscale_max", type=int, default=4,
+                   help="autoscale ceiling (the warm deploy size)")
+    p.add_argument("--autoscale_rate", type=float, default=0.0,
+                   help="base req/s for the step trace (0 = 0.6x the "
+                        "calibrated min-set capacity)")
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(0)
@@ -618,9 +946,11 @@ def main(argv: List[str] = None) -> None:
         t0 = time.perf_counter()
         model = _fit_model(model_name, X, y_reg, y_clf)
         fit_sec = time.perf_counter() - t0
-        if args.headline or args.swap_blip:
+        if args.headline or args.swap_blip or args.autoscale:
             if args.headline:
                 run_headline(model_name, model, X, args, args.report_path)
+            if args.autoscale:
+                run_autoscale(model_name, model, X, args, args.report_path)
             if args.swap_blip:
                 # a refit of the same class: the rolling swap re-warms its
                 # buckets straight from the retained AOT cache (zero new

@@ -806,6 +806,69 @@ assert fd > 0 and hd <= fd / 2 * 1.10, (hd, fd)
 EOF
 rm -rf "$TOPO_SMOKE"
 
+# 3t. srml-elastic gates (also inside the full suite; re-asserted by name
+#     so marker drift can never silently drop them — docs/serving.md
+#     §srml-elastic):
+#     - the shared-pool invariant: two models on ONE SlicePool can never
+#       be handed overlapping devices; group-major carve under
+#       SRML_TOPO=2:4 never straddles a host group; exhaustion is the
+#       typed retryable CapacityExhausted (never a silent round-robin),
+#       and shared single-device leases exist only under the explicit
+#       allow_oversubscribe policy
+#     - warm scale-up: deploy-at-max / trim / regrow performs ZERO new
+#       compiles (AOT cache keys include slice device ids — the bill is
+#       paid once at deploy) with predictions bitwise-identical to a
+#       fixed-replica comparator throughout
+#     - the preemption storm: replicas killed under a zero restart budget
+#       (SRML_FAULTS serving.dispatch kills) are re-sliced + re-warmed
+#       through Router.replace_replica with zero client-visible errors
+#     then the concurrency-sensitive pair re-run ONCE under the lockdep
+#     sanitizer (a violation raises out of the acquiring thread, so a
+#     green rerun IS the zero-violations assertion), a focused graftlint
+#     pass over the elastic plane + the modules this layer touched, and
+#     the bench --autoscale step-load smoke asserting the two required
+#     zeros: scale_up_new_compiles and storm_client_errors.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_autoscale.py -q
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_autoscale.py -q \
+    -k "shared_pool_keeps_models_disjoint or never_straddles \
+        or scale_up_is_warm or preemption_storm \
+        or oversubscription_is_typed"
+SRML_SANITIZE=lockdep XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_autoscale.py -q \
+    -k "concurrent_allocate_release or preemption_storm"
+python -m tools.graftlint \
+    spark_rapids_ml_tpu/serving/slicepool.py \
+    spark_rapids_ml_tpu/serving/autoscale.py \
+    spark_rapids_ml_tpu/serving/router.py \
+    spark_rapids_ml_tpu/serving/engine.py \
+    spark_rapids_ml_tpu/serving/scheduler.py \
+    spark_rapids_ml_tpu/parallel/mesh.py
+# rows_per_request is sized to the full batch so one replica saturates
+# below the paced client's ceiling on the 2-core image (the burst must
+# build REAL queue pressure for the signal-driven scale-up to fire)
+ELASTIC_SMOKE=$(mktemp -d)
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m benchmark.bench_serving --models kmeans --autoscale \
+    --duration 1 --fit_rows 4096 --num_cols 16 \
+    --rows_per_request 256 --max_batch 256 \
+    --report_path "$ELASTIC_SMOKE/elastic.jsonl"
+python - "$ELASTIC_SMOKE/elastic.jsonl" <<'EOF'
+import json, sys
+rec = json.loads(open(sys.argv[1]).readline())
+assert rec["metric"] == "autoscale_step_load", rec
+# THE srml-elastic bars: warm scale-up (the deploy-at-max discipline) and
+# preemption repair with zero client-visible errors
+assert rec["scale_up_new_compiles"] == 0, rec
+assert rec["storm_client_errors"] == 0 and rec["errors_total"] == 0, rec
+assert rec["storm_restored"] and rec["repairs"] >= 1, rec
+assert rec["scale_ups"] >= 1, rec   # the burst really forced a scale event
+assert max(p["replicas"] for p in rec["replica_trajectory"]) \
+    > rec["min_replicas"], rec
+EOF
+rm -rf "$ELASTIC_SMOKE"
+
 # 4. benchmark smoke on tiny data (reference ci/test.sh:38-45)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT

@@ -113,6 +113,41 @@ def slice_meshes(n_slices: int, devices=None) -> list:
     return out
 
 
+def carve_device_slices(devices, slice_devices: int) -> list:
+    """Carve the device list into as many DISJOINT `slice_devices`-sized
+    device groups as it holds — the fixed-granularity counterpart of
+    slice_meshes, and the ONE carve rule behind the serving slice pool
+    (serving/slicepool.SlicePool).
+
+    Group-aware, not merely group-major: when the host topology is known
+    and a slice fits inside a host group (slice_devices <= group size),
+    the carve runs PER GROUP, so no slice ever straddles a host group —
+    a replica spanning DCN would pay the slow link on every dispatch.
+    Devices left over inside a group (group size not a multiple of
+    slice_devices) are stranded rather than glued across the boundary;
+    the pool accounts for them explicitly.  A slice BIGGER than a host
+    group must span DCN by construction, so the carve falls back to
+    contiguous group-major runs (the whole-mesh n_slices=1 case).  On
+    flat/unknown topologies this is a plain contiguous carve."""
+    if slice_devices < 1:
+        raise ValueError(f"slice_devices must be >= 1, got {slice_devices}")
+    from . import topology
+
+    devs = list(devices) if devices is not None else jax.devices()
+    topo = topology.topology_map(devices=devs)
+    out = []
+    if topo.n_groups > 1 and slice_devices <= min(len(g) for g in topo.groups):
+        for g in topo.groups:
+            members = [devs[p] for p in g]
+            for i in range(len(members) // slice_devices):
+                out.append(members[i * slice_devices : (i + 1) * slice_devices])
+        return out
+    ordered = topology.group_major_devices(devs)
+    for i in range(len(ordered) // slice_devices):
+        out.append(ordered[i * slice_devices : (i + 1) * slice_devices])
+    return out
+
+
 def ring_permutation(n_dev: int, shift: int = 1):
     """The (source, destination) pairs of a +shift rotation along the
     1-D data mesh — the ONE definition of the mesh's ring order, used by

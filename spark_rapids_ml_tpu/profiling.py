@@ -971,6 +971,7 @@ def render_prometheus(metrics: Optional[Dict[str, Any]] = None) -> str:
             "srml_memory_bytes": [],
             "srml_health": [],
             "srml_router": [],
+            "srml_elastic": [],
             "srml_gauge": [],
         }
         # exchange link pressure gets its own family with a `link` label
@@ -987,6 +988,9 @@ def render_prometheus(metrics: Optional[Dict[str, Any]] = None) -> str:
                 fams["srml_health"].append((k, v))
             elif k.startswith("router."):
                 fams["srml_router"].append((k, v))
+            elif k.startswith(("slicepool.", "autoscale.")):
+                # srml-elastic capacity plane: pool ledger + policy loop
+                fams["srml_elastic"].append((k, v))
             else:
                 fams["srml_gauge"].append((k, v))
         if link_entries:

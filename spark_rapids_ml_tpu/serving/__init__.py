@@ -24,7 +24,16 @@
 #   multiplex.py srml-lanes: K same-shape model variants stacked on a pow2
 #                lane axis behind ONE kernel per micro-batch, with LRU
 #                lane paging (host-RAM spill, zero-recompile page-in)
+#   slicepool.py srml-elastic capacity ledger: fixed-size, group-aware,
+#                DISJOINT device slices leased to replicas across ALL
+#                served models; typed CapacityExhausted over silent
+#                oversubscription
+#   autoscale.py srml-elastic policy loop: signal-driven scale-up/down
+#                with hysteresis + cooldowns, and preemption repair
+#                (terminal replica -> re-slice + re-warm) through
+#                Router.scale_to / Router.replace_replica
 #
+from .autoscale import Autoscaler, AutoscalePolicy
 from .batcher import (
     MicroBatcher,
     RequestTimeout,
@@ -54,8 +63,14 @@ from .scheduler import (
     NoReplicaAvailable,
     RequestShed,
 )
+from .slicepool import CapacityExhausted, SliceLease, SlicePool
 
 __all__ = [
+    "Autoscaler",
+    "AutoscalePolicy",
+    "CapacityExhausted",
+    "SliceLease",
+    "SlicePool",
     "DEFAULT_CLASS",
     "DEGRADED",
     "DRAINING",

@@ -1061,6 +1061,14 @@ class ModelServer:
         self._burn_cache = (now, burn)
         return burn
 
+    def slo_burn(self) -> float:
+        """Public read of the cached SLO burn fraction — the autoscaler's
+        scale-up signal (serving/autoscale.py).  Same windowed verdict the
+        DEGRADED overlay and health() score against, amortized by the
+        _BURN_CACHE_S cache so a policy loop polling every replica every
+        tick never pays the latency-ring scan per call."""
+        return self._slo_burn()
+
     def effective_state(self) -> str:
         """Lifecycle state with the SLO-burn DEGRADED overlay applied —
         the router's rotation signal.  state() alone never reports

@@ -9,6 +9,13 @@
 # registration — model load time is where the compile bill is paid, so the
 # first request is already steady state.
 #
+# The registry is the SINGLE-server deployment surface (one ModelServer
+# per name on the whole mesh).  Replicated, capacity-managed serving —
+# slice-pool leases, scale_to, autoscaling, preemption repair — is the
+# router plane (serving/router.py + serving/slicepool.py +
+# serving/autoscale.py); a registry server's whole-mesh footprint is by
+# design outside the slice pool's ledger.
+#
 
 from __future__ import annotations
 

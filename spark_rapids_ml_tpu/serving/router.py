@@ -22,6 +22,14 @@
 #               warms its buckets BEFORE the atomic per-slot cut-over, the
 #               old generation drains its in-flight requests, and the set
 #               never loses more than one replica of capacity.
+#   ELASTIC     srml-elastic actuation: replica slices are LEASED from a
+#               SlicePool (serving/slicepool.py) instead of carved ad hoc,
+#               scale_to(name, n) grows/shrinks the set replica-by-replica
+#               (warm from the retained AOT cache, atomic admission,
+#               drain-then-release), and replace_replica() re-slices a
+#               preempted/terminal replica through the same spawn path —
+#               the policy loop that drives both lives in
+#               serving/autoscale.py.
 #
 # Replicas are named "<model>-r<i>" — every existing per-server surface
 # (serving.<n>.* counters, serve.<n>.* latency series, health states,
@@ -54,6 +62,7 @@ from .engine import (
 )
 from .entry import check_swap_compatible
 from .scheduler import DEFAULT_CLASS, NoReplicaAvailable, RequestShed
+from .slicepool import CapacityExhausted, SlicePool
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 
@@ -75,14 +84,38 @@ def _default_replicas() -> int:
 class _ReplicaSet:
     """One served model's replicas + routing policy state.  The replica
     list is swapped under the router lock; dispatch reads a snapshot, so a
-    rolling swap never blocks traffic on the other slots."""
+    rolling swap never blocks traffic on the other slots.
 
-    def __init__(self, name: str, priority: str, replicas, slices, kwargs):
+    Since srml-elastic the set also carries its capacity bookkeeping:
+    `leases[i]` is the SlicePool lease replica i runs on, `slots[i]` its
+    stable slot id (replica names are "<model>-r<slot>"; a replaced or
+    re-grown slot reuses its id so per-replica metric series and fault
+    tags stay continuous), `factory` the ONE replica constructor shared
+    by serve/swap/scale_to/replace_replica, and `scale_lock` the per-set
+    mutex that serializes structural changes (scale/swap/repair) without
+    ever blocking dispatch, which only takes the router state lock."""
+
+    def __init__(
+        self, name, priority, replicas, leases, slots, kwargs, factory,
+        pool, owns_pool, allow_oversubscribe,
+    ):
         self.name = name
         self.priority = priority
         self.replicas: List[ModelServer] = replicas
-        self.slices = slices
+        self.leases = leases
+        self.slots = slots
         self.kwargs = kwargs  # per-replica ModelServer kwargs (for swap)
+        self.factory = factory  # (replica_name, mesh) -> server
+        self.pool = pool
+        self.owns_pool = owns_pool  # implicit per-set pool: close on unroute
+        self.allow_oversubscribe = allow_oversubscribe
+        self.scale_lock = sanitize.lockdep_lock("serve.router.scale")
+
+    @property
+    def slices(self):
+        """Mesh per replica (lease view) — kept for callers that predate
+        the slice pool."""
+        return [lease.mesh for lease in self.leases]
 
 
 class Router:
@@ -98,9 +131,16 @@ class Router:
         self,
         replicas: Optional[int] = None,
         inflight_depth: Optional[int] = None,
+        pool: Optional[SlicePool] = None,
         **server_kwargs: Any,
     ):
         self._replicas_default = replicas or _default_replicas()
+        # srml-elastic: a shared SlicePool makes slice ownership explicit
+        # ACROSS models and leaves headroom for scale_to/autoscaling.
+        # Without one, each serve() builds a private per-set pool sized so
+        # the initial replica count covers every device — the historical
+        # whole-fleet carve, byte-compatible with pre-pool routers.
+        self._pool = pool
         from ..utils import env_float
 
         self._inflight_depth = max(
@@ -131,41 +171,60 @@ class Router:
         profiling.register_gauges(self._gauge_key, _provider)
 
     # -- deployment -----------------------------------------------------------
-    def serve(
+    def _deploy(
         self,
         name: str,
-        model: Any,
-        replicas: Optional[int] = None,
-        priority: str = DEFAULT_CLASS,
-        **overrides: Any,
+        priority: str,
+        n: int,
+        factory,
+        kwargs: Dict[str, Any],
+        allow_oversubscribe: bool,
     ) -> List[ModelServer]:
-        """Deploy `model` under `name` as a replica set: carve disjoint
-        mesh slices, then warm one ModelServer per slice ("<name>-r<i>").
-        The name is reserved before the (expensive) warmups, so a
-        duplicate fails before paying any compile bill; a replica whose
-        warmup fails tears down the ones already built."""
+        """The ONE deployment path under serve()/serve_multiplex(): reserve
+        the name, lease `n` disjoint slices from the pool, build a replica
+        per lease through `factory`, install atomically.  The name is
+        reserved before the (expensive) warmups, so a duplicate fails
+        before paying any compile bill; a replica whose warmup fails tears
+        down the ones already built and releases every lease.
+
+        Slice accounting replaces the historical silent round-robin
+        oversubscription: asking for more replicas than the pool can carve
+        WITHOUT sharing devices raises the typed CapacityExhausted (a
+        ValueError) unless allow_oversubscribe=True, because two
+        multi-device programs interleaving their per-device enqueue order
+        on shared devices can deadlock XLA:CPU's cross_module rendezvous
+        (parallel/mesh.slice_meshes documents the hazard) — opting in
+        degrades the overflow replicas to single shared devices, which
+        only contend."""
         scheduler.class_index(priority)  # typo'd class fails at deploy time
-        n = replicas or self._replicas_default
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1, got {n}")
         with self._lock:
             if name in self._sets:
                 raise ValueError(f"model name {name!r} already routed")
             self._sets[name] = None  # reservation; filled below
-        from ..parallel.mesh import slice_meshes
-
-        kwargs = {
-            "inflight_depth": self._inflight_depth,
-            **self._defaults,
-            **overrides,
-        }
         built: List[ModelServer] = []
+        leases: List[Any] = []
+        pool = self._pool
+        owns_pool = pool is None
         try:
-            slices = slice_meshes(n)
-            for i in range(n):
-                built.append(
-                    ModelServer(
-                        f"{name}-r{i}", model, mesh=slices[i], **kwargs
+            if pool is None:
+                # per-set pool reproducing the whole-fleet carve: n slices
+                # of len(devices)//n (plus any headroom the division
+                # leaves), group-major so none straddles a host group
+                import jax
+
+                n_dev = len(jax.devices())
+                pool = SlicePool(slice_devices=max(1, n_dev // n))
+            for slot in range(n):
+                leases.append(
+                    pool.allocate(
+                        f"{name}-r{slot}",
+                        oversubscribe=allow_oversubscribe or None,
                     )
                 )
+            for slot, lease in enumerate(leases):
+                built.append(factory(f"{name}-r{slot}", lease.mesh))
         except BaseException:
             for srv in built:
                 try:
@@ -175,14 +234,49 @@ class Router:
                         "router: teardown of half-built replica %r failed",
                         srv.name,
                     )
+            for lease in leases:
+                pool.release(lease)
+            if owns_pool and pool is not None:
+                pool.close()
             with self._lock:
                 self._sets.pop(name, None)
             raise
-        rs = _ReplicaSet(name, priority, built, slices, kwargs)
+        rs = _ReplicaSet(
+            name, priority, built, leases, list(range(n)), kwargs,
+            factory, pool, owns_pool, allow_oversubscribe,
+        )
         with self._lock:
             self._sets[name] = rs
         profiling.incr_counter(f"router.{name}.replicas_started", n)
         return built
+
+    def serve(
+        self,
+        name: str,
+        model: Any,
+        replicas: Optional[int] = None,
+        priority: str = DEFAULT_CLASS,
+        allow_oversubscribe: bool = False,
+        **overrides: Any,
+    ) -> List[ModelServer]:
+        """Deploy `model` under `name` as a replica set: lease disjoint
+        mesh slices from the slice pool, then warm one ModelServer per
+        slice ("<name>-r<i>").  More replicas than the pool can carve
+        without sharing devices raises the typed CapacityExhausted unless
+        `allow_oversubscribe=True` (see _deploy)."""
+        kwargs = {
+            "inflight_depth": self._inflight_depth,
+            **self._defaults,
+            **overrides,
+        }
+
+        def factory(replica_name: str, mesh) -> ModelServer:
+            return ModelServer(replica_name, model, mesh=mesh, **kwargs)
+
+        return self._deploy(
+            name, priority, replicas or self._replicas_default, factory,
+            kwargs, allow_oversubscribe,
+        )
 
     def serve_multiplex(
         self,
@@ -192,6 +286,7 @@ class Router:
         priority: str = DEFAULT_CLASS,
         *,
         resident_lanes: Optional[int] = None,
+        allow_oversubscribe: bool = False,
         **overrides: Any,
     ) -> List[ModelServer]:
         """Deploy K same-shape model variants as a replica set of
@@ -203,46 +298,164 @@ class Router:
         deploying a successor set under a new name."""
         from .multiplex import MultiplexServer
 
-        scheduler.class_index(priority)
-        n = replicas or self._replicas_default
-        with self._lock:
-            if name in self._sets:
-                raise ValueError(f"model name {name!r} already routed")
-            self._sets[name] = None  # reservation; filled below
-        from ..parallel.mesh import slice_meshes
-
         kwargs = {
             "inflight_depth": self._inflight_depth,
             **self._defaults,
             **overrides,
         }
-        built: List[ModelServer] = []
+
+        def factory(replica_name: str, mesh) -> ModelServer:
+            return MultiplexServer(
+                replica_name, models, mesh=mesh,
+                resident_lanes=resident_lanes, **kwargs,
+            )
+
+        return self._deploy(
+            name, priority, replicas or self._replicas_default, factory,
+            kwargs, allow_oversubscribe,
+        )
+
+    # -- elastic actuation (serving/autoscale.py drives these) ---------------
+    def _spawn_slot(self, name: str, rs: _ReplicaSet, slot: int):
+        """Lease a slice and build the replica for `slot` through the
+        set's shared factory.  Returns (replica, lease); on a build
+        failure the lease is released before the error propagates.
+        Caller holds rs.scale_lock (never the state lock — warmup is the
+        expensive part and dispatch must keep flowing)."""
+        lease = rs.pool.allocate(
+            f"{name}-r{slot}", oversubscribe=rs.allow_oversubscribe or None
+        )
         try:
-            slices = slice_meshes(n)
-            for i in range(n):
-                built.append(
-                    MultiplexServer(
-                        f"{name}-r{i}", models, mesh=slices[i],
-                        resident_lanes=resident_lanes, **kwargs,
-                    )
-                )
+            replica = rs.factory(f"{name}-r{slot}", lease.mesh)
         except BaseException:
-            for srv in built:
-                try:
-                    srv.shutdown(drain=False)
-                except Exception:  # noqa: BLE001 - teardown of a half-built set
-                    logger.warning(
-                        "router: teardown of half-built replica %r failed",
-                        srv.name,
-                    )
-            with self._lock:
-                self._sets.pop(name, None)
+            rs.pool.release(lease)
             raise
-        rs = _ReplicaSet(name, priority, built, slices, kwargs)
-        with self._lock:
-            self._sets[name] = rs
-        profiling.incr_counter(f"router.{name}.replicas_started", n)
-        return built
+        return replica, lease
+
+    def scale_to(
+        self, name: str, n: int, *, drain_timeout_s: float = 30.0
+    ) -> List[ModelServer]:
+        """Resize the replica set to exactly `n` replicas — the elastic
+        plane's actuator (serving/autoscale.py decides when; this makes
+        it so).  Scale-UP leases a fresh pool slice per new slot, warms
+        the replica through the set's factory (for a model class already
+        served, the retained AOT executable cache satisfies the warmup
+        with ZERO new compiles — the swap discipline, chaos-gated), and
+        admits it to rotation atomically; no free slice raises the typed
+        retryable CapacityExhausted with the set unchanged mid-growth.
+        Scale-DOWN removes the highest slot from rotation atomically,
+        drains its in-flight work, then releases its slice back to the
+        pool — admitted requests finish, new ones never see it.  Returns
+        the post-scale replica snapshot."""
+        rs = self._set(name)
+        if n < 1:
+            raise ValueError(
+                f"router.{name}: cannot scale below 1 replica (got {n}); "
+                "use unroute() to stop serving"
+            )
+        with rs.scale_lock:
+            with profiling.span(f"router.{name}.scale", target=n):
+                while True:
+                    with self._lock:
+                        if self._sets.get(name) is not rs:
+                            raise KeyError(
+                                f"routed model {name!r} was removed during "
+                                "scale_to; aborting"
+                            )
+                        cur = len(rs.replicas)
+                        if cur == n:
+                            return list(rs.replicas)
+                        if cur > n:
+                            # atomic removal: highest slot leaves rotation
+                            i = max(
+                                range(len(rs.slots)), key=rs.slots.__getitem__
+                            )
+                            victim = rs.replicas.pop(i)
+                            lease = rs.leases.pop(i)
+                            rs.slots.pop(i)
+                        else:
+                            slot = next(
+                                s for s in range(n) if s not in rs.slots
+                            )
+                    if cur > n:
+                        try:
+                            victim.drain(timeout_s=drain_timeout_s)
+                        finally:
+                            victim.shutdown(drain=False)
+                            rs.pool.release(lease)
+                        profiling.incr_counter(f"router.{name}.scaled_down")
+                        continue
+                    replica, lease = self._spawn_slot(name, rs, slot)
+                    with self._lock:
+                        if self._sets.get(name) is not rs:
+                            installed = False
+                        else:
+                            rs.replicas.append(replica)
+                            rs.leases.append(lease)
+                            rs.slots.append(slot)
+                            installed = True
+                    if not installed:
+                        replica.shutdown(drain=False)
+                        rs.pool.release(lease)
+                        raise KeyError(
+                            f"routed model {name!r} was removed during "
+                            "scale_to; aborting"
+                        )
+                    profiling.incr_counter(f"router.{name}.scaled_up")
+                    profiling.incr_counter(f"router.{name}.replicas_started")
+
+    def replace_replica(
+        self, name: str, dead: ModelServer
+    ) -> Optional[ModelServer]:
+        """Replace one terminal replica in place — preemption as the
+        common case (serving/autoscale.py's repair path).  The dead
+        replica's slice goes back to the pool FIRST, a fresh lease is
+        taken (possibly the same devices, possibly a re-slice), the
+        successor warms through the set's factory (retained AOT cache:
+        zero new compiles), and the slot cuts over atomically under the
+        state lock — same discipline as swap(), minus the compat check
+        (same factory, same model).  The dead replica is torn down
+        without drain: its worker already died, and the engine already
+        failed its in-flight futures with the typed retryable errors the
+        router reroutes.  Returns the successor, or None if the replica
+        had already been replaced/removed (repair paths may race)."""
+        rs = self._set(name)
+        with rs.scale_lock:
+            with self._lock:
+                if self._sets.get(name) is not rs:
+                    return None
+                try:
+                    i = rs.replicas.index(dead)
+                except ValueError:
+                    return None  # already replaced or scaled away
+                slot = rs.slots[i]
+                old_lease = rs.leases[i]
+            rs.pool.release(old_lease)
+            incoming, lease = self._spawn_slot(name, rs, slot)
+            with self._lock:
+                installed = False
+                if self._sets.get(name) is rs:
+                    try:
+                        i = rs.replicas.index(dead)
+                    except ValueError:
+                        i = -1
+                    if i >= 0:
+                        rs.replicas[i] = incoming  # atomic slot cut-over
+                        rs.leases[i] = lease
+                        installed = True
+            if not installed:
+                incoming.shutdown(drain=False)
+                rs.pool.release(lease)
+                return None
+            try:
+                dead.shutdown(drain=False)
+            except Exception:  # noqa: BLE001 - teardown of a dead replica
+                logger.warning(
+                    "router.%s: teardown of replaced replica %r failed",
+                    name, dead.name,
+                )
+            profiling.incr_counter(f"router.{name}.replicas_replaced")
+            return incoming
 
     def _set(self, name: str) -> _ReplicaSet:
         with self._lock:
@@ -436,17 +649,24 @@ class Router:
         the untouched slots — zero downtime.
 
         An incompatible model (entry.check_swap_compatible) fails BEFORE
-        the first cut-over, leaving the set untouched."""
+        the first cut-over, leaving the set untouched.  A completed swap
+        also updates the set's replica factory, so later scale_to()
+        growth and preemption repairs spawn the NEW model."""
         rs = self._set(name)
         t0 = profiling.now()
         swapped: List[ModelServer] = []
-        with profiling.span(f"router.{name}.swap", replicas=len(rs.replicas)):
+
+        def factory(replica_name: str, mesh) -> ModelServer:
+            return ModelServer(replica_name, new_model, mesh=mesh, **rs.kwargs)
+
+        with rs.scale_lock, profiling.span(
+            f"router.{name}.swap", replicas=len(rs.replicas)
+        ):
             for i in range(len(rs.replicas)):
                 with self._lock:
                     old = rs.replicas[i]
-                incoming = ModelServer(
-                    old.name, new_model, mesh=rs.slices[i], **rs.kwargs
-                )
+                    mesh_i = rs.leases[i].mesh
+                incoming = factory(old.name, mesh_i)
                 try:
                     check_swap_compatible(old._entry, incoming._entry, name)
                     with self._lock:
@@ -478,6 +698,8 @@ class Router:
                     old.drain(timeout_s=drain_timeout_s)
                 finally:
                     old.shutdown(drain=False)
+            with self._lock:
+                rs.factory = factory  # scale-ups now spawn the new model
         profiling.incr_counter(f"router.{name}.swaps")
         profiling.record_duration(
             f"router.{name}.swap", profiling.now() - t0
@@ -489,8 +711,18 @@ class Router:
             rs = self._sets.pop(name, None)
         if rs is None:
             return
+        self._teardown_set(rs, drain=drain)
+
+    def _teardown_set(self, rs: _ReplicaSet, drain: bool) -> None:
+        """Shut every replica down and return its slice to the pool; an
+        implicit per-set pool is closed outright (its gauge provider goes
+        with it)."""
         for srv in rs.replicas:
             srv.shutdown(drain=drain)
+        for lease in rs.leases:
+            rs.pool.release(lease)
+        if rs.owns_pool:
+            rs.pool.close()
 
     # -- health / observability ----------------------------------------------
     def _model_health(self, rs: _ReplicaSet) -> Dict[str, Any]:
@@ -519,6 +751,12 @@ class Router:
             "replicas": len(reps),
             "in_rotation": in_rotation,
             "fill": round(scheduler.aggregate_fill(reps), 6),
+            # the autoscaler's signal surface, exported so operators see
+            # exactly what the policy loop saw: fill_fraction is the
+            # admission fill (queued rows / queue depth), occupancy the
+            # busyness including rows in flight on the devices
+            "fill_fraction": round(scheduler.aggregate_fill(reps), 6),
+            "occupancy": round(scheduler.aggregate_occupancy(reps), 6),
             "restarts": sum(h.get("restarts", 0) for h in health.values()),
             "models": health,  # per-replica health, engine.health() shape
         }
@@ -574,6 +812,8 @@ class Router:
             out[f"router.{name}.replicas"] = float(m["replicas"])
             out[f"router.{name}.in_rotation"] = float(m["in_rotation"])
             out[f"router.{name}.fill"] = float(m["fill"])
+            out[f"router.{name}.fill_fraction"] = float(m["fill_fraction"])
+            out[f"router.{name}.occupancy"] = float(m["occupancy"])
             out.update(watch.health_gauges(m["models"]))
         return out
 
@@ -597,8 +837,7 @@ class Router:
             sets = [rs for rs in self._sets.values() if rs is not None]
             self._sets.clear()
         for rs in sets:
-            for srv in rs.replicas:
-                srv.shutdown(drain=drain)
+            self._teardown_set(rs, drain=drain)
 
     def __enter__(self) -> "Router":
         return self

@@ -112,6 +112,22 @@ def aggregate_fill(replicas: Sequence[Any]) -> float:
     return queued / depth
 
 
+def aggregate_occupancy(replicas: Sequence[Any]) -> float:
+    """Aggregate occupancy over a replica set: admitted-but-unresolved
+    requests over total queue depth.  Where aggregate_fill counts only
+    rows still WAITING in the queues (it collapses to zero the instant
+    dispatch keeps up), occupancy also counts rows in flight on the
+    devices, so it stays a truthful busyness signal for a set that is
+    saturated but not backlogged — the autoscaler's scale-DOWN guard
+    (serving/autoscale.py) and the router.<model>.occupancy gauge.  Can
+    exceed 1.0 under deep continuous-batching pipelines; an empty set
+    reads 0.0 (nothing is busy, unlike fill's defensive 1.0)."""
+    depth = sum(r.queue_depth() for r in replicas)
+    if depth <= 0:
+        return 0.0
+    return sum(r.outstanding() for r in replicas) / depth
+
+
 def _state_of(r: Any) -> str:
     """A replica's rotation state: effective_state() (the SLO-burn-aware
     verdict) when the object offers it, plain state() otherwise."""
